@@ -1,0 +1,20 @@
+"""mythril_tpu: a TPU-native symbolic-execution security analyzer for EVM bytecode.
+
+A ground-up rebuild of the capabilities of Mythril (reference:
+strawberrylady99/mythril v0.22.7) designed TPU-first:
+
+- ``mythril_tpu.smt``       — expression DAG + bit-blaster + solvers (the L0 seam;
+  reference: mythril/laser/smt/).  No Z3: satisfiability is decided by a
+  native C++ CDCL solver (``smt/solver/native``) and a batched JAX/Pallas
+  local-search + unit-propagation kernel (``ops/``).
+- ``mythril_tpu.laser``     — the symbolic EVM (reference: mythril/laser/ethereum/).
+- ``mythril_tpu.analysis``  — detection modules, exploit concretization, reports
+  (reference: mythril/analysis/).
+- ``mythril_tpu.ops``       — batched TPU kernels (u256 limb math, unit propagation,
+  WalkSAT) — the compute path that replaces serial Z3 dispatch.
+- ``mythril_tpu.parallel``  — device-mesh sharding of solver batches and corpus
+  analysis; learned-clause exchange via collectives.
+- ``mythril_tpu.interfaces``— the ``myth``-compatible CLI.
+"""
+
+from mythril_tpu.__version__ import __version__  # noqa: F401

@@ -1,0 +1,7 @@
+"""Version of the mythril_tpu framework.
+
+Tracks feature parity with reference mythril/__version__.py:7 (v0.22.7);
+our own versioning starts at 0.1.x.
+"""
+
+__version__ = "0.1.0"

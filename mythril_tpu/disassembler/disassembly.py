@@ -1,0 +1,76 @@
+"""Disassembly: instruction list + function-entry discovery.
+
+Reference counterpart: mythril/disassembler/disassembly.py — decodes
+bytecode, then recognizes the Solidity dispatcher idiom
+``DUP1; PUSH4 <selector>; EQ; PUSH<n> <entry>; JUMPI`` to build the
+selector->entry-point maps used for report function names and CFG
+labels.  Names resolve through :class:`SignatureDB`.
+"""
+
+import logging
+from typing import Dict, List
+
+from mythril_tpu.disassembler import asm
+from mythril_tpu.support.crypto import keccak256
+from mythril_tpu.support.signatures import SignatureDB
+
+log = logging.getLogger(__name__)
+
+# The dispatcher comparison site; entry PUSH may be 1-4 bytes wide.
+_DISPATCHER_PATTERN = [
+    ["PUSH4"],
+    ["EQ"],
+    ["PUSH1", "PUSH2", "PUSH3", "PUSH4"],
+    ["JUMPI"],
+]
+
+
+class Disassembly:
+    """Decoded bytecode plus selector/function metadata."""
+
+    def __init__(self, code: str, enable_online_lookup: bool = False):
+        if isinstance(code, (bytes, bytearray)):
+            code = "0x" + bytes(code).hex()
+        self.bytecode = code
+        self.raw_bytecode = bytes.fromhex(code.removeprefix("0x"))
+        self.instruction_list: List[asm.EvmInstruction] = asm.disassemble(
+            self.raw_bytecode
+        )
+        self.func_hashes: List[str] = []
+        self.function_name_to_address: Dict[str, int] = {}
+        self.address_to_function_name: Dict[int, str] = {}
+        self.enable_online_lookup = enable_online_lookup
+        signature_db = SignatureDB(enable_online_lookup=enable_online_lookup)
+
+        for index in asm.find_op_code_sequence(
+            _DISPATCHER_PATTERN, self.instruction_list
+        ):
+            selector_instr = self.instruction_list[index]
+            entry_instr = self.instruction_list[index + 2]
+            assert selector_instr.argument is not None
+            assert entry_instr.argument is not None
+            selector = "0x" + selector_instr.argument.hex()
+            entry = int.from_bytes(entry_instr.argument, "big")
+            matches = signature_db.get(selector)
+            if matches:
+                name = matches[0]
+                if len(matches) > 1:
+                    log.debug("Ambiguous signature for %s: %s", selector, matches)
+            else:
+                name = f"_function_{selector}"
+            self.func_hashes.append(selector)
+            self.function_name_to_address[name] = entry
+            self.address_to_function_name[entry] = name
+
+    def get_easm(self) -> str:
+        return asm.instruction_list_to_easm(self.instruction_list)
+
+    def __len__(self) -> int:
+        return len(self.raw_bytecode)
+
+
+def get_code_hash(code) -> str:
+    """keccak256 of the (hex or raw) bytecode, 0x-prefixed."""
+    if isinstance(code, str):
+        code = bytes.fromhex(code.removeprefix("0x"))
+    return "0x" + keccak256(bytes(code)).hex()

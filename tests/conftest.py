@@ -1,0 +1,26 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual XLA devices so multi-chip sharding logic
+is exercised without TPU hardware (real-chip benchmarking happens in
+bench.py, driven separately).  These env vars must be set before jax is
+imported anywhere, hence the top-of-conftest placement.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REFERENCE_ROOT = "/root/reference"
+
+
+def reference_path(*parts: str) -> str:
+    """Path into the read-only reference checkout (tests skip if absent)."""
+    return os.path.join(REFERENCE_ROOT, *parts)

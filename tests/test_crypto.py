@@ -1,0 +1,59 @@
+"""Crypto primitive tests against published vectors."""
+
+import hashlib
+
+from mythril_tpu.support.crypto import (
+    blake2b_compress,
+    bn128_add,
+    bn128_mul,
+    ecdsa_sign,
+    ecrecover_address,
+    keccak256,
+    privkey_to_address,
+)
+
+
+def test_keccak256_vectors():
+    assert (
+        keccak256(b"").hex()
+        == "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    assert (
+        keccak256(b"abc").hex()
+        == "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+    # exactly one rate block (136 bytes) exercises the multi-absorb path
+    assert keccak256(b"\x00" * 136) != keccak256(b"\x00" * 135)
+
+
+def test_ecrecover_roundtrip():
+    private_key = 0x1234_5678_9ABC
+    address = privkey_to_address(private_key)
+    digest = keccak256(b"transaction payload")
+    v, r, s = ecdsa_sign(digest, private_key)
+    assert ecrecover_address(digest, v, r, s) == address
+    # invalid v yields None
+    assert ecrecover_address(digest, 29, r, s) is None
+
+
+def test_blake2b_compress_matches_hashlib():
+    h = [0x6A09E667F3BCC908 ^ 0x01010040] + [
+        0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B, 0xA54FF53A5F1D36F1,
+        0x510E527FADE682D1, 0x9B05688C2B3E6C1F, 0x1F83D9ABFB41BD6B,
+        0x5BE0CD19137E2179,
+    ]
+    message = [0x0000000000636261] + [0] * 15
+    out = blake2b_compress(12, h, message, (3, 0), True)
+    digest = b"".join(x.to_bytes(8, "little") for x in out)
+    assert digest == hashlib.blake2b(b"abc").digest()
+
+
+def test_bn128_add_mul():
+    g1 = (1, 2)
+    two_g = bn128_add(g1, g1)
+    assert two_g == bn128_mul(g1, 2)
+    three_g = bn128_add(two_g, g1)
+    assert three_g == bn128_mul(g1, 3)
+    # identity behavior
+    assert bn128_add(g1, None) == g1
+    assert bn128_mul(g1, 0) is None

@@ -1,0 +1,113 @@
+"""Disassembler + assembler tests (reference oracle: tests/disassembler/)."""
+
+import os
+
+import pytest
+
+from mythril_tpu.disassembler.asm import disassemble, find_op_code_sequence
+from mythril_tpu.disassembler.disassembly import Disassembly
+from mythril_tpu.support.assembler import asm, assemble
+from mythril_tpu.support.signatures import selector_of
+from tests.conftest import reference_path
+
+
+def test_assemble_roundtrip():
+    code = assemble(
+        """
+        PUSH 0x60; PUSH 0x40; MSTORE
+        CALLVALUE; ISZERO; PUSH @ok; JUMPI
+        PUSH 0; PUSH 0; REVERT
+      ok:
+        JUMPDEST; STOP
+        """
+    )
+    instrs = disassemble(code)
+    names = [i.op_code for i in instrs]
+    assert names == [
+        "PUSH1", "PUSH1", "MSTORE", "CALLVALUE", "ISZERO", "PUSH2", "JUMPI",
+        "PUSH1", "PUSH1", "REVERT", "JUMPDEST", "STOP",
+    ]
+    # label resolves to the JUMPDEST offset
+    jumpdest_offset = instrs[-2].address
+    assert int.from_bytes(instrs[5].argument, "big") == jumpdest_offset
+
+
+def test_push_argument_extraction_and_truncation():
+    instrs = disassemble(bytes.fromhex("6100ff"))
+    assert instrs[0].op_code == "PUSH2" and instrs[0].argument == b"\x00\xff"
+    # truncated PUSH at end of code is zero-padded
+    instrs = disassemble(bytes.fromhex("61ff"))
+    assert instrs[0].argument == b"\xff\x00"
+
+
+def test_invalid_opcode():
+    instrs = disassemble(bytes.fromhex("0c"))
+    assert instrs[0].op_code == "INVALID"
+
+
+def test_metadata_tail_skipped():
+    # code STOP + fake bzzr metadata tail of declared length
+    body = bytes.fromhex("00")
+    meta = bytes.fromhex("a165627a7a72") + b"\x00" * 36
+    tail = meta + (len(meta)).to_bytes(2, "big")
+    instrs = disassemble(body + tail)
+    assert [i.op_code for i in instrs] == ["STOP"]
+
+
+def test_find_op_code_sequence():
+    code = assemble("PUSH4 0x11223344; EQ; PUSH2 0x0010; JUMPI; STOP")
+    instrs = disassemble(code)
+    hits = list(
+        find_op_code_sequence(
+            [["PUSH4"], ["EQ"], ["PUSH1", "PUSH2"], ["JUMPI"]], instrs
+        )
+    )
+    assert hits == [0]
+
+
+def test_function_discovery_dispatcher():
+    selector = selector_of("withdraw()")
+    code = asm(
+        f"""
+        PUSH 0; CALLDATALOAD; PUSH 0xe0; SHR
+        DUP1; PUSH4 {selector}; EQ; PUSH @withdraw; JUMPI
+        PUSH 0; PUSH 0; REVERT
+      withdraw:
+        JUMPDEST; STOP
+        """
+    )
+    disassembly = Disassembly(code)
+    assert disassembly.func_hashes == [selector]
+    assert "withdraw()" in disassembly.function_name_to_address
+    entry = disassembly.function_name_to_address["withdraw()"]
+    assert disassembly.address_to_function_name[entry] == "withdraw()"
+
+
+def test_unknown_selector_gets_placeholder_name():
+    code = asm(
+        """
+        DUP1; PUSH4 0xdeadbeef; EQ; PUSH @f; JUMPI; STOP
+      f:
+        JUMPDEST; STOP
+        """
+    )
+    disassembly = Disassembly(code)
+    assert any(n.startswith("_function_0xdeadbeef") for n in disassembly.function_name_to_address)
+
+
+@pytest.mark.skipif(
+    not os.path.isdir(reference_path("tests", "testdata", "inputs")),
+    reason="reference corpus not mounted",
+)
+def test_disassembles_real_solc_output():
+    """Every precompiled contract in the reference corpus decodes cleanly."""
+    inputs_dir = reference_path("tests", "testdata", "inputs")
+    count = 0
+    for name in sorted(os.listdir(inputs_dir)):
+        if not name.endswith(".sol.o"):
+            continue
+        code = open(os.path.join(inputs_dir, name)).read().strip()
+        disassembly = Disassembly(code)
+        assert len(disassembly.instruction_list) > 10, name
+        count += 1
+    assert count > 5
