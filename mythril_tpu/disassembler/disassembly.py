@@ -16,11 +16,15 @@ from mythril_tpu.support.signatures import SignatureDB
 
 log = logging.getLogger(__name__)
 
-# The dispatcher comparison site; entry PUSH may be 1-4 bytes wide.
+# The dispatcher comparison site.  The selector push is PUSH1..PUSH4:
+# solc's optimizer strips leading zero bytes from selectors (reference
+# handles this by zero-padding, disassembly.py:41,85).  The entry push
+# may be 1-4 bytes wide.
+_SELECTOR_PUSHES = ["PUSH1", "PUSH2", "PUSH3", "PUSH4"]
 _DISPATCHER_PATTERN = [
-    ["PUSH4"],
+    _SELECTOR_PUSHES,
     ["EQ"],
-    ["PUSH1", "PUSH2", "PUSH3", "PUSH4"],
+    _SELECTOR_PUSHES,
     ["JUMPI"],
 ]
 
@@ -49,7 +53,7 @@ class Disassembly:
             entry_instr = self.instruction_list[index + 2]
             assert selector_instr.argument is not None
             assert entry_instr.argument is not None
-            selector = "0x" + selector_instr.argument.hex()
+            selector = "0x" + selector_instr.argument.hex().rjust(8, "0")
             entry = int.from_bytes(entry_instr.argument, "big")
             matches = signature_db.get(selector)
             if matches:

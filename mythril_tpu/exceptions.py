@@ -1,0 +1,29 @@
+"""Framework-level exceptions (reference: mythril/exceptions.py)."""
+
+
+class MythrilBaseException(Exception):
+    """Base class for all framework errors."""
+
+
+class CompilerError(MythrilBaseException):
+    """Solidity compilation failed (or no compiler is available)."""
+
+
+class UnsatError(MythrilBaseException):
+    """No model exists for the queried constraints (or solver gave up)."""
+
+
+class NoContractFoundError(MythrilBaseException):
+    """Input file contained no contract."""
+
+
+class CriticalError(MythrilBaseException):
+    """Fatal user-facing error (bad input, bad flags, missing RPC...)."""
+
+
+class AddressNotFoundError(MythrilBaseException):
+    """On-chain address lookup failed."""
+
+
+class DetectorNotFoundError(CriticalError):
+    """Unknown detection-module name passed to the module loader."""

@@ -1,0 +1,135 @@
+"""Native components: CDCL SAT solver + fast keccak, built from C++ at
+first import (g++ is in the image; no prebuilt wheels are shipped).
+
+The compiled library is cached next to the sources; rebuilds happen only
+when the source is newer than the binary.
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+log = logging.getLogger(__name__)
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "csrc")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "_native.so")
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> None:
+    sources = [
+        os.path.join(_SRC_DIR, name)
+        for name in sorted(os.listdir(_SRC_DIR))
+        if name.endswith(".cpp")
+    ]
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _LIB_PATH,
+    ] + sources
+    log.info("building native library: %s", " ".join(cmd))
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        newest_src = max(
+            os.path.getmtime(os.path.join(_SRC_DIR, n))
+            for n in os.listdir(_SRC_DIR)
+            if n.endswith(".cpp")
+        )
+        if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < newest_src:
+            _build()
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.cdcl_new.restype = ctypes.c_void_p
+        lib.cdcl_free.argtypes = [ctypes.c_void_p]
+        lib.cdcl_new_var.argtypes = [ctypes.c_void_p]
+        lib.cdcl_new_var.restype = ctypes.c_int32
+        lib.cdcl_add_clause.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ]
+        lib.cdcl_add_clause.restype = ctypes.c_int32
+        lib.cdcl_solve.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_double,
+        ]
+        lib.cdcl_solve.restype = ctypes.c_int32
+        lib.cdcl_model_value.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.cdcl_model_value.restype = ctypes.c_int32
+        lib.cdcl_conflicts.argtypes = [ctypes.c_void_p]
+        lib.cdcl_conflicts.restype = ctypes.c_int64
+        lib.cdcl_num_clauses.argtypes = [ctypes.c_void_p]
+        lib.cdcl_num_clauses.restype = ctypes.c_int64
+        lib.keccak256_native.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+        ]
+        _lib = lib
+        return lib
+
+
+def keccak256(data: bytes) -> bytes:
+    lib = load()
+    out = ctypes.create_string_buffer(32)
+    lib.keccak256_native(data, len(data), out)
+    return out.raw
+
+
+class SatSolver:
+    """ctypes wrapper over the native CDCL instance.
+
+    Incremental: variables/clauses persist across ``solve`` calls;
+    per-query constraints are passed as assumptions.
+    """
+
+    SAT, UNSAT, UNKNOWN = 1, -1, 0
+
+    def __init__(self):
+        self._lib = load()
+        self._handle = self._lib.cdcl_new()
+        # var 1 is the constant-TRUE anchor allocated by the solver ctor
+        self.true_var = 1
+
+    def __del__(self):
+        try:
+            self._lib.cdcl_free(self._handle)
+        except Exception:
+            pass
+
+    def new_var(self) -> int:
+        return self._lib.cdcl_new_var(self._handle)
+
+    def add_clause(self, lits: Sequence[int]) -> None:
+        arr = (ctypes.c_int32 * len(lits))(*lits)
+        self._lib.cdcl_add_clause(self._handle, arr, len(lits))
+
+    def solve(
+        self,
+        assumptions: Sequence[int] = (),
+        conflict_budget: int = -1,
+        time_budget_s: float = 0.0,
+    ) -> int:
+        arr = (ctypes.c_int32 * len(assumptions))(*assumptions)
+        return self._lib.cdcl_solve(
+            self._handle, arr, len(assumptions), conflict_budget, time_budget_s
+        )
+
+    def model_value(self, variable: int) -> bool:
+        return self._lib.cdcl_model_value(self._handle, variable) > 0
+
+    def model(self, variables: Sequence[int]) -> List[bool]:
+        return [self.model_value(v) for v in variables]
+
+    @property
+    def conflicts(self) -> int:
+        return self._lib.cdcl_conflicts(self._handle)
+
+    @property
+    def num_clauses(self) -> int:
+        return self._lib.cdcl_num_clauses(self._handle)

@@ -1,0 +1,607 @@
+// Native CDCL SAT solver for mythril_tpu.
+//
+// The reference framework rides on Z3 (a native C++ SMT solver) for every
+// path-feasibility and exploit-concretization query; this build has no Z3,
+// so this file is the authoritative decision procedure the bit-blaster
+// targets.  Classic minisat-style architecture: two-literal watches, VSIDS
+// with a binary heap, phase saving, 1UIP clause learning with recursive
+// minimization, Luby restarts, activity-based learned-clause reduction,
+// and incremental solving under assumptions (each symbolic-execution
+// query activates a subset of the persistent clause pool, so learned
+// clauses are shared across the thousands of queries one contract
+// analysis issues).
+//
+// Exposed through a tiny C API consumed via ctypes (no pybind11 in the
+// image).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <vector>
+
+namespace {
+
+using std::vector;
+
+typedef int32_t Lit;   // DIMACS-style: +v / -v, v >= 1
+typedef int32_t Var;
+
+static inline int lit_index(Lit l) {  // 2v / 2v+1 encoding for watch lists
+  Var v = l > 0 ? l : -l;
+  return (v << 1) | (l < 0);
+}
+
+struct Clause {
+  float activity = 0.0f;
+  bool learned = false;
+  bool deleted = false;
+  vector<Lit> lits;
+};
+
+struct Watcher {
+  int clause;
+  Lit blocker;
+};
+
+class Solver {
+ public:
+  Solver() {
+    new_var();  // var 1 is the constant-true anchor used by the blaster
+    vector<Lit> unit{1};
+    add_clause(unit);
+  }
+
+  Var new_var() {
+    Var v = (Var)assigns_.size() ? (Var)(assigns_.size()) : 1;
+    // assigns_ is indexed by var; index 0 unused.
+    if (assigns_.empty()) assigns_.push_back(0);
+    assigns_.push_back(0);
+    level_.resize(assigns_.size(), 0);
+    reason_.resize(assigns_.size(), -1);
+    activity_.resize(assigns_.size(), 0.0);
+    polarity_.resize(assigns_.size(), 0);
+    seen_.resize(assigns_.size(), 0);
+    heap_pos_.resize(assigns_.size(), -1);
+    watches_.resize(assigns_.size() * 2 + 2);
+    heap_insert(v);
+    return v;
+  }
+
+  // Returns false if the database became trivially UNSAT.
+  bool add_clause(vector<Lit>& lits) {
+    if (!ok_) return false;
+    // Normalize: sort, dedupe, drop tautologies and false lits @ level 0.
+    std::sort(lits.begin(), lits.end(), [](Lit a, Lit b) {
+      return std::abs(a) != std::abs(b) ? std::abs(a) < std::abs(b) : a < b;
+    });
+    vector<Lit> out;
+    for (size_t i = 0; i < lits.size(); ++i) {
+      Lit l = lits[i];
+      if (i + 1 < lits.size() && lits[i + 1] == -l) return true;  // tautology
+      if (i > 0 && lits[i - 1] == l) continue;                    // duplicate
+      int v = value(l);
+      if (v == 1 && level_of(l) == 0) return true;   // already satisfied
+      if (v == -1 && level_of(l) == 0) continue;     // already false forever
+      out.push_back(l);
+    }
+    if (out.empty()) { ok_ = false; return false; }
+    if (out.size() == 1) {
+      if (value(out[0]) == -1) { ok_ = false; return false; }
+      if (value(out[0]) == 0) {
+        uncheckedEnqueue(out[0], -1);
+        if (propagate() != -1) { ok_ = false; return false; }
+      }
+      return true;
+    }
+    attach(out, false);
+    return true;
+  }
+
+  // 1 sat, -1 unsat, 0 unknown (budget exhausted)
+  int solve(const Lit* assumps, int n_assumps, int64_t conflict_budget,
+            double time_budget_s) {
+    conflict_core_.clear();
+    if (!ok_) return -1;
+    assumptions_.assign(assumps, assumps + n_assumps);
+    budget_conflicts_ = conflict_budget;
+    deadline_ = time_budget_s > 0 ? now() + time_budget_s : -1.0;
+    conflicts_this_call_ = 0;
+    model_.clear();
+    cancelUntil(0);
+
+    int restart = 0;
+    int status = 0;
+    while (status == 0) {
+      int64_t luby_len = 100 * luby(restart++);
+      status = search(luby_len);
+      if (budget_conflicts_ >= 0 && conflicts_this_call_ >= budget_conflicts_)
+        { if (status == 0) { cancelUntil(0); return 0; } }
+      if (deadline_ > 0 && now() > deadline_)
+        { if (status == 0) { cancelUntil(0); return 0; } }
+    }
+    if (status == 1) {
+      model_.assign(assigns_.begin(), assigns_.end());
+    }
+    cancelUntil(0);
+    return status;
+  }
+
+  int model_value(Var v) const {
+    if (v < 0 || (size_t)v >= model_.size()) return 0;
+    return model_[v];
+  }
+
+  int64_t conflicts() const { return total_conflicts_; }
+  int64_t num_clauses() const { return (int64_t)clauses_.size(); }
+  int core_size() const { return (int)conflict_core_.size(); }
+  const Lit* core() const { return conflict_core_.data(); }
+
+ private:
+  // ---- state ----
+  bool ok_ = true;
+  vector<Clause> clauses_;
+  vector<vector<Watcher>> watches_;   // indexed by lit_index
+  vector<int8_t> assigns_;            // var -> 0/1/-1
+  vector<int> level_;
+  vector<int> reason_;                // var -> clause idx or -1
+  vector<Lit> trail_;
+  vector<int> trail_lim_;
+  size_t qhead_ = 0;
+  vector<double> activity_;
+  double var_inc_ = 1.0;
+  double cla_inc_ = 1.0;
+  vector<int8_t> polarity_;
+  vector<int8_t> seen_;
+  vector<Var> heap_;
+  vector<int> heap_pos_;
+  vector<Lit> assumptions_;
+  vector<Lit> conflict_core_;
+  vector<int8_t> model_;
+  int64_t budget_conflicts_ = -1;
+  int64_t conflicts_this_call_ = 0;
+  int64_t total_conflicts_ = 0;
+  double deadline_ = -1.0;
+  int64_t max_learned_ = 8192;
+
+  static double now() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec + 1e-9 * ts.tv_nsec;
+  }
+
+  static int64_t luby(int x) {
+    // Canonical Luby sequence 1 1 2 1 1 2 4 ... (base 2)
+    int size = 1, seq = 0;
+    while (size < x + 1) { ++seq; size = 2 * size + 1; }
+    while (size - 1 != x) { size = (size - 1) >> 1; --seq; x = x % size; }
+    return (int64_t)1 << seq;
+  }
+
+  int value(Lit l) const {
+    int8_t a = assigns_[std::abs(l)];
+    return l > 0 ? a : -a;
+  }
+  int level_of(Lit l) const { return level_[std::abs(l)]; }
+  int decision_level() const { return (int)trail_lim_.size(); }
+
+  // ---- heap (max-heap on activity) ----
+  bool heap_less(Var a, Var b) const { return activity_[a] > activity_[b]; }
+  void heap_insert(Var v) {
+    if (heap_pos_[v] != -1) return;
+    heap_pos_[v] = (int)heap_.size();
+    heap_.push_back(v);
+    heap_up(heap_pos_[v]);
+  }
+  void heap_up(int i) {
+    Var x = heap_[i];
+    while (i > 0) {
+      int p = (i - 1) >> 1;
+      if (!heap_less(x, heap_[p])) break;
+      heap_[i] = heap_[p]; heap_pos_[heap_[i]] = i; i = p;
+    }
+    heap_[i] = x; heap_pos_[x] = i;
+  }
+  void heap_down(int i) {
+    Var x = heap_[i];
+    int n = (int)heap_.size();
+    while (true) {
+      int c = 2 * i + 1;
+      if (c >= n) break;
+      if (c + 1 < n && heap_less(heap_[c + 1], heap_[c])) ++c;
+      if (!heap_less(heap_[c], x)) break;
+      heap_[i] = heap_[c]; heap_pos_[heap_[i]] = i; i = c;
+    }
+    heap_[i] = x; heap_pos_[x] = i;
+  }
+  Var heap_pop() {
+    Var top = heap_[0];
+    heap_pos_[top] = -1;
+    heap_[0] = heap_.back();
+    heap_.pop_back();
+    if (!heap_.empty()) { heap_pos_[heap_[0]] = 0; heap_down(0); }
+    return top;
+  }
+
+  void var_bump(Var v) {
+    activity_[v] += var_inc_;
+    if (activity_[v] > 1e100) {
+      for (size_t i = 1; i < activity_.size(); ++i) activity_[i] *= 1e-100;
+      var_inc_ *= 1e-100;
+    }
+    if (heap_pos_[v] != -1) heap_up(heap_pos_[v]);
+  }
+  void var_decay() { var_inc_ /= 0.95; }
+
+  // ---- clause attachment ----
+  int attach(const vector<Lit>& lits, bool learned) {
+    int idx = (int)clauses_.size();
+    clauses_.push_back(Clause{(float)cla_inc_, learned, false, lits});
+    watches_[lit_index(-lits[0])].push_back({idx, lits[1]});
+    watches_[lit_index(-lits[1])].push_back({idx, lits[0]});
+    return idx;
+  }
+
+  void uncheckedEnqueue(Lit l, int reason_clause) {
+    Var v = std::abs(l);
+    assigns_[v] = l > 0 ? 1 : -1;
+    level_[v] = decision_level();
+    reason_[v] = reason_clause;
+    trail_.push_back(l);
+  }
+
+  // returns conflicting clause idx or -1
+  int propagate() {
+    while (qhead_ < trail_.size()) {
+      Lit p = trail_[qhead_++];
+      auto& ws = watches_[lit_index(p)];
+      size_t i = 0, j = 0;
+      while (i < ws.size()) {
+        Watcher w = ws[i];
+        if (value(w.blocker) == 1) { ws[j++] = ws[i++]; continue; }
+        Clause& c = clauses_[w.clause];
+        if (c.deleted) { ++i; continue; }
+        // ensure c.lits[1] is the false literal (-p)
+        if (c.lits[0] == -p) std::swap(c.lits[0], c.lits[1]);
+        Lit first = c.lits[0];
+        if (value(first) == 1) { ws[j++] = {w.clause, first}; ++i; continue; }
+        bool moved = false;
+        for (size_t k = 2; k < c.lits.size(); ++k) {
+          if (value(c.lits[k]) != -1) {
+            std::swap(c.lits[1], c.lits[k]);
+            watches_[lit_index(-c.lits[1])].push_back({w.clause, first});
+            moved = true;
+            break;
+          }
+        }
+        if (moved) { ++i; continue; }
+        if (value(first) == -1) {
+          // conflict: restore remaining watchers
+          while (i < ws.size()) ws[j++] = ws[i++];
+          ws.resize(j);
+          return w.clause;
+        }
+        uncheckedEnqueue(first, w.clause);
+        ws[j++] = {w.clause, first};
+        ++i;
+      }
+      ws.resize(j);
+    }
+    return -1;
+  }
+
+  void cancelUntil(int target_level) {
+    if (decision_level() <= target_level) return;
+    for (int i = (int)trail_.size() - 1; i >= trail_lim_[target_level]; --i) {
+      Var v = std::abs(trail_[i]);
+      polarity_[v] = assigns_[v] > 0 ? 1 : 0;
+      assigns_[v] = 0;
+      reason_[v] = -1;
+      heap_insert(v);
+    }
+    trail_.resize(trail_lim_[target_level]);
+    trail_lim_.resize(target_level);
+    qhead_ = trail_.size();
+  }
+
+  void cla_bump(int ci) {
+    Clause& c = clauses_[ci];
+    c.activity += (float)cla_inc_;
+    if (c.activity > 1e20f) {
+      for (auto& cl : clauses_) if (cl.learned) cl.activity *= 1e-20f;
+      cla_inc_ *= 1e-20;
+    }
+  }
+
+  // 1UIP learning; fills out_learnt, returns backtrack level
+  int analyze(int confl, vector<Lit>& out_learnt) {
+    out_learnt.clear();
+    out_learnt.push_back(0);  // placeholder for the asserting literal
+    int path_count = 0;
+    Lit p = 0;
+    int index = (int)trail_.size() - 1;
+    int c = confl;
+    do {
+      Clause& cl = clauses_[c];
+      if (cl.learned) cla_bump(c);
+      for (size_t k = (p == 0 ? 0 : 1); k < cl.lits.size(); ++k) {
+        Lit q = cl.lits[k];
+        Var v = std::abs(q);
+        if (!seen_[v] && level_[v] > 0) {
+          seen_[v] = 1;
+          var_bump(v);
+          if (level_[v] >= decision_level()) ++path_count;
+          else out_learnt.push_back(q);
+        }
+      }
+      while (!seen_[std::abs(trail_[index])]) --index;
+      p = trail_[index];
+      c = reason_[std::abs(p)];
+      seen_[std::abs(p)] = 0;
+      --path_count;
+      --index;
+      if (p != 0 && c == -1 && path_count > 0) {
+        // should not happen (decision var reached with paths left)
+        break;
+      }
+    } while (path_count > 0);
+    out_learnt[0] = -p;
+
+    // local minimization (conservative: drop lits whose reason clause is
+    // subsumed by the remaining learnt literals)
+    vector<Lit> to_clear(out_learnt);
+    vector<Lit> minimized;
+    minimized.push_back(out_learnt[0]);
+    for (size_t i = 1; i < out_learnt.size(); ++i) {
+      Var v = std::abs(out_learnt[i]);
+      int r = reason_[v];
+      bool redundant = false;
+      if (r != -1) {
+        redundant = true;
+        for (Lit q : clauses_[r].lits) {
+          Var qv = std::abs(q);
+          if (qv == v) continue;
+          if (!seen_[qv] && level_[qv] > 0) { redundant = false; break; }
+        }
+      }
+      if (!redundant) minimized.push_back(out_learnt[i]);
+    }
+    out_learnt.swap(minimized);
+    for (Lit q : to_clear) seen_[std::abs(q)] = 0;
+
+    if (out_learnt.size() == 1) return 0;
+    // find second-highest level
+    int max_i = 1;
+    for (size_t i = 2; i < out_learnt.size(); ++i)
+      if (level_of(out_learnt[i]) > level_of(out_learnt[max_i])) max_i = (int)i;
+    std::swap(out_learnt[1], out_learnt[max_i]);
+    return level_of(out_learnt[1]);
+  }
+
+  // UNSAT-under-assumptions core from a failing assumption literal.
+  void analyzeFinal(Lit p) {
+    conflict_core_.clear();
+    conflict_core_.push_back(p);
+    if (decision_level() == 0) return;
+    seen_[std::abs(p)] = 1;
+    for (int i = (int)trail_.size() - 1; i >= trail_lim_[0]; --i) {
+      Var v = std::abs(trail_[i]);
+      if (!seen_[v]) continue;
+      if (reason_[v] == -1) {
+        if (level_[v] > 0) conflict_core_.push_back(-trail_[i]);
+      } else {
+        for (Lit q : clauses_[reason_[v]].lits)
+          if (level_of(q) > 0) seen_[std::abs(q)] = 1;
+      }
+      seen_[v] = 0;
+    }
+    seen_[std::abs(p)] = 0;
+  }
+
+  void reduceDB() {
+    vector<int> learned_idx;
+    for (int i = 0; i < (int)clauses_.size(); ++i)
+      if (clauses_[i].learned && !clauses_[i].deleted &&
+          clauses_[i].lits.size() > 2)
+        learned_idx.push_back(i);
+    if ((int64_t)learned_idx.size() < max_learned_) return;
+    std::sort(learned_idx.begin(), learned_idx.end(), [&](int a, int b) {
+      return clauses_[a].activity < clauses_[b].activity;
+    });
+    vector<int8_t> locked(clauses_.size(), 0);
+    for (Lit l : trail_) {
+      int r = reason_[std::abs(l)];
+      if (r != -1) locked[r] = 1;
+    }
+    size_t kill = learned_idx.size() / 2;
+    for (size_t i = 0; i < kill; ++i) {
+      int ci = learned_idx[i];
+      if (locked[ci]) continue;
+      clauses_[ci].deleted = true;
+      clauses_[ci].lits.clear();
+      clauses_[ci].lits.shrink_to_fit();
+    }
+    // rebuild watches
+    for (auto& ws : watches_) ws.clear();
+    for (int i = 0; i < (int)clauses_.size(); ++i) {
+      Clause& c = clauses_[i];
+      if (c.deleted || c.lits.empty()) continue;
+      watches_[lit_index(-c.lits[0])].push_back({i, c.lits[1]});
+      watches_[lit_index(-c.lits[1])].push_back({i, c.lits[0]});
+    }
+    max_learned_ += max_learned_ / 10;
+  }
+
+  // returns 1 sat / -1 unsat / 0 keep going (restart or budget)
+  int search(int64_t conflicts_allowed) {
+    int64_t local_conflicts = 0;
+    vector<Lit> learnt;
+    while (true) {
+      int confl = propagate();
+      if (confl != -1) {
+        ++local_conflicts; ++conflicts_this_call_; ++total_conflicts_;
+        if (decision_level() == 0) { ok_ = false; return -1; }
+        if (decision_level() <= (int)assumptions_.size()) {
+          // Conflict with only assumption decisions on the trail: the
+          // assumption set is jointly UNSAT with the clause DB.  (Core
+          // extraction intentionally omitted — no consumer yet; see
+          // analyzeFinal for the per-literal path.)
+          conflict_core_.clear();
+          return -1;
+        }
+        int back_level = analyze(confl, learnt);
+        cancelUntil(std::max(back_level, 0));
+        if (learnt.size() == 1) {
+          if (value(learnt[0]) == 0) uncheckedEnqueue(learnt[0], -1);
+          else if (value(learnt[0]) == -1) {
+            if (decision_level() == 0) { ok_ = false; return -1; }
+            return -1;
+          }
+        } else {
+          int ci = attach(learnt, true);
+          uncheckedEnqueue(learnt[0], ci);
+        }
+        var_decay();
+        cla_inc_ *= 1.001;
+        if (conflicts_this_call_ % 4096 == 0) reduceDB();
+        if (budget_conflicts_ >= 0 && conflicts_this_call_ >= budget_conflicts_)
+          return 0;
+        if (deadline_ > 0 && (conflicts_this_call_ & 255) == 0 &&
+            now() > deadline_)
+          return 0;
+        if (local_conflicts >= conflicts_allowed) {
+          cancelUntil((int)assumptions_.size() <= decision_level()
+                          ? (int)assumptions_.size()
+                          : 0);
+          cancelUntil(0);
+          return 0;  // restart
+        }
+      } else {
+        // assumption decisions first
+        if (decision_level() < (int)assumptions_.size()) {
+          Lit a = assumptions_[decision_level()];
+          int v = value(a);
+          if (v == 1) {
+            trail_lim_.push_back((int)trail_.size());
+            // re-assert as pseudo-decision so level bookkeeping is stable:
+            // nothing to enqueue; continue to next level
+            continue;
+          }
+          if (v == -1) { analyzeFinal(-a); return -1; }
+          trail_lim_.push_back((int)trail_.size());
+          uncheckedEnqueue(a, -1);
+          continue;
+        }
+        // normal decision
+        Var next = 0;
+        while (!heap_.empty()) {
+          Var cand = heap_pop();
+          if (assigns_[cand] == 0) { next = cand; break; }
+        }
+        if (next == 0) return 1;  // all assigned: SAT
+        trail_lim_.push_back((int)trail_.size());
+        Lit decision = polarity_[next] ? next : -next;
+        uncheckedEnqueue(decision, -1);
+      }
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* cdcl_new() { return new Solver(); }
+void cdcl_free(void* s) { delete (Solver*)s; }
+int32_t cdcl_new_var(void* s) { return ((Solver*)s)->new_var(); }
+int32_t cdcl_add_clause(void* s, const int32_t* lits, int32_t n) {
+  vector<Lit> v(lits, lits + n);
+  return ((Solver*)s)->add_clause(v) ? 1 : 0;
+}
+int32_t cdcl_solve(void* s, const int32_t* assumps, int32_t n,
+                   int64_t conflict_budget, double time_budget_s) {
+  return ((Solver*)s)->solve(assumps, n, conflict_budget, time_budget_s);
+}
+int32_t cdcl_model_value(void* s, int32_t var) {
+  return ((Solver*)s)->model_value(var);
+}
+int64_t cdcl_conflicts(void* s) { return ((Solver*)s)->conflicts(); }
+int64_t cdcl_num_clauses(void* s) { return ((Solver*)s)->num_clauses(); }
+
+// ---------------------------------------------------------------------------
+// keccak-256 (Ethereum variant: original Keccak padding 0x01)
+// ---------------------------------------------------------------------------
+
+static const uint64_t KECCAK_RC[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808aULL,
+    0x8000000080008000ULL, 0x000000000000808bULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008aULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000aULL,
+    0x000000008000808bULL, 0x800000000000008bULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800aULL, 0x800000008000000aULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
+
+static inline uint64_t rotl64(uint64_t x, int s) {
+  return (x << s) | (x >> (64 - s));
+}
+
+static void keccak_f(uint64_t st[25]) {
+  // lanes indexed st[x + 5*y]
+  static const int rot[5][5] = {{0, 36, 3, 41, 18},
+                                {1, 44, 10, 45, 2},
+                                {62, 6, 43, 15, 61},
+                                {28, 55, 25, 21, 56},
+                                {27, 20, 39, 8, 14}};
+  for (int round = 0; round < 24; ++round) {
+    uint64_t c[5], d[5], b[25];
+    for (int x = 0; x < 5; ++x)
+      c[x] = st[x] ^ st[x + 5] ^ st[x + 10] ^ st[x + 15] ^ st[x + 20];
+    for (int x = 0; x < 5; ++x) {
+      d[x] = c[(x + 4) % 5] ^ rotl64(c[(x + 1) % 5], 1);
+      for (int y = 0; y < 5; ++y) st[x + 5 * y] ^= d[x];
+    }
+    for (int x = 0; x < 5; ++x)
+      for (int y = 0; y < 5; ++y)
+        b[y + 5 * ((2 * x + 3 * y) % 5)] = rotl64(st[x + 5 * y], rot[x][y]);
+    for (int x = 0; x < 5; ++x)
+      for (int y = 0; y < 5; ++y)
+        st[x + 5 * y] =
+            b[x + 5 * y] ^ ((~b[(x + 1) % 5 + 5 * y]) & b[(x + 2) % 5 + 5 * y]);
+    st[0] ^= KECCAK_RC[round];
+  }
+}
+
+void keccak256_native(const uint8_t* data, uint64_t len, uint8_t out[32]) {
+  const size_t rate = 136;
+  uint64_t st[25];
+  std::memset(st, 0, sizeof(st));
+  // absorb full blocks
+  while (len >= rate) {
+    for (size_t i = 0; i < rate / 8; ++i) {
+      uint64_t lane;
+      std::memcpy(&lane, data + 8 * i, 8);
+      st[i] ^= lane;  // little-endian host assumed (x86/ARM)
+    }
+    keccak_f(st);
+    data += rate;
+    len -= rate;
+  }
+  // last (partial) block with pad 0x01 ... 0x80
+  uint8_t block[136];
+  std::memset(block, 0, sizeof(block));
+  std::memcpy(block, data, len);
+  block[len] = 0x01;
+  block[rate - 1] |= 0x80;
+  for (size_t i = 0; i < rate / 8; ++i) {
+    uint64_t lane;
+    std::memcpy(&lane, block + 8 * i, 8);
+    st[i] ^= lane;
+  }
+  keccak_f(st);
+  std::memcpy(out, st, 32);
+}
+
+}  // extern "C"

@@ -1,0 +1,503 @@
+"""Bit-blasting: term DAG -> CNF over the incremental native SAT solver.
+
+The replacement for z3's internal rewriter+bit-blaster.  One
+:class:`BlastContext` owns one native CDCL instance and grows a single
+CNF pool for the whole analysis: every DAG node is translated once
+(cached by node id), every path-feasibility query is just an assumption
+set over already-blasted constraint literals, so learned clauses are
+shared across the thousands of queries a contract analysis issues —
+the CPU-side mirror of the batched-TPU design (see ops/batched_sat.py).
+
+Theory lowering done here:
+- arrays: store chains become mux chains at read sites; reads of a base
+  array are Ackermannized (fresh bit variables + congruence clauses);
+- uninterpreted functions (keccak modeling): Ackermann expansion over
+  all applications of the same function.
+
+Bit order convention: bits[0] is the LSB.  Literal 1 is constant TRUE
+(anchored by a unit clause inside the native solver).
+"""
+
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from mythril_tpu.native import SatSolver
+from mythril_tpu.smt import terms as T
+
+log = logging.getLogger(__name__)
+
+TRUE_LIT = 1
+FALSE_LIT = -1
+
+
+def _const_bits(value: int, width: int) -> List[int]:
+    return [TRUE_LIT if (value >> i) & 1 else FALSE_LIT for i in range(width)]
+
+
+class BlastContext:
+    def __init__(self):
+        self.solver = SatSolver()
+        self.bits_cache: Dict[int, List[int]] = {}
+        self.lit_cache: Dict[int, int] = {}
+        self.gate_cache: Dict[Tuple, int] = {}
+        self.var_bits: Dict[int, List[int]] = {}       # bv var node id -> bits
+        self.bool_var_lits: Dict[int, int] = {}        # bool var node id -> lit
+        self.array_reads: Dict[int, List[Tuple[T.Node, List[int]]]] = {}
+        self.uf_apps: Dict[int, List[Tuple[Tuple[T.Node, ...], List[int]]]] = {}
+        self.clause_count = 0
+
+    # ------------------------------------------------------------------
+    # gates
+    # ------------------------------------------------------------------
+
+    def _clause(self, lits: Sequence[int]) -> None:
+        self.solver.add_clause(lits)
+        self.clause_count += 1
+
+    def new_lit(self) -> int:
+        return self.solver.new_var()
+
+    def g_and(self, a: int, b: int) -> int:
+        if a == FALSE_LIT or b == FALSE_LIT or a == -b:
+            return FALSE_LIT
+        if a == TRUE_LIT:
+            return b
+        if b == TRUE_LIT or a == b:
+            return a
+        key = ("and", min(a, b), max(a, b))
+        lit = self.gate_cache.get(key)
+        if lit is None:
+            lit = self.new_lit()
+            self._clause([-lit, a])
+            self._clause([-lit, b])
+            self._clause([lit, -a, -b])
+            self.gate_cache[key] = lit
+        return lit
+
+    def g_or(self, a: int, b: int) -> int:
+        return -self.g_and(-a, -b)
+
+    def g_xor(self, a: int, b: int) -> int:
+        if a == TRUE_LIT:
+            return -b
+        if a == FALSE_LIT:
+            return b
+        if b == TRUE_LIT:
+            return -a
+        if b == FALSE_LIT:
+            return a
+        if a == b:
+            return FALSE_LIT
+        if a == -b:
+            return TRUE_LIT
+        # canonicalize on positive vars: xor(a,b) = xor(|a|,|b|) ^ signs
+        flip = (a < 0) != (b < 0)
+        va, vb = abs(a), abs(b)
+        if va > vb:
+            va, vb = vb, va
+        key = ("xor", va, vb)
+        lit = self.gate_cache.get(key)
+        if lit is None:
+            lit = self.new_lit()
+            self._clause([-lit, va, vb])
+            self._clause([-lit, -va, -vb])
+            self._clause([lit, -va, vb])
+            self._clause([lit, va, -vb])
+            self.gate_cache[key] = lit
+        return -lit if flip else lit
+
+    def g_mux(self, s: int, a: int, b: int) -> int:
+        """s ? a : b"""
+        if s == TRUE_LIT:
+            return a
+        if s == FALSE_LIT:
+            return b
+        if a == b:
+            return a
+        if a == TRUE_LIT and b == FALSE_LIT:
+            return s
+        if a == FALSE_LIT and b == TRUE_LIT:
+            return -s
+        key = ("mux", s, a, b)
+        lit = self.gate_cache.get(key)
+        if lit is None:
+            lit = self.new_lit()
+            self._clause([-s, -a, lit])
+            self._clause([-s, a, -lit])
+            self._clause([s, -b, lit])
+            self._clause([s, b, -lit])
+            if a != TRUE_LIT and a != FALSE_LIT and b != TRUE_LIT and b != FALSE_LIT:
+                self._clause([-a, -b, lit])   # redundant, aids propagation
+                self._clause([a, b, -lit])
+            self.gate_cache[key] = lit
+        return lit
+
+    def g_and_many(self, lits: Sequence[int]) -> int:
+        acc = TRUE_LIT
+        for lit in lits:
+            acc = self.g_and(acc, lit)
+        return acc
+
+    def g_or_many(self, lits: Sequence[int]) -> int:
+        acc = FALSE_LIT
+        for lit in lits:
+            acc = self.g_or(acc, lit)
+        return acc
+
+    # ------------------------------------------------------------------
+    # word-level circuits
+    # ------------------------------------------------------------------
+
+    def full_adder(self, x: int, y: int, cin: int) -> Tuple[int, int]:
+        t = self.g_xor(x, y)
+        total = self.g_xor(t, cin)
+        cout = self.g_or(self.g_and(x, y), self.g_and(t, cin))
+        return total, cout
+
+    def add_bits(
+        self, xs: List[int], ys: List[int], cin: int = FALSE_LIT
+    ) -> Tuple[List[int], int]:
+        out = []
+        carry = cin
+        for x, y in zip(xs, ys):
+            s, carry = self.full_adder(x, y, carry)
+            out.append(s)
+        return out, carry
+
+    def sub_bits(self, xs: List[int], ys: List[int]) -> Tuple[List[int], int]:
+        """xs - ys; carry-out == 1 iff xs >= ys (no borrow)."""
+        return self.add_bits(xs, [-y for y in ys], TRUE_LIT)
+
+    def neg_bits(self, xs: List[int]) -> List[int]:
+        out, _ = self.add_bits([-x for x in xs], _const_bits(0, len(xs)), TRUE_LIT)
+        return out
+
+    def eq_lit(self, xs: List[int], ys: List[int]) -> int:
+        return self.g_and_many([-self.g_xor(x, y) for x, y in zip(xs, ys)])
+
+    def ult_lit(self, xs: List[int], ys: List[int]) -> int:
+        _, carry = self.sub_bits(xs, ys)
+        return -carry
+
+    def ule_lit(self, xs: List[int], ys: List[int]) -> int:
+        return -self.ult_lit(ys, xs)
+
+    def slt_lit(self, xs: List[int], ys: List[int]) -> int:
+        sign_x, sign_y = xs[-1], ys[-1]
+        return self.g_mux(
+            self.g_xor(sign_x, sign_y), sign_x, self.ult_lit(xs, ys)
+        )
+
+    def mux_bits(self, s: int, xs: List[int], ys: List[int]) -> List[int]:
+        return [self.g_mux(s, x, y) for x, y in zip(xs, ys)]
+
+    def mul_bits(self, xs: List[int], ys: List[int]) -> List[int]:
+        width = len(xs)
+        acc = _const_bits(0, width)
+        for i, y in enumerate(ys):
+            if y == FALSE_LIT:
+                continue
+            partial = [FALSE_LIT] * i + [self.g_and(x, y) for x in xs[: width - i]]
+            acc, _ = self.add_bits(acc, partial)
+        return acc
+
+    def udivmod_bits(
+        self, xs: List[int], ys: List[int]
+    ) -> Tuple[List[int], List[int]]:
+        """Restoring division; (quotient, remainder) with SMT-LIB zero
+        semantics handled by the caller via a zero-divisor mux."""
+        width = len(xs)
+        # remainder runs one bit wider: after the shift-in it can reach
+        # 2*divisor-1 which needs w+1 bits when the divisor is large
+        ys_wide = ys + [FALSE_LIT]
+        remainder = _const_bits(0, width + 1)
+        quotient = [FALSE_LIT] * width
+        for i in range(width - 1, -1, -1):
+            remainder = [xs[i]] + remainder[:width]  # shift left, bring down bit
+            diff, no_borrow = self.sub_bits(remainder, ys_wide)
+            quotient[i] = no_borrow
+            remainder = self.mux_bits(no_borrow, diff, remainder)
+        return quotient, remainder[:width]
+
+    def shift_bits(self, xs: List[int], ys: List[int], mode: str) -> List[int]:
+        """Barrel shifter; mode in {'shl','lshr','ashr'}."""
+        width = len(xs)
+        fill = xs[-1] if mode == "ashr" else FALSE_LIT
+        stages = max(1, (width - 1).bit_length())
+        acc = list(xs)
+        for stage in range(stages):
+            amount = 1 << stage
+            s = ys[stage] if stage < len(ys) else FALSE_LIT
+            if s == FALSE_LIT:
+                continue
+            if mode == "shl":
+                shifted = [FALSE_LIT] * min(amount, width) + acc[: max(0, width - amount)]
+            else:
+                shifted = acc[amount:] + [fill] * min(amount, width)
+            acc = self.mux_bits(s, shifted, acc)
+        # any shift-amount bit >= stages forces the overflow fill
+        overflow = self.g_or_many(ys[stages:])
+        if overflow != FALSE_LIT:
+            acc = self.mux_bits(overflow, [fill] * width, acc)
+        return acc
+
+    # ------------------------------------------------------------------
+    # node -> bits translation
+    # ------------------------------------------------------------------
+
+    def blast_bits(self, node: T.Node) -> List[int]:
+        cached = self.bits_cache.get(node.id)
+        if cached is not None:
+            return cached
+        bits = self._blast_bits(node)
+        assert len(bits) == node.width, (node.op, node.width, len(bits))
+        self.bits_cache[node.id] = bits
+        return bits
+
+    def _blast_bits(self, n: T.Node) -> List[int]:
+        op = n.op
+        if op == "const":
+            return _const_bits(n.params[0], n.width)
+        if op == "var":
+            bits = [self.new_lit() for _ in range(n.width)]
+            self.var_bits[n.id] = bits
+            return bits
+        if op == "ite":
+            cond = self.blast_lit(n.args[0])
+            return self.mux_bits(
+                cond, self.blast_bits(n.args[1]), self.blast_bits(n.args[2])
+            )
+        if op == "select":
+            return self._blast_select(n)
+        if op == "apply":
+            return self._blast_apply(n)
+
+        if op in ("add", "sub", "mul", "udiv", "sdiv", "urem", "srem",
+                  "and", "or", "xor", "shl", "lshr", "ashr"):
+            xs = self.blast_bits(n.args[0])
+            ys = self.blast_bits(n.args[1])
+            if op == "add":
+                return self.add_bits(xs, ys)[0]
+            if op == "sub":
+                return self.sub_bits(xs, ys)[0]
+            if op == "mul":
+                # prefer the operand with fewer symbolic bits as multiplier
+                def sym_count(bs):
+                    return sum(1 for b in bs if b not in (TRUE_LIT, FALSE_LIT))
+                if sym_count(xs) < sym_count(ys):
+                    xs, ys = ys, xs
+                return self.mul_bits(xs, ys)
+            if op == "and":
+                return [self.g_and(x, y) for x, y in zip(xs, ys)]
+            if op == "or":
+                return [self.g_or(x, y) for x, y in zip(xs, ys)]
+            if op == "xor":
+                return [self.g_xor(x, y) for x, y in zip(xs, ys)]
+            if op in ("shl", "lshr", "ashr"):
+                return self.shift_bits(xs, ys, op)
+            if op in ("udiv", "urem"):
+                q, r = self.udivmod_bits(xs, ys)
+                is_zero = self.eq_lit(ys, _const_bits(0, len(ys)))
+                if op == "udiv":  # x/0 = all-ones (SMT-LIB)
+                    return self.mux_bits(is_zero, _const_bits((1 << len(xs)) - 1, len(xs)), q)
+                return self.mux_bits(is_zero, xs, r)  # x%0 = x
+            # signed div/rem via abs / unsigned / sign fixup
+            sign_x, sign_y = xs[-1], ys[-1]
+            ax = self.mux_bits(sign_x, self.neg_bits(xs), xs)
+            ay = self.mux_bits(sign_y, self.neg_bits(ys), ys)
+            q, r = self.udivmod_bits(ax, ay)
+            is_zero = self.eq_lit(ys, _const_bits(0, len(ys)))
+            if op == "sdiv":
+                signed_q = self.mux_bits(self.g_xor(sign_x, sign_y), self.neg_bits(q), q)
+                # SMT-LIB bvsdiv x/0: 1 if x<0 else -1
+                zero_case = self.mux_bits(
+                    sign_x,
+                    _const_bits(1, len(xs)),
+                    _const_bits((1 << len(xs)) - 1, len(xs)),
+                )
+                return self.mux_bits(is_zero, zero_case, signed_q)
+            signed_r = self.mux_bits(sign_x, self.neg_bits(r), r)
+            return self.mux_bits(is_zero, xs, signed_r)
+
+        if op == "not":
+            return [-b for b in self.blast_bits(n.args[0])]
+        if op == "concat":
+            bits: List[int] = []
+            for part in reversed(n.args):  # last arg is least significant
+                bits.extend(self.blast_bits(part))
+            return bits
+        if op == "extract":
+            high, low = n.params
+            return self.blast_bits(n.args[0])[low : high + 1]
+        if op == "zext":
+            return self.blast_bits(n.args[0]) + [FALSE_LIT] * n.params[0]
+        if op == "sext":
+            inner = self.blast_bits(n.args[0])
+            return inner + [inner[-1]] * n.params[0]
+        raise NotImplementedError(f"blast_bits: {op}")
+
+    def _blast_select(self, n: T.Node) -> List[int]:
+        arr, idx = n.args
+        idx_bits = self.blast_bits(idx)
+        # collect the store chain (outermost first)
+        chain: List[Tuple[T.Node, T.Node]] = []
+        base = arr
+        while base.op == "store":
+            chain.append((base.args[1], base.args[2]))
+            base = base.args[0]
+        if base.op == "constarr":
+            result = self.blast_bits(base.args[0])
+        elif base.op == "avar":
+            result = self._base_array_read(base, idx, idx_bits)
+        else:
+            raise NotImplementedError(f"select base {base.op}")
+        for sidx, sval in reversed(chain):
+            hit = self.eq_lit(idx_bits, self.blast_bits(sidx))
+            result = self.mux_bits(hit, self.blast_bits(sval), result)
+        return result
+
+    def _base_array_read(
+        self, base: T.Node, idx: T.Node, idx_bits: List[int]
+    ) -> List[int]:
+        reads = self.array_reads.setdefault(base.id, [])
+        for prev_idx, prev_bits in reads:
+            if prev_idx is idx:
+                return prev_bits
+        rng = base.params[2]
+        bits = [self.new_lit() for _ in range(rng)]
+        for prev_idx, prev_bits in reads:
+            same = self.eq_lit(idx_bits, self.blast_bits(prev_idx))
+            for a, b in zip(bits, prev_bits):
+                self._clause([-same, -a, b])
+                self._clause([-same, a, -b])
+        reads.append((idx, bits))
+        return bits
+
+    def _blast_apply(self, n: T.Node) -> List[int]:
+        func = n.args[0]
+        args = n.args[1:]
+        apps = self.uf_apps.setdefault(func.id, [])
+        for prev_args, prev_bits in apps:
+            if all(a is b for a, b in zip(prev_args, args)):
+                return prev_bits
+        ret_width = func.params[2]
+        bits = [self.new_lit() for _ in range(ret_width)]
+        arg_bits = [self.blast_bits(a) for a in args]
+        for prev_args, prev_bits in apps:
+            same = self.g_and_many(
+                [
+                    self.eq_lit(ab, self.blast_bits(pa))
+                    for ab, pa in zip(arg_bits, prev_args)
+                ]
+            )
+            for a, b in zip(bits, prev_bits):
+                self._clause([-same, -a, b])
+                self._clause([-same, a, -b])
+        apps.append((args, bits))
+        return bits
+
+    # ------------------------------------------------------------------
+    # bool nodes -> single literal
+    # ------------------------------------------------------------------
+
+    def blast_lit(self, node: T.Node) -> int:
+        cached = self.lit_cache.get(node.id)
+        if cached is not None:
+            return cached
+        lit = self._blast_lit(node)
+        self.lit_cache[node.id] = lit
+        return lit
+
+    def _blast_lit(self, n: T.Node) -> int:
+        op = n.op
+        if op == "bconst":
+            return TRUE_LIT if n.params[0] else FALSE_LIT
+        if op == "bvar":
+            lit = self.new_lit()
+            self.bool_var_lits[n.id] = lit
+            return lit
+        if op == "band":
+            return self.g_and(self.blast_lit(n.args[0]), self.blast_lit(n.args[1]))
+        if op == "bor":
+            return self.g_or(self.blast_lit(n.args[0]), self.blast_lit(n.args[1]))
+        if op == "bnot":
+            return -self.blast_lit(n.args[0])
+        if op == "bxor":
+            return self.g_xor(self.blast_lit(n.args[0]), self.blast_lit(n.args[1]))
+        if op == "eq":
+            return self.eq_lit(self.blast_bits(n.args[0]), self.blast_bits(n.args[1]))
+        if op == "ult":
+            return self.ult_lit(self.blast_bits(n.args[0]), self.blast_bits(n.args[1]))
+        if op == "ule":
+            return self.ule_lit(self.blast_bits(n.args[0]), self.blast_bits(n.args[1]))
+        if op == "slt":
+            return self.slt_lit(self.blast_bits(n.args[0]), self.blast_bits(n.args[1]))
+        if op == "sle":
+            return -self.slt_lit(
+                self.blast_bits(n.args[1]), self.blast_bits(n.args[0])
+            )
+        if op == "ite":  # bool-sorted ite
+            cond = self.blast_lit(n.args[0])
+            return self.g_mux(
+                cond, self.blast_lit(n.args[1]), self.blast_lit(n.args[2])
+            )
+        raise NotImplementedError(f"blast_lit: {op}")
+
+    # ------------------------------------------------------------------
+    # solving + model extraction
+    # ------------------------------------------------------------------
+
+    def check(
+        self,
+        constraints: Sequence[T.Node],
+        timeout_s: float = 0.0,
+        conflict_budget: int = -1,
+    ) -> Tuple[int, Optional[T.EvalEnv]]:
+        """Returns (status, env) with status in SatSolver.{SAT,UNSAT,UNKNOWN}."""
+        assumptions = []
+        for c in constraints:
+            if c is T.FALSE:
+                return SatSolver.UNSAT, None
+            if c is T.TRUE:
+                continue
+            assumptions.append(self.blast_lit(c))
+        status = self.solver.solve(assumptions, conflict_budget, timeout_s)
+        if status != SatSolver.SAT:
+            return status, None
+        return status, self._extract_model()
+
+    def _bits_value(self, bits: List[int]) -> int:
+        value = 0
+        for i, lit in enumerate(bits):
+            if lit == TRUE_LIT:
+                bit = 1
+            elif lit == FALSE_LIT:
+                bit = 0
+            else:
+                assigned = self.solver.model_value(abs(lit))
+                bit = int(assigned if lit > 0 else not assigned)
+            value |= bit << i
+        return value
+
+    def _extract_model(self) -> T.EvalEnv:
+        env = T.EvalEnv()
+        for node_id, bits in self.var_bits.items():
+            env.variables[node_id] = self._bits_value(bits)
+        for node_id, lit in self.bool_var_lits.items():
+            env.variables[node_id] = (
+                self.solver.model_value(abs(lit)) if lit > 0
+                else not self.solver.model_value(abs(lit))
+            )
+        # array reads & UF apps: index/arg expressions may themselves contain
+        # reads; iterate to a (cheap) fixed point
+        for _ in range(3):
+            for base_id, reads in self.array_reads.items():
+                table = env.arrays.setdefault(base_id, {})
+                for idx_node, bits in reads:
+                    idx_val = T.evaluate(idx_node, env)
+                    table[idx_val] = self._bits_value(bits)
+            for func_id, apps in self.uf_apps.items():
+                for args, bits in apps:
+                    arg_vals = tuple(T.evaluate(a, env) for a in args)
+                    env.ufs[(func_id, arg_vals)] = self._bits_value(bits)
+        return env

@@ -1,0 +1,251 @@
+"""Solver facade: the API the symbolic VM and analysis layer talk to.
+
+Reference counterpart: mythril/laser/smt/solver/ (Solver/Optimize wrap a
+z3 instance; IndependenceSolver partitions constraints).  Here:
+
+- every Solver shares one process-wide :class:`BlastContext`, i.e. a
+  single incremental native CDCL instance holding the CNF pool for the
+  whole analysis; a ``check`` is an assumption query against that pool
+  (learned clauses persist across queries and transfer between states —
+  the role Z3's per-query state could never play in the reference);
+- ``Optimize`` implements minimize/maximize by SAT-guided binary search
+  over ULE bounds (the reference used z3's Optimize for calldata /
+  callvalue minimization, analysis/solver.py:202);
+- when a batch of independent queries is available the TPU batch path in
+  ``ops/batched_sat.py`` is tried first (see smt/solver/batch.py).
+"""
+
+import logging
+import time
+from functools import wraps
+from typing import List, Optional, Sequence
+
+from mythril_tpu.native import SatSolver
+from mythril_tpu.smt import terms as T
+from mythril_tpu.smt.bitblast import BlastContext
+from mythril_tpu.smt.model import Model
+
+log = logging.getLogger(__name__)
+
+
+class CheckResult:
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self) -> str:
+        return self.name
+
+
+sat = CheckResult("sat")
+unsat = CheckResult("unsat")
+unknown = CheckResult("unknown")
+
+
+# ---------------------------------------------------------------------------
+# Statistics (reference: laser/smt/solver/solver_statistics.py)
+# ---------------------------------------------------------------------------
+
+
+class SolverStatistics:
+    """Process-wide query counter/timer singleton."""
+
+    _instance: Optional["SolverStatistics"] = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance.enabled = False
+            cls._instance.query_count = 0
+            cls._instance.solver_time = 0.0
+        return cls._instance
+
+    def reset(self) -> None:
+        self.query_count = 0
+        self.solver_time = 0.0
+
+    def __repr__(self) -> str:
+        return (
+            f"Solver statistics: query count: {self.query_count}, "
+            f"solver time: {self.solver_time}"
+        )
+
+
+def stat_smt_query(func):
+    """Times a solver query when statistics collection is enabled."""
+
+    @wraps(func)
+    def wrapper(*args, **kwargs):
+        stats = SolverStatistics()
+        if not stats.enabled:
+            return func(*args, **kwargs)
+        stats.query_count += 1
+        begin = time.time()
+        try:
+            return func(*args, **kwargs)
+        finally:
+            stats.solver_time += time.time() - begin
+
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# Shared blast context
+# ---------------------------------------------------------------------------
+
+_context: Optional[BlastContext] = None
+
+
+def get_blast_context() -> BlastContext:
+    global _context
+    if _context is None:
+        _context = BlastContext()
+    return _context
+
+
+def reset_blast_context() -> None:
+    """Drop the CNF pool and the term-interner table (used between
+    unrelated analyses and in tests).  Callers must not retain Expression
+    wrappers across a reset — the interner forgets old nodes, so stale
+    wrappers would no longer compare identical to newly built terms."""
+    global _context
+    _context = None
+    T.reset_interner()
+
+
+class BaseSolver:
+    def __init__(self):
+        self.constraints: List = []  # Bool wrappers or raw nodes
+        self.timeout_ms = 100000
+        self.conflict_budget = -1
+
+    def set_timeout(self, timeout_ms: int) -> None:
+        self.timeout_ms = timeout_ms
+
+    def add(self, *constraints) -> None:
+        for c in constraints:
+            if isinstance(c, (list, tuple)):
+                self.constraints.extend(c)
+            else:
+                self.constraints.append(c)
+
+    append = add
+
+    def _nodes(self, extra=()) -> List[T.Node]:
+        nodes = []
+        for c in list(self.constraints) + list(extra):
+            nodes.append(c.raw if hasattr(c, "raw") else c)
+        return nodes
+
+    @stat_smt_query
+    def _check_nodes(self, nodes: Sequence[T.Node]):
+        ctx = get_blast_context()
+        status, env = ctx.check(
+            nodes,
+            timeout_s=self.timeout_ms / 1000.0,
+            conflict_budget=self.conflict_budget,
+        )
+        if status == SatSolver.SAT:
+            return sat, env
+        if status == SatSolver.UNSAT:
+            return unsat, None
+        return unknown, None
+
+
+class Solver(BaseSolver):
+    def __init__(self):
+        super().__init__()
+        self._env: Optional[T.EvalEnv] = None
+
+    def check(self, *extra) -> CheckResult:
+        result, env = self._check_nodes(self._nodes(extra))
+        self._env = env
+        return result
+
+    def model(self) -> Model:
+        return Model([self._env]) if self._env is not None else Model()
+
+    def reset(self) -> None:
+        self.constraints = []
+        self._env = None
+
+    pop = reset
+
+
+class Optimize(BaseSolver):
+    """minimize/maximize via incremental bound search (max ~24 probes)."""
+
+    MAX_PROBES = 24
+
+    def __init__(self):
+        super().__init__()
+        self._minimize: List[T.Node] = []
+        self._maximize: List[T.Node] = []
+        self._env: Optional[T.EvalEnv] = None
+
+    def minimize(self, element) -> None:
+        self._minimize.append(element.raw if hasattr(element, "raw") else element)
+
+    def maximize(self, element) -> None:
+        self._maximize.append(element.raw if hasattr(element, "raw") else element)
+
+    def check(self, *extra) -> CheckResult:
+        base = self._nodes(extra)
+        result, env = self._check_nodes(base)
+        if result is not sat:
+            return result
+        pinned: List[T.Node] = []
+        for objective, direction in [(o, "min") for o in self._minimize] + [
+            (o, "max") for o in self._maximize
+        ]:
+            env = self._tighten(base, pinned, objective, direction, env)
+            best = T.evaluate(objective, env)
+            if direction == "min":
+                pinned.append(T.ule(objective, T.const(best, objective.width)))
+            else:
+                pinned.append(T.ule(T.const(best, objective.width), objective))
+        self._env = env
+        return sat
+
+    def _tighten(self, base, pinned, objective, direction, env):
+        width = objective.width
+        best_env = env
+        best = T.evaluate(objective, env)
+        lo, hi = 0, best
+        if direction == "max":
+            lo, hi = best, T.mask(width)
+        probes = 0
+        while lo < hi and probes < self.MAX_PROBES:
+            probes += 1
+            mid = (lo + hi) // 2
+            if direction == "min":
+                bound = T.ule(objective, T.const(mid, width))
+            else:
+                bound = T.ule(T.const(mid + 1, width), objective)
+            result, candidate = self._check_nodes(base + pinned + [bound])
+            if result is sat:
+                value = T.evaluate(objective, candidate)
+                best_env = candidate
+                if direction == "min":
+                    hi = min(value, mid)
+                else:
+                    lo = max(value, mid + 1)
+            else:
+                # unsat or unknown: the bound is (assumed) too tight
+                if direction == "min":
+                    lo = mid + 1
+                else:
+                    hi = mid
+        return best_env
+
+    def model(self) -> Model:
+        return Model([self._env]) if self._env is not None else Model()
+
+
+class IndependenceSolver(Solver):
+    """API-compatible stand-in for the reference's constraint-partitioning
+    solver (laser/smt/solver/independence_solver.py).
+
+    Partitioning buys nothing for an assumption-based incremental CDCL
+    (the solver only touches clauses reachable from the assumptions), so
+    this delegates to :class:`Solver`; kept for interface parity.
+    """

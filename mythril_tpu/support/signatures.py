@@ -51,6 +51,15 @@ _BUILTIN_SIGNATURES = [
     "batchTransfer(address[],uint256)",
 ]
 
+_builtin_cache: Optional[dict] = None
+
+
+def _builtin_table() -> dict:
+    global _builtin_cache
+    if _builtin_cache is None:
+        _builtin_cache = {selector_of(s): [s] for s in _BUILTIN_SIGNATURES}
+    return _builtin_cache
+
 
 class SignatureDB:
     """Selector->signature store; safe to use without any database file."""
@@ -59,7 +68,7 @@ class SignatureDB:
         # Online lookup is accepted for CLI compat but is a no-op: this
         # environment has no network egress.
         self.enable_online_lookup = enable_online_lookup
-        self._mem = {selector_of(s): [s] for s in _BUILTIN_SIGNATURES}
+        self._mem = {k: list(v) for k, v in _builtin_table().items()}
         self.path = path or os.path.join(
             os.path.expanduser("~"), ".mythril_tpu", "signatures.db"
         )
@@ -75,7 +84,7 @@ class SignatureDB:
                     " (byte_sig VARCHAR(10), text_sig VARCHAR(255),"
                     "  PRIMARY KEY (byte_sig, text_sig))"
                 )
-            except OSError:
+            except (OSError, sqlite3.Error):
                 return None
         return self._conn
 
@@ -85,11 +94,14 @@ class SignatureDB:
             self._mem[byte_sig].append(text_sig)
         db = self._db()
         if db is not None:
-            with db:
-                db.execute(
-                    "INSERT OR IGNORE INTO signatures VALUES (?, ?)",
-                    (byte_sig, text_sig),
-                )
+            try:
+                with db:
+                    db.execute(
+                        "INSERT OR IGNORE INTO signatures VALUES (?, ?)",
+                        (byte_sig, text_sig),
+                    )
+            except sqlite3.Error:
+                pass
 
     def get(self, byte_sig: str) -> List[str]:
         if not byte_sig.startswith("0x"):
@@ -97,9 +109,13 @@ class SignatureDB:
         found = list(self._mem.get(byte_sig, []))
         db = self._db()
         if db is not None:
-            rows = db.execute(
-                "SELECT text_sig FROM signatures WHERE byte_sig = ?", (byte_sig,)
-            ).fetchall()
+            try:
+                rows = db.execute(
+                    "SELECT text_sig FROM signatures WHERE byte_sig = ?",
+                    (byte_sig,),
+                ).fetchall()
+            except sqlite3.Error:
+                rows = []
             for (text_sig,) in rows:
                 if text_sig not in found:
                     found.append(text_sig)

@@ -1,0 +1,220 @@
+"""SMT layer tests: term DAG, bit-blaster, solvers.
+
+The reference trusts z3 and ships no solver-correctness tests; we cannot
+(SURVEY.md §4), so the core here is differential testing of the blasted
+CNF against the concrete term evaluator.
+"""
+
+import random
+
+import pytest
+
+from mythril_tpu.native import SatSolver
+from mythril_tpu.smt import (
+    And, Array, BitVec, Bool, BVAddNoOverflow, BVMulNoOverflow,
+    BVSubNoUnderflow, Concat, Extract, Function, If, K, Not, Optimize, Or,
+    Solver, UGT, ULT, symbol_factory,
+)
+from mythril_tpu.smt import solver as solver_mod
+from mythril_tpu.smt import terms as T
+from mythril_tpu.smt.bitblast import BlastContext
+
+
+def _random_expr(rng, vars_, depth, width):
+    if depth == 0 or rng.random() < 0.25:
+        if rng.random() < 0.5:
+            return rng.choice(vars_)
+        return T.const(rng.getrandbits(width), width)
+    op = rng.choice(
+        ["add", "sub", "mul", "udiv", "sdiv", "urem", "srem", "and", "or",
+         "xor", "not", "shl", "lshr", "ashr", "ite", "ext"]
+    )
+    a = _random_expr(rng, vars_, depth - 1, width)
+    b = _random_expr(rng, vars_, depth - 1, width)
+    if op == "not":
+        return T.bv_not(a)
+    if op == "ite":
+        cond = _random_pred(rng, vars_, depth - 1, width)
+        return T.ite(cond, a, b)
+    if op == "ext":
+        lo = rng.randint(0, width - 1)
+        hi = rng.randint(lo, width - 1)
+        return T.zext(width - (hi - lo + 1), T.extract(hi, lo, a))
+    name = {"and": "bv_and", "or": "bv_or", "xor": "bv_xor"}.get(op, op)
+    return getattr(T, name)(a, b)
+
+
+def _random_pred(rng, vars_, depth, width):
+    op = rng.choice(["eq", "ult", "ule", "slt", "sle"])
+    return getattr(T, op)(
+        _random_expr(rng, vars_, depth, width),
+        _random_expr(rng, vars_, depth, width),
+    )
+
+
+def test_blaster_differential_vs_evaluator():
+    rng = random.Random(1234)
+    for trial in range(40):
+        width = rng.choice([4, 8])
+        vars_ = [T.var(f"dv{trial}_{i}", width) for i in range(3)]
+        assignment = {v.id: rng.getrandbits(width) for v in vars_}
+        env = T.EvalEnv(dict(assignment))
+        exprs = [_random_expr(rng, vars_, 3, width) for _ in range(3)]
+        constraints = [T.eq(v, T.const(assignment[v.id], width)) for v in vars_]
+        for e in exprs:
+            constraints.append(T.eq(e, T.const(T.evaluate(e, env), width)))
+        ctx = BlastContext()
+        status, _ = ctx.check(constraints)
+        assert status == SatSolver.SAT
+        val = T.evaluate(exprs[0], env)
+        bad = T.eq(exprs[0], T.const((val + 1) % (1 << width), width))
+        status, _ = ctx.check(constraints + [bad])
+        assert status == SatSolver.UNSAT
+
+
+def test_models_satisfy_constraints():
+    rng = random.Random(99)
+    for trial in range(20):
+        vars_ = [T.var(f"ms{trial}_{i}", 8) for i in range(3)]
+        constraints = [_random_pred(rng, vars_, 2, 8) for _ in range(4)]
+        ctx = BlastContext()
+        status, env = ctx.check(constraints, timeout_s=5.0)
+        if status == SatSolver.SAT:
+            for c in constraints:
+                assert T.evaluate(c, env) is True or T.evaluate(c, env) == True
+
+
+def test_array_ackermann_congruence():
+    arr = T.avar("Ack", 8, 8)
+    i1, i2 = T.var("ai1", 8), T.var("ai2", 8)
+    r1, r2 = T.select(arr, i1), T.select(arr, i2)
+    ctx = BlastContext()
+    status, _ = ctx.check([T.eq(i1, i2), T.bnot(T.eq(r1, r2))])
+    assert status == SatSolver.UNSAT
+    status, _ = ctx.check([T.bnot(T.eq(i1, i2)), T.bnot(T.eq(r1, r2))])
+    assert status == SatSolver.SAT
+
+
+def test_store_select_chain():
+    arr = T.avar("SS", 8, 8)
+    idx = T.var("ssidx", 8)
+    stored = T.store(arr, T.const(5, 8), T.const(42, 8))
+    read = T.select(stored, idx)
+    ctx = BlastContext()
+    status, _ = ctx.check([T.eq(idx, T.const(5, 8)), T.eq(read, T.const(42, 8))])
+    assert status == SatSolver.SAT
+    status, _ = ctx.check(
+        [T.eq(idx, T.const(5, 8)), T.bnot(T.eq(read, T.const(42, 8)))]
+    )
+    assert status == SatSolver.UNSAT
+
+
+def test_uf_congruence():
+    f = T.uf("ufh", (8,), 8)
+    x, y = T.var("ufx", 8), T.var("ufy", 8)
+    fx, fy = T.apply_uf(f, [x]), T.apply_uf(f, [y])
+    ctx = BlastContext()
+    status, _ = ctx.check([T.eq(x, y), T.bnot(T.eq(fx, fy))])
+    assert status == SatSolver.UNSAT
+
+
+def test_256bit_arithmetic():
+    x = T.var("bb_x256", 256)
+    ctx = BlastContext()
+    status, env = ctx.check([T.eq(T.add(x, T.const(1, 256)), T.const(0, 256))])
+    assert status == SatSolver.SAT
+    assert env.variables[x.id] == (1 << 256) - 1
+
+
+# ---------------------------------------------------------------------------
+# wrapper API
+# ---------------------------------------------------------------------------
+
+
+def test_wrapper_operators_fold_concrete():
+    a = symbol_factory.BitVecVal(10, 256)
+    b = symbol_factory.BitVecVal(32, 256)
+    assert (a + b).value == 42
+    assert (b - a).value == 22
+    assert (a * b).value == 320
+    assert (b / a).value == 3
+    assert (b % a).value == 2
+    assert (a < b).is_true  # signed
+    assert ULT(a, b).is_true
+    assert (a == 10).is_true
+    assert Extract(7, 0, Concat(a, b)).value == 32
+    assert If(a < b, a, b).value == 10
+
+
+def test_wrapper_annotations_propagate():
+    a = symbol_factory.BitVecSym("ann_a", 256)
+    a.annotate("taint")
+    b = symbol_factory.BitVecVal(5, 256)
+    assert "taint" in (a + b).annotations
+    assert "taint" in (a * 3).annotations
+    assert "taint" in (a == 5).annotations
+    assert "taint" in If(a == 5, a, b).annotations
+
+
+def test_solver_facade():
+    s = Solver()
+    x = symbol_factory.BitVecSym("sf_x", 16)
+    s.add(UGT(x, symbol_factory.BitVecVal(100, 16)))
+    s.add(ULT(x, symbol_factory.BitVecVal(103, 16)))
+    assert s.check() is solver_mod.sat
+    value = s.model().eval(x, model_completion=True).as_long()
+    assert value in (101, 102)
+    s.add(x == 55)
+    assert s.check() is solver_mod.unsat
+
+
+def test_optimize_minimize():
+    opt = Optimize()
+    x = symbol_factory.BitVecSym("om_x", 16)
+    opt.add(UGT(x, symbol_factory.BitVecVal(57, 16)))
+    opt.minimize(x)
+    assert opt.check() is solver_mod.sat
+    assert opt.model().eval(x).as_long() == 58
+
+
+def test_optimize_maximize():
+    opt = Optimize()
+    x = symbol_factory.BitVecSym("ox_x", 8)
+    opt.add(ULT(x, symbol_factory.BitVecVal(57, 8)))
+    opt.maximize(x)
+    assert opt.check() is solver_mod.sat
+    assert opt.model().eval(x).as_long() == 56
+
+
+def test_overflow_predicates():
+    big = symbol_factory.BitVecVal(2**255, 256)
+    one = symbol_factory.BitVecVal(1, 256)
+    assert BVAddNoOverflow(big, big, False).is_false
+    assert BVAddNoOverflow(one, one, False).is_true
+    assert BVMulNoOverflow(big, 2, False).is_false
+    assert BVMulNoOverflow(one, 2, False).is_true
+    assert BVSubNoUnderflow(one, big, False).is_false
+    assert BVSubNoUnderflow(big, one, False).is_true
+    # symbolic: x*2 overflows iff x >= 2^255
+    x = symbol_factory.BitVecSym("ovf_x", 256)
+    s = Solver()
+    s.add(Not(BVMulNoOverflow(x, 2, False)))
+    s.add(ULT(x, symbol_factory.BitVecVal(2**255, 256)))
+    assert s.check() is solver_mod.unsat
+
+
+def test_array_wrapper():
+    storage = Array("test_storage_arr", 256, 256)
+    key = symbol_factory.BitVecVal(1, 256)
+    storage[key] = symbol_factory.BitVecVal(99, 256)
+    assert storage[key].value == 99
+    k_arr = K(256, 256, 0)
+    assert k_arr[symbol_factory.BitVecVal(123, 256)].value == 0
+
+
+def test_function_wrapper():
+    f = Function("keccak_test_fn", 256, 256)
+    x = symbol_factory.BitVecSym("fn_x", 256)
+    fx = f(x)
+    assert fx.func_name == "keccak_test_fn"
+    assert fx.size == 256
