@@ -69,6 +69,10 @@ class Disassembly:
     def get_easm(self) -> str:
         return asm.instruction_list_to_easm(self.instruction_list)
 
+    def assign_bytecode(self, bytecode) -> None:
+        """Replace the code (used when a creation tx returns runtime code)."""
+        self.__init__(bytecode, enable_online_lookup=self.enable_online_lookup)
+
     def __len__(self) -> int:
         return len(self.raw_bytecode)
 

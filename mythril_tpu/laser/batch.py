@@ -1,0 +1,69 @@
+"""Batched frontier feasibility checking — the scheduling seam where the
+TPU backend replaces the reference's one-solver-call-per-state pruning
+(reference svm.py:252-257 calls constraints.is_possible serially).
+
+``prune_infeasible`` receives the whole set of successor states produced
+in one VM step and returns the feasible subset.  Pipeline:
+
+1. structural triage: constraints that folded to literal False are
+   dropped without any solver work; states whose constraint sets are
+   memoized keep their verdicts;
+2. batched TPU check: remaining lanes are packed and handed to
+   ops.batched_sat (WalkSAT finds models for the SAT-majority in
+   lockstep on device);
+3. CDCL tail: lanes the batch pass could not decide go to the native
+   incremental solver (authoritative for UNSAT).
+"""
+
+import logging
+from typing import List
+
+from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.support.support_args import args
+
+log = logging.getLogger(__name__)
+
+
+def _structurally_false(constraints) -> bool:
+    for c in constraints:
+        if isinstance(c, bool):
+            if not c:
+                return True
+            continue
+        if getattr(c, "is_false", False):
+            return True
+    return False
+
+
+def prune_infeasible(states: List) -> List:
+    """Return the subset of states whose path constraints are satisfiable."""
+    undecided = []
+    feasible = []
+    for state in states:
+        constraints = state.world_state.constraints
+        if _structurally_false(constraints):
+            continue
+        undecided.append(state)
+
+    if len(undecided) > 1 and args.batched_solving:
+        try:
+            from mythril_tpu.ops.batched_sat import batch_check_states
+
+            verdicts = batch_check_states(
+                [s.world_state.constraints for s in undecided]
+            )
+        except Exception as e:  # batch path must never lose states
+            log.debug("batched feasibility pass unavailable: %s", e)
+            verdicts = [None] * len(undecided)
+    else:
+        verdicts = [None] * len(undecided)
+
+    for state, verdict in zip(undecided, verdicts):
+        if verdict is True:
+            feasible.append(state)
+        elif verdict is False:
+            continue
+        else:  # undecided by the batch pass: authoritative CDCL check
+            if state.world_state.constraints.is_possible:
+                feasible.append(state)
+    return feasible

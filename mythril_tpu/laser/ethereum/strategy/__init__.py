@@ -1,0 +1,38 @@
+"""Search strategies = frontier schedulers (reference:
+laser/ethereum/strategy/__init__.py).
+
+A strategy iterates over the shared work list, deciding which state to
+step next.  In the TPU design this is also where frontier *batches* are
+drawn from (laser/batch.py selects up to ``batch_lanes`` states at once
+for lockstep feasibility checking).
+"""
+
+from abc import ABC, abstractmethod
+from typing import List
+
+from mythril_tpu.laser.ethereum.state.global_state import GlobalState
+
+
+class BasicSearchStrategy(ABC):
+    def __init__(self, work_list: List[GlobalState], max_depth: int, **kwargs):
+        self.work_list = work_list
+        self.max_depth = max_depth
+
+    def __iter__(self):
+        return self
+
+    @abstractmethod
+    def get_strategic_global_state(self) -> GlobalState:
+        raise NotImplementedError
+
+    def run_check(self) -> bool:
+        return True
+
+    def __next__(self) -> GlobalState:
+        while True:
+            if len(self.work_list) == 0:
+                raise StopIteration
+            global_state = self.get_strategic_global_state()
+            if global_state.mstate.depth < self.max_depth:
+                return global_state
+            # beyond max depth: drop and pick another

@@ -1,0 +1,231 @@
+"""Transaction models and control signals (reference:
+laser/ethereum/transaction/transaction_models.py)."""
+
+import logging
+from copy import deepcopy
+from typing import Any, Optional, Union
+
+from mythril_tpu.disassembler.disassembly import Disassembly
+from mythril_tpu.laser.ethereum.state.account import Account
+from mythril_tpu.laser.ethereum.state.calldata import (
+    BaseCalldata,
+    ConcreteCalldata,
+    SymbolicCalldata,
+)
+from mythril_tpu.laser.ethereum.state.constraints import Constraints
+from mythril_tpu.laser.ethereum.state.environment import Environment
+from mythril_tpu.laser.ethereum.state.global_state import GlobalState
+from mythril_tpu.laser.ethereum.state.world_state import WorldState
+from mythril_tpu.smt import UGE, BitVec, symbol_factory
+
+log = logging.getLogger(__name__)
+
+_next_transaction_id = 0
+
+
+def get_next_transaction_id() -> str:
+    global _next_transaction_id
+    _next_transaction_id += 1
+    return str(_next_transaction_id)
+
+
+def reset_transaction_ids() -> None:
+    global _next_transaction_id
+    _next_transaction_id = 0
+
+
+class TransactionStartSignal(Exception):
+    """Raised when a CALL/CREATE opcode starts a nested transaction."""
+
+    def __init__(self, transaction, op_code: str, global_state: GlobalState):
+        self.transaction = transaction
+        self.op_code = op_code
+        self.global_state = global_state
+
+
+class TransactionEndSignal(Exception):
+    """Raised when a transaction ends (STOP/RETURN/REVERT/exception)."""
+
+    def __init__(self, global_state: GlobalState, revert: bool = False):
+        self.global_state = global_state
+        self.revert = revert
+
+
+class BaseTransaction:
+    def __init__(
+        self,
+        world_state: WorldState,
+        callee_account: Optional[Account] = None,
+        caller: Optional[BitVec] = None,
+        call_data: Optional[BaseCalldata] = None,
+        identifier: Optional[str] = None,
+        gas_price=None,
+        gas_limit=None,
+        origin=None,
+        code=None,
+        call_value=None,
+        init_call_data: bool = True,
+        static: bool = False,
+        base_fee=None,
+    ):
+        assert isinstance(world_state, WorldState)
+        self.world_state = world_state
+        self.id = identifier or get_next_transaction_id()
+
+        self.gas_price = (
+            gas_price
+            if gas_price is not None
+            else symbol_factory.BitVecSym(f"gasprice{self.id}", 256)
+        )
+        self.gas_limit = gas_limit
+        self.origin = (
+            origin
+            if origin is not None
+            else symbol_factory.BitVecSym(f"origin{self.id}", 256)
+        )
+        self.code = code
+        self.caller = caller
+        self.callee_account = callee_account
+        if call_data is None and init_call_data:
+            self.call_data: BaseCalldata = SymbolicCalldata(self.id)
+        else:
+            self.call_data = (
+                call_data
+                if isinstance(call_data, BaseCalldata)
+                else ConcreteCalldata(self.id, [])
+            )
+        self.call_value = (
+            call_value
+            if call_value is not None
+            else symbol_factory.BitVecSym(f"call_value{self.id}", 256)
+        )
+        self.static = static
+        self.return_data: Optional[str] = None
+
+    def initial_global_state_from_environment(
+        self, environment: Environment, active_function: str
+    ) -> GlobalState:
+        global_state = GlobalState(
+            self.world_state, environment, None, transaction_stack=[]
+        )
+        global_state.environment.active_function_name = active_function
+
+        sender = environment.sender
+        receiver = environment.active_account.address
+        value = (
+            environment.callvalue
+            if isinstance(environment.callvalue, BitVec)
+            else symbol_factory.BitVecVal(environment.callvalue, 256)
+        )
+        global_state.world_state.constraints.append(
+            UGE(global_state.world_state.balances[sender], value)
+        )
+        global_state.world_state.balances[receiver] += value
+        global_state.world_state.balances[sender] -= value
+        return global_state
+
+    def initial_global_state(self) -> GlobalState:
+        raise NotImplementedError
+
+    def __str__(self) -> str:
+        return (
+            f"{self.__class__.__name__} {self.id} from "
+            f"{self.caller} to {self.callee_account}"
+        )
+
+
+class MessageCallTransaction(BaseTransaction):
+    """A message call to an existing account."""
+
+    def initial_global_state(self) -> GlobalState:
+        environment = Environment(
+            self.callee_account,
+            self.caller,
+            self.call_data,
+            self.gas_price,
+            self.call_value,
+            self.origin,
+            code=self.code or self.callee_account.code,
+            static=self.static,
+        )
+        return super().initial_global_state_from_environment(
+            environment, active_function="fallback"
+        )
+
+    def end(self, global_state: GlobalState, return_data=None, revert=False) -> None:
+        self.return_data = return_data
+        raise TransactionEndSignal(global_state, revert)
+
+
+class ContractCreationTransaction(BaseTransaction):
+    """Deploys a new contract: code is the creation bytecode; a RETURN
+    assigns the runtime bytecode to the new account."""
+
+    def __init__(
+        self,
+        world_state: WorldState,
+        caller: Optional[BitVec] = None,
+        call_data: Optional[BaseCalldata] = None,
+        identifier: Optional[str] = None,
+        gas_price=None,
+        gas_limit=None,
+        origin=None,
+        code=None,
+        call_value=None,
+        contract_name=None,
+        contract_address=None,
+    ):
+        self.prev_world_state = deepcopy(world_state)
+        contract_address = (
+            contract_address if isinstance(contract_address, int) else None
+        )
+        callee_account = world_state.create_account(
+            0, concrete_storage=True, creator=caller.value, address=contract_address
+        )
+        callee_account.contract_name = contract_name or callee_account.contract_name
+        # Constructor arguments are modeled as symbolic calldata; the
+        # codecopy/codesize/calldatasize mutators splice them onto the
+        # end of the init code (same trick as the reference,
+        # transaction_models.py:208).
+        super().__init__(
+            world_state=world_state,
+            callee_account=callee_account,
+            caller=caller,
+            call_data=call_data,
+            identifier=identifier,
+            gas_price=gas_price,
+            gas_limit=gas_limit,
+            origin=origin,
+            code=code,
+            call_value=call_value,
+            init_call_data=True,
+        )
+
+    def initial_global_state(self) -> GlobalState:
+        environment = Environment(
+            self.callee_account,
+            self.caller,
+            self.call_data,
+            self.gas_price,
+            self.call_value,
+            self.origin,
+            self.code,
+        )
+        return super().initial_global_state_from_environment(
+            environment, active_function="constructor"
+        )
+
+    def end(self, global_state: GlobalState, return_data=None, revert=False):
+        if (
+            not all([isinstance(element, int) for element in return_data or []])
+            or len(return_data or []) == 0
+        ):
+            self.return_data = None
+            raise TransactionEndSignal(global_state, revert)
+        contract_code = bytes.fromhex("".join(f"{b:02x}" for b in return_data))
+        global_state.environment.active_account.code.assign_bytecode(contract_code)
+        self.return_data = str(
+            global_state.environment.active_account.address
+        )
+        assert global_state.environment.active_account.code.instruction_list != []
+        raise TransactionEndSignal(global_state, revert)

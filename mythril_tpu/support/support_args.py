@@ -1,0 +1,25 @@
+"""Global analysis-flag singleton (reference: mythril/support/support_args.py).
+
+The CLI/facade writes these once per analysis; laser and the solver
+funnel read them from anywhere.  Kept deliberately identical in shape so
+flag plumbing matches the reference's behavior.
+"""
+
+from mythril_tpu.support.support_utils import Singleton
+
+
+class Args(object, metaclass=Singleton):
+    def __init__(self):
+        self.solver_timeout = 10000          # ms per query
+        self.sparse_pruning = False
+        self.unconstrained_storage = False
+        self.parallel_solving = False
+        self.call_depth_limit = 3
+        self.iprof = False
+        self.solver_log = None
+        # TPU-build extras
+        self.batched_solving = True          # batch frontier feasibility checks
+        self.batch_lanes = 64                # target lanes per TPU solver batch
+
+
+args = Args()
