@@ -35,8 +35,24 @@ class BaseCalldata:
             return symbol_factory.BitVecVal(result, 256)
         return result
 
-    def get_word_at(self, offset: int) -> BitVec:
-        parts = self[offset : offset + 32]
+    def get_word_at(self, offset: Union[int, BitVec]) -> BitVec:
+        # Read byte-wise rather than via a slice: a huge offset makes
+        # offset+32 wrap mod 2^256 and a slice would come out empty,
+        # whereas the EVM semantics is 32 zero-padded reads.
+        if isinstance(offset, BitVec) and offset.value is not None:
+            offset = offset.value
+        if isinstance(offset, int):
+            # indices are unbounded in the spec; anything beyond any
+            # realizable calldata size reads as zero (and must NOT wrap
+            # through the 256-bit masking of the term layer)
+            parts = [
+                symbol_factory.BitVecVal(0, 8)
+                if offset + i >= 2**64
+                else self._load(offset + i)
+                for i in range(32)
+            ]
+        else:
+            parts = [self._load(simplify(offset + i)) for i in range(32)]
         return simplify(Concat(parts))
 
     def __getitem__(self, item: Union[int, slice, BitVec]) -> Any:
