@@ -1,0 +1,108 @@
+"""Minimal JSON-RPC client for on-chain data (reference:
+mythril/ethereum/interface/rpc/client.py).
+
+Only the read methods the analyzer needs.  Uses urllib from the stdlib;
+all errors surface as ClientError so DynLoader degrades gracefully when
+no node is reachable (the common case in this environment).
+"""
+
+import json
+import logging
+import urllib.request
+from typing import Any, List, Optional
+
+log = logging.getLogger(__name__)
+
+JSON_MEDIA_TYPE = "application/json"
+
+
+class ClientError(Exception):
+    pass
+
+
+class BadStatusCodeError(ClientError):
+    pass
+
+
+class BadJsonError(ClientError):
+    pass
+
+
+class BadResponseError(ClientError):
+    pass
+
+
+class ConnectionError_(ClientError):
+    pass
+
+
+class BaseClient:
+    def eth_getCode(self, address: str, default_block: str = "latest") -> str:
+        return self._call("eth_getCode", [address, default_block])
+
+    def eth_getStorageAt(
+        self, address: str, position: int, block: str = "latest"
+    ) -> str:
+        return self._call(
+            "eth_getStorageAt", [address, hex(position), block]
+        )
+
+    def eth_getBalance(self, address: str, block: str = "latest") -> int:
+        return int(self._call("eth_getBalance", [address, block]), 16)
+
+    def eth_getBlockByNumber(self, block: str, full: bool = True):
+        return self._call("eth_getBlockByNumber", [block, full])
+
+    def eth_getTransactionReceipt(self, tx_hash: str):
+        return self._call("eth_getTransactionReceipt", [tx_hash])
+
+    def _call(self, method: str, params: Optional[List[Any]] = None):
+        raise NotImplementedError
+
+
+class EthJsonRpc(BaseClient):
+    """JSON-RPC over HTTP(S)."""
+
+    def __init__(self, host: str = "localhost", port: int = 8545, tls: bool = False):
+        self.host = host
+        self.port = port
+        self.tls = tls
+        self._id = 0
+
+    @property
+    def url(self) -> str:
+        scheme = "https" if self.tls else "http"
+        if self.host.startswith(("http://", "https://")):
+            return self.host
+        netloc = f"{self.host}:{self.port}" if self.port else self.host
+        return f"{scheme}://{netloc}"
+
+    def _call(self, method: str, params: Optional[List[Any]] = None):
+        self._id += 1
+        payload = json.dumps(
+            {
+                "jsonrpc": "2.0",
+                "method": method,
+                "params": params or [],
+                "id": self._id,
+            }
+        ).encode()
+        request = urllib.request.Request(
+            self.url,
+            data=payload,
+            headers={"Content-Type": JSON_MEDIA_TYPE},
+        )
+        try:
+            with urllib.request.urlopen(request, timeout=10) as response:
+                if response.status != 200:
+                    raise BadStatusCodeError(str(response.status))
+                body = response.read()
+        except OSError as e:
+            raise ConnectionError_(str(e))
+        try:
+            decoded = json.loads(body)
+        except json.JSONDecodeError:
+            raise BadJsonError(body[:200])
+        if "result" not in decoded:
+            raise BadResponseError(decoded.get("error"))
+        return decoded["result"]

@@ -1,0 +1,71 @@
+"""solc invocation helpers (reference: mythril/ethereum/util.py).
+
+The environment may have no solc binary; callers get a CompilerError
+they can surface to the user.
+"""
+
+import json
+import logging
+import os
+import shutil
+import subprocess
+from typing import Optional
+
+from mythril_tpu.exceptions import CompilerError
+
+log = logging.getLogger(__name__)
+
+
+def solc_exists(version_or_binary: str = "solc") -> Optional[str]:
+    return shutil.which(version_or_binary)
+
+
+def get_solc_json(file: str, solc_binary: str = "solc", solc_settings_json=None) -> dict:
+    """Compile a solidity file via solc --standard-json."""
+    if not solc_exists(solc_binary):
+        raise CompilerError(
+            f"Compiler not found: {solc_binary!r}. Install solc or pass "
+            "--bin runtime bytecode / a -c hex string instead."
+        )
+    settings = json.loads(solc_settings_json) if solc_settings_json else {}
+    settings.setdefault("optimizer", {"enabled": True})
+    settings["outputSelection"] = {
+        "*": {
+            "*": [
+                "metadata", "evm.bytecode", "evm.deployedBytecode",
+                "evm.methodIdentifiers",
+            ],
+            "": ["ast"],
+        }
+    }
+    input_json = json.dumps(
+        {
+            "language": "Solidity",
+            "sources": {file: {"urls": [file]}},
+            "settings": settings,
+        }
+    )
+    try:
+        result = subprocess.run(
+            [solc_binary, "--standard-json", "--allow-paths", "."],
+            input=input_json.encode(),
+            capture_output=True,
+            check=False,
+            cwd=os.path.dirname(os.path.abspath(file)) or ".",
+        )
+    except OSError as e:
+        raise CompilerError(f"Compiler exception: {e}")
+    try:
+        output = json.loads(result.stdout)
+    except json.JSONDecodeError:
+        raise CompilerError(
+            f"solc returned invalid output: {result.stdout[:300]!r} "
+            f"{result.stderr[:300]!r}"
+        )
+    for error in output.get("errors", []):
+        if error.get("severity") == "error":
+            raise CompilerError(
+                "Solc experienced a fatal error:\n"
+                + error.get("formattedMessage", str(error))
+            )
+    return output

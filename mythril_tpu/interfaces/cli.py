@@ -1,0 +1,668 @@
+"""The myth command-line interface (reference: mythril/interfaces/cli.py).
+
+Commands: analyze (a), disassemble (d), list-detectors, read-storage,
+function-to-hash, hash-to-address, version, help — plus stubs for the
+reference's leveldb-search/truffle/pro commands (their backends are not
+available in this environment and report so cleanly).
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import List, Optional
+
+import mythril_tpu
+from mythril_tpu.analysis.module.loader import ModuleLoader
+from mythril_tpu.exceptions import (
+    CriticalError,
+    DetectorNotFoundError,
+)
+from mythril_tpu.mythril import (
+    MythrilAnalyzer,
+    MythrilConfig,
+    MythrilDisassembler,
+)
+from mythril_tpu.plugin.loader import MythrilPluginLoader
+from mythril_tpu.support.crypto import keccak256
+
+log = logging.getLogger(__name__)
+
+ANALYZE_LIST = ("analyze", "a")
+DISASSEMBLE_LIST = ("disassemble", "d")
+
+COMMAND_LIST = (
+    ANALYZE_LIST
+    + DISASSEMBLE_LIST
+    + (
+        "pro",
+        "list-detectors",
+        "read-storage",
+        "leveldb-search",
+        "function-to-hash",
+        "hash-to-address",
+        "version",
+        "truffle",
+        "help",
+    )
+)
+
+
+def exit_with_error(format_: str, message: str) -> None:
+    if format_ == "text" or format_ == "markdown":
+        log.error(message)
+    elif format_ == "json":
+        print(json.dumps({"success": False, "error": str(message), "issues": []}))
+    else:
+        print(
+            json.dumps(
+                [
+                    {
+                        "issues": [],
+                        "sourceType": "",
+                        "sourceFormat": "",
+                        "sourceList": [],
+                        "meta": {
+                            "logs": [
+                                {
+                                    "level": "error",
+                                    "hidden": True,
+                                    "error": message,
+                                }
+                            ]
+                        },
+                    }
+                ]
+            )
+        )
+    sys.exit()
+
+
+def get_runtime_input_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(add_help=False)
+    parser.add_argument(
+        "-a",
+        "--address",
+        help="pull contract from the blockchain",
+        metavar="CONTRACT_ADDRESS",
+    )
+    parser.add_argument(
+        "--bin-runtime",
+        action="store_true",
+        help="Only when -c or -f is used. Consider the input bytecode as "
+        "binary runtime code, default being the contract creation bytecode.",
+    )
+    return parser
+
+
+def get_creation_input_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(add_help=False)
+    parser.add_argument(
+        "-c",
+        "--code",
+        help='hex-encoded bytecode string ("6060604052...")',
+        metavar="BYTECODE",
+    )
+    parser.add_argument(
+        "-f",
+        "--codefile",
+        help="file containing hex-encoded bytecode string",
+        metavar="BYTECODEFILE",
+        type=argparse.FileType("r"),
+    )
+    return parser
+
+
+def get_output_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(add_help=False)
+    parser.add_argument(
+        "-o",
+        "--outform",
+        choices=["text", "markdown", "json", "jsonv2"],
+        default="text",
+        help="report output format",
+        metavar="<text/markdown/json/jsonv2>",
+    )
+    parser.add_argument(
+        "--verbose-report",
+        action="store_true",
+        help="Include debugging information in report",
+    )
+    return parser
+
+
+def get_rpc_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(add_help=False)
+    parser.add_argument(
+        "--rpc",
+        help="custom RPC settings",
+        metavar="HOST:PORT / ganache / infura-[network_name]",
+    )
+    parser.add_argument(
+        "--rpctls", type=bool, default=False, help="RPC connection over TLS"
+    )
+    return parser
+
+
+def get_utilities_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(add_help=False)
+    parser.add_argument("--solc-json", help="Json for the optional 'settings' parameter of solc's standard-json input")
+    parser.add_argument(
+        "--solv",
+        help="specify solidity compiler version.",
+        metavar="SOLV",
+    )
+    parser.add_argument(
+        "-v",
+        type=int,
+        help="log level (0-5)",
+        metavar="LOG_LEVEL",
+        default=2,
+        dest="verbosity",
+    )
+    return parser
+
+
+def create_analyzer_parser(analyzer_parser: argparse.ArgumentParser) -> None:
+    analyzer_parser.add_argument(
+        "solidity_files", nargs="*", help="Inputs file name and contract name"
+    )
+    commands = analyzer_parser.add_argument_group("commands")
+    commands.add_argument("-g", "--graph", help="generate a control flow graph", metavar="OUTPUT_FILE")
+    commands.add_argument(
+        "-j",
+        "--statespace-json",
+        help="dumps the statespace json",
+        metavar="OUTPUT_FILE",
+    )
+    options = analyzer_parser.add_argument_group("options")
+    options.add_argument(
+        "-m",
+        "--modules",
+        help="Comma-separated list of security analysis modules",
+        metavar="MODULES",
+    )
+    options.add_argument(
+        "--max-depth",
+        type=int,
+        default=128,
+        help="Maximum recursion depth for symbolic execution",
+    )
+    options.add_argument(
+        "--call-depth-limit",
+        type=int,
+        default=3,
+        help="Maximum call depth limit for symbolic execution",
+    )
+    options.add_argument(
+        "--strategy",
+        choices=["dfs", "bfs", "naive-random", "weighted-random"],
+        default="bfs",
+        help="Symbolic execution strategy",
+    )
+    options.add_argument(
+        "-b",
+        "--loop-bound",
+        type=int,
+        default=3,
+        help="Bound loops at n iterations",
+        metavar="N",
+    )
+    options.add_argument(
+        "-t",
+        "--transaction-count",
+        type=int,
+        default=2,
+        help="Maximum number of transactions issued by laser",
+    )
+    options.add_argument(
+        "--execution-timeout",
+        type=int,
+        default=86400,
+        help="The amount of seconds to spend on symbolic execution",
+    )
+    options.add_argument(
+        "--solver-timeout",
+        type=int,
+        default=10000,
+        help="The maximum amount of time (in milli seconds) the solver "
+        "spends for queries from analysis modules",
+    )
+    options.add_argument(
+        "--create-timeout",
+        type=int,
+        default=10,
+        help="The amount of seconds to spend on the initial contract creation",
+    )
+    options.add_argument(
+        "--parallel-solving",
+        action="store_true",
+        help="Enable solving parallelization",
+    )
+    options.add_argument(
+        "--batched-solving",
+        action="store_true",
+        default=True,
+        help="Batch frontier feasibility checks on the accelerator (default on)",
+    )
+    options.add_argument(
+        "--no-onchain-data",
+        action="store_true",
+        help="Don't attempt to retrieve contract code, variables and balances from the blockchain",
+    )
+    options.add_argument(
+        "--sparse-pruning",
+        action="store_true",
+        help="Checks for reachability after the end of tx. Recommended for "
+        "short execution timeouts < 1 minute",
+    )
+    options.add_argument(
+        "--unconstrained-storage",
+        action="store_true",
+        help="Default storage value is symbolic, turns off the on-chain "
+        "loading of storage",
+    )
+    options.add_argument(
+        "--phrack", action="store_true", help="Phrack-style call graph"
+    )
+    options.add_argument(
+        "--enable-physics",
+        action="store_true",
+        help="enable graph physics simulation",
+    )
+    options.add_argument(
+        "-q",
+        "--query-signature",
+        action="store_true",
+        help="Lookup function signatures through www.4byte.directory",
+    )
+    options.add_argument(
+        "--enable-iprof",
+        action="store_true",
+        help="enable the instruction profiler",
+    )
+    options.add_argument(
+        "--disable-dependency-pruning",
+        action="store_true",
+        help="Deactivate dependency-based pruning",
+    )
+    options.add_argument(
+        "--enable-coverage-strategy",
+        action="store_true",
+        help="enable coverage based search strategy",
+    )
+    options.add_argument(
+        "--custom-modules-directory",
+        help="designates a separate directory to search for custom "
+        "analysis modules",
+        metavar="CUSTOM_MODULES_DIRECTORY",
+    )
+    options.add_argument(
+        "--attacker-address",
+        help="Designates a specific attacker address to use during analysis",
+        metavar="ATTACKER_ADDRESS",
+    )
+    options.add_argument(
+        "--creator-address",
+        help="Designates a specific creator address to use during analysis",
+        metavar="CREATOR_ADDRESS",
+    )
+
+
+def create_disassemble_parser(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "solidity_files",
+        nargs="*",
+        help="Inputs file name and contract name. "
+        "usage: file:contractName",
+    )
+
+
+def create_read_storage_parser(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "storage_slots",
+        help="read state variables from storage index",
+        metavar="INDEX,NUM_SLOTS,[array] / mapping,INDEX,[KEY1, KEY2...]",
+    )
+    parser.add_argument(
+        "address", help="contract address", metavar="CONTRACT_ADDRESS"
+    )
+
+
+def create_func_to_hash_parser(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "func_name", help="calculate function signature hash", metavar="SIGNATURE"
+    )
+
+
+def create_hash_to_addr_parser(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "hash", help="Find the address from hash", metavar="FUNCTION_NAME"
+    )
+
+
+def main() -> None:
+    """The main CLI interface entry point."""
+    program_name = "myth"
+    parser = argparse.ArgumentParser(
+        prog=program_name,
+        description="Security analysis of Ethereum smart contracts "
+        "(TPU-native build)",
+    )
+    parser.add_argument(
+        "--epic", action="store_true", help=argparse.SUPPRESS
+    )
+    subparsers = parser.add_subparsers(dest="command", help="Commands")
+
+    rpc_parser = get_rpc_parser()
+    utilities_parser = get_utilities_parser()
+    creation_input_parser = get_creation_input_parser()
+    runtime_input_parser = get_runtime_input_parser()
+    output_parser = get_output_parser()
+
+    analyzer_parser = subparsers.add_parser(
+        ANALYZE_LIST[0],
+        help="Triggers the analysis of the smart contract",
+        parents=[
+            rpc_parser, utilities_parser, creation_input_parser,
+            runtime_input_parser, output_parser,
+        ],
+        aliases=ANALYZE_LIST[1:],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    create_analyzer_parser(analyzer_parser)
+
+    disassemble_parser = subparsers.add_parser(
+        DISASSEMBLE_LIST[0],
+        help="Disassembles the smart contract",
+        aliases=DISASSEMBLE_LIST[1:],
+        parents=[
+            rpc_parser, utilities_parser, creation_input_parser,
+            runtime_input_parser,
+        ],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    create_disassemble_parser(disassemble_parser)
+
+    subparsers.add_parser(
+        "list-detectors",
+        parents=[output_parser],
+        help="Lists available detection modules",
+    )
+    read_storage_parser = subparsers.add_parser(
+        "read-storage",
+        help="Retrieves storage slots from a given address through rpc",
+        parents=[rpc_parser],
+    )
+    create_read_storage_parser(read_storage_parser)
+    func_to_hash_parser = subparsers.add_parser(
+        "function-to-hash", help="Returns the hash of a function signature"
+    )
+    create_func_to_hash_parser(func_to_hash_parser)
+    hash_to_addr_parser = subparsers.add_parser(
+        "hash-to-address",
+        help="Returns the functions from signature database for the hash",
+    )
+    create_hash_to_addr_parser(hash_to_addr_parser)
+    subparsers.add_parser("version", parents=[output_parser], help="Outputs the version")
+    subparsers.add_parser(
+        "pro", help="(unavailable) MythX cloud analysis", parents=[output_parser]
+    )
+    subparsers.add_parser(
+        "truffle", help="(unavailable) analyze a truffle project"
+    )
+    subparsers.add_parser(
+        "leveldb-search", help="(unavailable) search a local geth LevelDB"
+    )
+    subparsers.add_parser("help", add_help=False)
+
+    args = parser.parse_args()
+    parse_args_and_execute(parser=parser, args=args)
+
+
+def set_config(args: argparse.Namespace) -> MythrilConfig:
+    config = MythrilConfig()
+    if getattr(args, "rpc", None):
+        config.set_api_rpc(rpc=args.rpc, rpctls=args.rpctls)
+    elif not getattr(args, "no_onchain_data", True):
+        config.set_api_from_config_path()
+    return config
+
+
+def load_code(disassembler: MythrilDisassembler, args: argparse.Namespace):
+    address = None
+    if args.code is not None:
+        address, _ = disassembler.load_from_bytecode(
+            args.code, args.bin_runtime, address
+        )
+    elif args.codefile:
+        bytecode = "".join([l.strip() for l in args.codefile if len(l.strip()) > 0])
+        address, _ = disassembler.load_from_bytecode(
+            bytecode, args.bin_runtime, address
+        )
+    elif args.address:
+        address, _ = disassembler.load_from_address(args.address)
+    elif args.solidity_files:
+        address, _ = disassembler.load_from_solidity(args.solidity_files)
+    else:
+        exit_with_error(
+            getattr(args, "outform", "text"),
+            "No input bytecode. Please provide EVM code via -c BYTECODE, "
+            "-a ADDRESS, -f BYTECODE_FILE or <SOLIDITY_FILE>",
+        )
+    return address
+
+
+def execute_command(
+    disassembler: MythrilDisassembler,
+    address: str,
+    parser: argparse.ArgumentParser,
+    args: argparse.Namespace,
+) -> None:
+    if args.command in DISASSEMBLE_LIST:
+        if disassembler.contracts[0].code:
+            print("Runtime Disassembly: \n" + disassembler.contracts[0].get_easm())
+        if disassembler.contracts[0].creation_code:
+            print(
+                "Disassembly: \n"
+                + disassembler.contracts[0].get_creation_easm()
+            )
+        return
+
+    if args.command in ANALYZE_LIST:
+        analyzer = MythrilAnalyzer(
+            strategy=args.strategy,
+            disassembler=disassembler,
+            address=address,
+            max_depth=args.max_depth,
+            execution_timeout=args.execution_timeout,
+            loop_bound=args.loop_bound,
+            create_timeout=args.create_timeout,
+            enable_iprof=args.enable_iprof,
+            disable_dependency_pruning=args.disable_dependency_pruning,
+            use_onchain_data=not args.no_onchain_data,
+            solver_timeout=args.solver_timeout,
+            parallel_solving=args.parallel_solving,
+            custom_modules_directory=args.custom_modules_directory
+            if args.custom_modules_directory
+            else "",
+            sparse_pruning=args.sparse_pruning,
+            unconstrained_storage=args.unconstrained_storage,
+            call_depth_limit=args.call_depth_limit,
+            enable_coverage_strategy=args.enable_coverage_strategy,
+        )
+
+        if not disassembler.contracts:
+            exit_with_error(
+                args.outform, "input files do not contain any valid contracts"
+            )
+
+        if args.graph:
+            html = analyzer.graph_html(
+                contract=analyzer.contracts[0],
+                enable_physics=args.enable_physics,
+                phrackify=args.phrack,
+                transaction_count=args.transaction_count,
+            )
+            try:
+                with open(args.graph, "w") as f:
+                    f.write(html)
+            except Exception as e:
+                exit_with_error(args.outform, f"Error saving graph: {e}")
+            return
+        if args.statespace_json:
+            if not analyzer.contracts:
+                exit_with_error(
+                    args.outform, "input files do not contain any valid contracts"
+                )
+            statespace = analyzer.dump_statespace(contract=analyzer.contracts[0])
+            try:
+                with open(args.statespace_json, "w") as f:
+                    json.dump(statespace, f)
+            except Exception as e:
+                exit_with_error(args.outform, f"Error saving json: {e}")
+            return
+
+        try:
+            report = analyzer.fire_lasers(
+                modules=[m.strip() for m in args.modules.strip().split(",")]
+                if args.modules
+                else None,
+                transaction_count=args.transaction_count,
+            )
+            outputs = {
+                "json": report.as_json(),
+                "jsonv2": report.as_swc_standard_format(),
+                "text": report.as_text(),
+                "markdown": report.as_markdown(),
+            }
+            print(outputs[args.outform])
+        except DetectorNotFoundError as e:
+            exit_with_error(args.outform, format(e))
+        except CriticalError as e:
+            exit_with_error(
+                args.outform, "Analysis error encountered: " + format(e)
+            )
+        return
+
+
+def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Namespace) -> None:
+    if args.epic:
+        path = os.path.dirname(os.path.realpath(__file__))
+        sys.argv.remove("--epic")
+        os.system(" ".join(sys.argv) + " | python3 " + path + "/epic.py")
+        sys.exit()
+
+    if args.command not in COMMAND_LIST or args.command is None:
+        parser.print_help()
+        sys.exit()
+
+    if args.command == "version":
+        if args.outform == "json":
+            print(json.dumps({"version_str": mythril_tpu.__version__}))
+        else:
+            print(f"Mythril-TPU version {mythril_tpu.__version__}")
+        sys.exit()
+
+    if args.command == "help":
+        parser.print_help()
+        sys.exit()
+
+    # Logging
+    log_levels = [
+        logging.NOTSET, logging.CRITICAL, logging.ERROR, logging.WARNING,
+        logging.INFO, logging.DEBUG,
+    ]
+    level = log_levels[min(getattr(args, "verbosity", 2), 5)]
+    logging.basicConfig(
+        level=level, format="%(name)s [%(levelname)s]: %(message)s"
+    )
+    logging.getLogger("mythril_tpu").setLevel(level)
+
+    if args.command == "function-to-hash":
+        print(MythrilDisassembler.hash_for_function_signature(args.func_name))
+        sys.exit()
+
+    if args.command == "hash-to-address":
+        from mythril_tpu.support.signatures import SignatureDB
+
+        sig_db = SignatureDB()
+        for name in sig_db.get(args.hash):
+            print(name)
+        sys.exit()
+
+    if args.command == "list-detectors":
+        modules = []
+        for module in ModuleLoader().get_detection_modules():
+            modules.append({"classname": type(module).__name__, "title": module.name})
+        if args.outform == "json":
+            print(json.dumps(modules))
+        else:
+            for module_data in modules:
+                print(f"{module_data['classname']}: {module_data['title']}")
+        sys.exit()
+
+    if args.command in ("pro", "truffle", "leveldb-search"):
+        exit_with_error(
+            getattr(args, "outform", "text"),
+            f"The '{args.command}' command is not available in this build "
+            "(its external backend does not exist in this environment).",
+        )
+
+    # load mythril-level plugins (entry-point discovery)
+    MythrilPluginLoader()
+
+    if args.command == "read-storage":
+        config = set_config(args)
+        if config.eth is None:
+            config.set_api_rpc(args.rpc or "localhost:8545", args.rpctls)
+        disassembler = MythrilDisassembler(eth=config.eth)
+        storage = disassembler.get_state_variable_from_storage(
+            address=args.address,
+            params=[a.strip() for a in args.storage_slots.strip().split(",")],
+        )
+        print(storage)
+        return
+
+    # analyze / disassemble need loaded code
+    if getattr(args, "attacker_address", None):
+        from mythril_tpu.laser.ethereum.transaction.symbolic import ACTORS
+
+        try:
+            ACTORS["ATTACKER"] = int(args.attacker_address, 16)
+        except ValueError:
+            exit_with_error(args.outform, "Attacker address is invalid")
+    if getattr(args, "creator_address", None):
+        from mythril_tpu.laser.ethereum.transaction.symbolic import ACTORS
+
+        try:
+            ACTORS["CREATOR"] = int(args.creator_address, 16)
+        except ValueError:
+            exit_with_error(args.outform, "Creator address is invalid")
+
+    config = set_config(args)
+    solv = getattr(args, "solv", None)
+    query_signature = getattr(args, "query_signature", False)
+    solc_json = getattr(args, "solc_json", None)
+    try:
+        disassembler = MythrilDisassembler(
+            eth=config.eth,
+            solc_version=solv,
+            solc_settings_json=solc_json,
+            enable_online_lookup=query_signature,
+        )
+        address = load_code(disassembler, args)
+        execute_command(
+            disassembler=disassembler, address=address, parser=parser, args=args
+        )
+    except CriticalError as ce:
+        exit_with_error(getattr(args, "outform", "text"), str(ce))
+    except Exception:
+        import traceback
+
+        exit_with_error(getattr(args, "outform", "text"), traceback.format_exc())
+
+
+if __name__ == "__main__":
+    main()

@@ -43,9 +43,7 @@ class WorldState:
             return new_account
 
     def __copy__(self) -> "WorldState":
-        new_annotations = [
-            copy(a) for a in self._annotations if a.persist_to_world_state
-        ]
+        new_annotations = [copy(a) for a in self._annotations]
         new_world_state = WorldState(
             transaction_sequence=self.transaction_sequence[:],
             annotations=new_annotations,

@@ -1,0 +1,108 @@
+"""Loop-bound strategy decorator (reference:
+laser/ethereum/strategy/extensions/bounded_loops.py).
+
+Each state carries a trace of executed JUMPDEST addresses; a repeating
+trace suffix is detected with a rolling positional hash and states whose
+innermost loop exceeded the bound are dropped (creation transactions get
+max(8, bound) so constructor loops complete).
+"""
+
+import logging
+from copy import copy
+from typing import Dict, List, cast
+
+from mythril_tpu.laser.ethereum.state.annotation import StateAnnotation
+from mythril_tpu.laser.ethereum.state.global_state import GlobalState
+from mythril_tpu.laser.ethereum.strategy import BasicSearchStrategy
+from mythril_tpu.laser.ethereum.transaction import ContractCreationTransaction
+
+log = logging.getLogger(__name__)
+
+
+class JumpdestCountAnnotation(StateAnnotation):
+    def __init__(self) -> None:
+        self._reached_count: Dict[int, int] = {}
+        self.trace: List[int] = []
+
+    def __copy__(self):
+        result = JumpdestCountAnnotation()
+        result._reached_count = copy(self._reached_count)
+        result.trace = copy(self.trace)
+        return result
+
+
+class BoundedLoopsStrategy(BasicSearchStrategy):
+    def __init__(self, super_strategy: BasicSearchStrategy, *args) -> None:
+        self.super_strategy = super_strategy
+        self.bound = args[0][0]
+        log.info(
+            "Loaded search strategy extension: Loop bounds (limit = %d)",
+            self.bound,
+        )
+        BasicSearchStrategy.__init__(
+            self, super_strategy.work_list, super_strategy.max_depth
+        )
+
+    @staticmethod
+    def calculate_hash(i: int, j: int, trace: List[int]) -> int:
+        """Positional hash of trace[i:j]."""
+        key = 0
+        for index in range(i, j):
+            key |= trace[index] << ((index - i) * 8)
+        return key
+
+    @staticmethod
+    def count_key(trace: List[int], key: int, start: int, size: int) -> int:
+        """Count how many times the suffix of length `size` repeats
+        contiguously backwards from `start`."""
+        count = 1
+        i = start
+        while i >= 0:
+            if BoundedLoopsStrategy.calculate_hash(i, i + size, trace) != key:
+                break
+            count += 1
+            i -= size
+        return count
+
+    @staticmethod
+    def get_loop_count(trace: List[int]) -> int:
+        found = False
+        i = 0
+        for i in range(len(trace) - 3, 0, -1):
+            if trace[i] == trace[-2] and trace[i + 1] == trace[-1]:
+                found = True
+                break
+        if not found:
+            return 0
+        key = BoundedLoopsStrategy.calculate_hash(i + 1, len(trace) - 1, trace)
+        size = len(trace) - i - 2
+        return BoundedLoopsStrategy.count_key(trace, key, i + 1, size)
+
+    def get_strategic_global_state(self) -> GlobalState:
+        while True:
+            state = self.super_strategy.get_strategic_global_state()
+            annotations = cast(
+                List[JumpdestCountAnnotation],
+                list(state.get_annotations(JumpdestCountAnnotation)),
+            )
+            if len(annotations) == 0:
+                annotation = JumpdestCountAnnotation()
+                state.annotate(annotation)
+            else:
+                annotation = annotations[0]
+
+            cur_instr = state.get_current_instruction()
+            annotation.trace.append(cur_instr["address"])
+
+            if cur_instr["opcode"].upper() != "JUMPDEST":
+                return state
+
+            count = BoundedLoopsStrategy.get_loop_count(annotation.trace)
+            if isinstance(
+                state.current_transaction, ContractCreationTransaction
+            ) and count < max(8, self.bound):
+                return state
+            if count > self.bound:
+                log.debug("Loop bound reached, skipping state")
+                continue
+            return state

@@ -111,6 +111,8 @@ class LaserEVM:
 
         # statistics comparable to the reference's telemetry
         self.iteration_states: List[int] = []
+        # populated by plugins (profilers etc.), surfaced in reports
+        self.execution_info: List = []
 
     # ------------------------------------------------------------------
     # top-level entry
@@ -269,6 +271,8 @@ class LaserEVM:
         except PluginSkipState:
             self._add_world_state(global_state)
             return [], None
+        except PluginSkipWorldState:
+            return [], None
 
         for hook in self._execute_state_hooks:
             hook(global_state)
@@ -420,7 +424,10 @@ class LaserEVM:
     # ------------------------------------------------------------------
 
     def manage_cfg(self, opcode: Optional[str], new_states: List[GlobalState]) -> None:
-        if not self.requires_statespace or opcode is None:
+        # Node objects are created unconditionally (function-name tagging
+        # rides on them); requires_statespace only gates nodes/edges
+        # *storage* (reference svm.py:465).
+        if opcode is None:
             return
         if opcode == "JUMP":
             assert len(new_states) <= 1
@@ -435,9 +442,10 @@ class LaserEVM:
         elif opcode in ("RETURN", "STOP"):
             for state in new_states:
                 self._new_node_state(state, JumpType.RETURN)
-        for state in new_states:
-            if state.node is not None:
-                state.node.states.append(state)
+        if self.requires_statespace:
+            for state in new_states:
+                if state.node is not None:
+                    state.node.states.append(state)
 
     def _new_node_state(
         self, state: GlobalState, edge_type=JumpType.UNCONDITIONAL, condition=None
@@ -543,7 +551,12 @@ class LaserEVM:
 
     def _execute_hooks(self, hooks: List[Callable]) -> None:
         for hook in hooks:
-            hook(self)
+            hook()
+
+    def extend_strategy(self, extension, *args) -> None:
+        """Wrap the current strategy with a decorator strategy (e.g.
+        BoundedLoopsStrategy)."""
+        self.strategy = extension(self.strategy, args)
 
     # decorator-style opcode hooks (reference svm.py:671-709)
     def pre_hook(self, op_code: str) -> Callable:

@@ -49,6 +49,7 @@ def execute_message_call(
 def _setup_global_state_for_execution(laser_evm, transaction) -> None:
     global_state = transaction.initial_global_state()
     global_state.transaction_stack.append((transaction, None))
+    global_state.world_state.transaction_sequence.append(transaction)
     new_node = Node(global_state.environment.active_account.contract_name)
     if laser_evm.requires_statespace:
         laser_evm.nodes[new_node.uid] = new_node

@@ -88,6 +88,8 @@ def generate_function_constraints(calldata, func_hashes):
 def execute_message_call(laser_evm, callee_address: BitVec) -> None:
     """Drain open states; fire a fresh symbolic transaction at each
     (reference symbolic.py:70)."""
+    if isinstance(callee_address, int):
+        callee_address = symbol_factory.BitVecVal(callee_address, 256)
     open_states = laser_evm.open_states[:]
     del laser_evm.open_states[:]
 
@@ -128,6 +130,7 @@ def _setup_global_state_for_execution(
     (reference symbolic.py:155)."""
     global_state = transaction.initial_global_state()
     global_state.transaction_stack.append((transaction, None))
+    global_state.world_state.transaction_sequence.append(transaction)
     global_state.world_state.constraints.append(
         Or(*[transaction.caller == actor for actor in ACTORS.addresses.values()])
     )

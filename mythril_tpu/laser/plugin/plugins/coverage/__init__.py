@@ -1,0 +1,4 @@
+from mythril_tpu.laser.plugin.plugins.coverage.coverage_plugin import (  # noqa: F401
+    CoveragePluginBuilder,
+    InstructionCoveragePlugin,
+)

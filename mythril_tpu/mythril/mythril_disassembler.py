@@ -1,0 +1,201 @@
+"""MythrilDisassembler: input loading (reference:
+mythril/mythril/mythril_disassembler.py)."""
+
+import logging
+import os
+import re
+from typing import List, Optional, Tuple
+
+from mythril_tpu.disassembler.disassembly import Disassembly
+from mythril_tpu.exceptions import CriticalError, CompilerError
+from mythril_tpu.ethereum.util import solc_exists
+from mythril_tpu.smt import symbol_factory
+from mythril_tpu.solidity.evmcontract import EVMContract
+from mythril_tpu.support.crypto import keccak256
+from mythril_tpu.support.loader import DynLoader
+from mythril_tpu.support.signatures import SignatureDB
+
+log = logging.getLogger(__name__)
+
+
+class MythrilDisassembler:
+    def __init__(
+        self,
+        eth=None,
+        solc_version: str = None,
+        solc_settings_json: str = None,
+        enable_online_lookup: bool = False,
+    ) -> None:
+        self.solc_binary = self._init_solc_binary(solc_version)
+        self.solc_settings_json = solc_settings_json
+        self.eth = eth
+        self.enable_online_lookup = enable_online_lookup
+        self.sigs = SignatureDB(enable_online_lookup=enable_online_lookup)
+        self.contracts: List[EVMContract] = []
+
+    @staticmethod
+    def _init_solc_binary(version: Optional[str]) -> Optional[str]:
+        """Pick a solc binary (no downloads in this environment: a
+        matching binary must already be on PATH)."""
+        if not version:
+            return solc_exists("solc")
+        if version.startswith("v"):
+            version = version[1:]
+        for candidate in (f"solc-{version}", f"solc{version}", "solc"):
+            path = solc_exists(candidate)
+            if path:
+                return path
+        raise CriticalError(
+            f"No matching solc binary found for version {version}"
+        )
+
+    def load_from_bytecode(
+        self, code: str, bin_runtime: bool = False, address: Optional[str] = None
+    ) -> Tuple[str, EVMContract]:
+        if address is None:
+            address = "0x" + keccak256(code.encode()).hex()[:40]
+        code = code.removeprefix("0x").strip()
+        try:
+            bytes.fromhex(code)
+        except ValueError as e:
+            raise CriticalError(f"Input is not valid hex-encoded bytecode: {e}")
+        if bin_runtime:
+            self.contracts.append(
+                EVMContract(
+                    code=code,
+                    name="MAIN",
+                    enable_online_lookup=self.enable_online_lookup,
+                )
+            )
+        else:
+            self.contracts.append(
+                EVMContract(
+                    creation_code=code,
+                    name="MAIN",
+                    enable_online_lookup=self.enable_online_lookup,
+                )
+            )
+        return address, self.contracts[-1]
+
+    def load_from_address(self, address: str) -> Tuple[str, EVMContract]:
+        if not re.match(r"0x[a-fA-F0-9]{40}", address):
+            raise CriticalError(
+                "Invalid contract address. Expected format is '0x...'."
+            )
+        if self.eth is None:
+            raise CriticalError(
+                "Please check RPC connection: no client available."
+            )
+        try:
+            code = self.eth.eth_getCode(address)
+        except Exception as e:
+            raise CriticalError(f"IPC / RPC error: {e}")
+        if code == "0x" or code == "0x0":
+            raise CriticalError(
+                "Received an empty response from eth_getCode. "
+                "Check the contract address and verify your RPC is synced."
+            )
+        self.contracts.append(
+            EVMContract(
+                code=code,
+                name=address,
+                enable_online_lookup=self.enable_online_lookup,
+            )
+        )
+        return address, self.contracts[-1]
+
+    def load_from_solidity(self, solidity_files: List[str]):
+        """Compile and load .sol files (requires solc)."""
+        from mythril_tpu.solidity.soliditycontract import (
+            SolidityContract,
+            get_contracts_from_file,
+        )
+
+        address = "0x" + "0" * 40
+        contracts = []
+        for file in solidity_files:
+            if not os.path.exists(file.rsplit(":", 1)[0] if ":" in file else file):
+                raise CriticalError(f"Input file not found: {file}")
+            if ":" in file:
+                file, contract_name = file.rsplit(":", 1)
+            else:
+                contract_name = None
+            file = file.replace("~", "")  # fix npm path oddities
+            try:
+                if contract_name is not None:
+                    contract = SolidityContract(
+                        input_file=file,
+                        name=contract_name,
+                        solc_settings_json=self.solc_settings_json,
+                        solc_binary=self.solc_binary,
+                    )
+                    self.contracts.append(contract)
+                    contracts.append(contract)
+                else:
+                    for contract in get_contracts_from_file(
+                        file,
+                        solc_settings_json=self.solc_settings_json,
+                        solc_binary=self.solc_binary,
+                    ):
+                        self.contracts.append(contract)
+                        contracts.append(contract)
+            except FileNotFoundError:
+                raise CriticalError(f"Input file not found: {file}")
+            except CompilerError as e:
+                raise CriticalError(str(e))
+        return address, contracts
+
+    def get_state_variable_from_storage(
+        self, address: str, params: Optional[List[str]] = None
+    ) -> str:
+        """read-storage command: slot / slot,count / mapping probing
+        (reference mythril_disassembler.py)."""
+        params = params or []
+        position = 0
+        length = 1
+        mappings: List[int] = []
+        out = ""
+        try:
+            if params[0] == "mapping":
+                position = int(params[1])
+                for i in range(2, len(params)):
+                    key = bytes(params[i], "utf8")
+                    key_formatted = key.rjust(64, b"\x00")
+                    mappings.append(
+                        int.from_bytes(
+                            keccak256(
+                                key_formatted
+                                + position.to_bytes(32, byteorder="big")
+                            ),
+                            byteorder="big",
+                        )
+                    )
+                length = len(mappings)
+            else:
+                if len(params) >= 2:
+                    length = int(params[1])
+                if len(params) >= 1:
+                    position = int(params[0])
+        except (ValueError, IndexError):
+            raise CriticalError(
+                "Invalid storage index. Please provide a numeric value."
+            )
+        try:
+            if length == 1:
+                slot = mappings[0] if mappings else position
+                value = self.eth.eth_getStorageAt(address, slot)
+                out = f"{hex(slot)}: {value}"
+            else:
+                for i in range(length):
+                    slot = mappings[i] if mappings else position + i
+                    value = self.eth.eth_getStorageAt(address, slot)
+                    out += f"{hex(slot)}: {value}\n"
+        except AttributeError:
+            raise CriticalError("Cannot read storage: no RPC client configured.")
+        except Exception as e:
+            raise CriticalError(f"RPC error: {e}")
+        return out.rstrip()
+
+    @staticmethod
+    def hash_for_function_signature(sig: str) -> str:
+        return "0x" + keccak256(sig.encode()).hex()[:8]

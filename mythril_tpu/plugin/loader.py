@@ -1,0 +1,52 @@
+"""Mythril-level plugin loader (reference: mythril/plugin/loader.py):
+dispatches discovered plugins to the right registry (detection modules ->
+ModuleLoader, laser plugins -> LaserPluginLoader)."""
+
+import logging
+
+from mythril_tpu.analysis.module.base import DetectionModule
+from mythril_tpu.analysis.module.loader import ModuleLoader
+from mythril_tpu.laser.plugin.loader import LaserPluginLoader
+from mythril_tpu.plugin.discovery import PluginDiscovery
+from mythril_tpu.plugin.interface import MythrilLaserPlugin, MythrilPlugin
+from mythril_tpu.support.support_utils import Singleton
+
+log = logging.getLogger(__name__)
+
+
+class UnsupportedPluginType(Exception):
+    pass
+
+
+class MythrilPluginLoader(object, metaclass=Singleton):
+    def __init__(self):
+        log.info("Initializing mythril plugin loader")
+        self.loaded_plugins = []
+        self._load_default_enabled()
+
+    def load(self, plugin: MythrilPlugin) -> None:
+        if not isinstance(plugin, MythrilPlugin):
+            raise ValueError("Passed plugin is not of type MythrilPlugin")
+        log.info("Loading plugin: %s", plugin.name)
+        if isinstance(plugin, DetectionModule):
+            self._load_detection_module(plugin)
+        elif isinstance(plugin, MythrilLaserPlugin):
+            self._load_laser_plugin(plugin)
+        else:
+            raise UnsupportedPluginType("Unsupported plugin type")
+        self.loaded_plugins.append(plugin)
+        log.info("Finished loading plugin: %s", plugin.name)
+
+    @staticmethod
+    def _load_detection_module(plugin) -> None:
+        ModuleLoader().register_module(plugin)
+
+    @staticmethod
+    def _load_laser_plugin(plugin: MythrilLaserPlugin) -> None:
+        LaserPluginLoader().load(plugin)
+
+    def _load_default_enabled(self) -> None:
+        log.info("Loading installed analysis modules that are enabled by default")
+        for plugin_name in PluginDiscovery().get_plugins(default_enabled=True):
+            plugin = PluginDiscovery().build_plugin(plugin_name, {})
+            self.load(plugin)

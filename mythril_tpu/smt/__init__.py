@@ -70,6 +70,8 @@ def _anns(*xs) -> Set:
 
 
 class Bool(Expression):
+    __slots__ = ("_py_truth",)
+
     @property
     def is_false(self) -> bool:
         return self.node is T.FALSE
@@ -85,15 +87,27 @@ class Bool(Expression):
     def __bool__(self) -> bool:
         if self.node.is_const:
             return bool(self.node.value)
+        # z3py convention: bool() of a non-constant ==/!= expression
+        # answers *structural* equality of its operands (z3 ExprRef
+        # __bool__).  The answer is recorded at construction time by
+        # __eq__/__ne__ — inferring it from node shape is unsound because
+        # constant folding collapses e.g. biff(eq, FALSE) into bnot(eq).
+        truth = getattr(self, "_py_truth", None)
+        if truth is not None:
+            return truth
         raise TypeError("truth value of a symbolic Bool is undefined")
 
     def __eq__(self, other) -> "Bool":  # type: ignore[override]
         other = _to_bool(other)
-        return Bool(T.biff(self.node, other.node), _anns(self, other))
+        result = Bool(T.biff(self.node, other.node), _anns(self, other))
+        result._py_truth = self.node is other.node
+        return result
 
     def __ne__(self, other) -> "Bool":  # type: ignore[override]
         other = _to_bool(other)
-        return Bool(T.bxor(self.node, other.node), _anns(self, other))
+        result = Bool(T.bxor(self.node, other.node), _anns(self, other))
+        result._py_truth = self.node is not other.node
+        return result
 
     def __and__(self, other) -> "Bool":
         return And(self, _to_bool(other))
@@ -212,13 +226,17 @@ class BitVec(Expression):
         if other is None:
             return Bool(T.FALSE)
         a, b = _pad(self, other)
-        return Bool(T.eq(a.node, b.node), _anns(a, b))
+        result = Bool(T.eq(a.node, b.node), _anns(a, b))
+        result._py_truth = a.node is b.node
+        return result
 
     def __ne__(self, other) -> Bool:  # type: ignore[override]
         if other is None:
             return Bool(T.TRUE)
         a, b = _pad(self, other)
-        return Bool(T.bnot(T.eq(a.node, b.node)), _anns(a, b))
+        result = Bool(T.bnot(T.eq(a.node, b.node)), _anns(a, b))
+        result._py_truth = a.node is not b.node
+        return result
 
     def __hash__(self) -> int:
         return hash(self.node.id)

@@ -1,0 +1,46 @@
+"""DynLoader: lazy on-chain code/storage/balance access (reference:
+mythril/support/loader.py)."""
+
+import functools
+import logging
+from typing import Optional
+
+from mythril_tpu.disassembler.disassembly import Disassembly
+
+log = logging.getLogger(__name__)
+
+
+class DynLoader:
+    def __init__(self, eth, active: bool = True):
+        self.eth = eth
+        self.active = active
+
+    @functools.lru_cache(2**10)
+    def read_storage(self, contract_address: str, index: int) -> str:
+        if not self.active:
+            raise ValueError("Loader is disabled")
+        if not self.eth:
+            raise ValueError("Cannot load from the storage when eth is None")
+        return self.eth.eth_getStorageAt(
+            contract_address, position=index, block="latest"
+        )
+
+    @functools.lru_cache(2**10)
+    def read_balance(self, address: str) -> int:
+        if not self.active:
+            raise ValueError("Loader is disabled")
+        if not self.eth:
+            raise ValueError("Cannot load from the chain when eth is None")
+        return self.eth.eth_getBalance(address)
+
+    @functools.lru_cache(2**10)
+    def dynld(self, dependency_address: str) -> Optional[Disassembly]:
+        if not self.active:
+            raise ValueError("Loader is disabled")
+        if not self.eth:
+            raise ValueError("Cannot load from the chain when eth is None")
+        log.debug("Dynld at contract %s", dependency_address)
+        code = self.eth.eth_getCode(dependency_address)
+        if code == "0x":
+            return None
+        return Disassembly(code)
