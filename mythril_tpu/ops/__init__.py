@@ -1,0 +1,8 @@
+"""Batched TPU kernels: the compute path that replaces serial Z3 dispatch.
+
+- ``batched_sat``: lockstep BCP + randomized probing over an HBM-resident
+  shared clause pool — decides whole frontiers of path-feasibility
+  queries per device step (see BASELINE.json north star).
+- ``u256``: 8x32-bit limb arithmetic primitives for batched EVM state
+  stepping (used by later rounds' lockstep interpreter).
+"""

@@ -1,0 +1,376 @@
+"""Batched SAT over a shared clause pool — the TPU solving core.
+
+Design (idiomatic XLA, no data-dependent Python control flow inside jit):
+
+- The bit-blaster's clause pool is SHARED by all lanes (every
+  path-feasibility query activates a subset via assumption literals),
+  so the pool uploads once per version as a dense ``[C, K]`` int32
+  matrix in HBM; per-lane state is only the assignment vector
+  ``[B, V+1]`` in {-1 (false), 0 (unknown), +1 (true)}.
+
+- One jitted step = full Boolean constraint propagation to fixpoint
+  (``lax.while_loop`` over a vectorized clause scan + scatter-max of
+  forced literals), then one randomized decision per undecided lane.
+  Conflicts discovered with *zero decisions taken* are sound UNSAT
+  verdicts (propagation from a clause subset cannot create false
+  conflicts).  Completed assignments are verified on the host against
+  the original term constraints before being trusted as SAT — so
+  clauses wider than K may be dropped from the device pool without
+  soundness loss.
+
+- Lanes that neither conflict immediately nor verify within the probe
+  budget fall through to the native CDCL (the authoritative tail).
+
+Sharding: the lane axis is data-parallel; ``parallel.mesh`` shards
+``[B, V+1]`` across devices while the clause pool is replicated
+(broadcast once over ICI) — see parallel/mesh.py.
+"""
+
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+MAX_CLAUSE_WIDTH = 8  # wider clauses stay CPU-only (soundness preserved)
+PROPAGATE_ITERS = 256  # BCP fixpoint cap per decision round
+DECISION_ROUNDS = 24  # probing depth before handing the lane to CDCL
+
+
+def _require_jax():
+    import jax
+    import jax.numpy as jnp
+
+    return jax, jnp
+
+
+class DevicePool:
+    """Device-resident dense clause matrix, refreshed on pool growth."""
+
+    def __init__(self):
+        self.version = -1
+        self.lits = None        # [C, K] int32 (signed, 0 = pad)
+        self.num_vars = 0
+        self.num_clauses = 0
+        self.dropped = 0
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        """Round up to a power of two so device shapes stay stable while
+        the pool grows (avoids re-jitting every refresh)."""
+        size = 256
+        while size < n:
+            size *= 2
+        return size
+
+    def refresh(self, clauses_py: Sequence[Tuple[int, ...]], num_vars: int):
+        _, jnp = _require_jax()
+        rows = []
+        dropped = 0
+        for clause in clauses_py:
+            if len(clause) > MAX_CLAUSE_WIDTH:
+                dropped += 1
+                continue
+            rows.append(
+                list(clause) + [0] * (MAX_CLAUSE_WIDTH - len(clause))
+            )
+        if not rows:
+            rows = [[0] * MAX_CLAUSE_WIDTH]
+        # pad clause count to the bucket with inert all-zero rows
+        target_c = self._bucket(len(rows))
+        rows.extend([[0] * MAX_CLAUSE_WIDTH] * (target_c - len(rows)))
+        self.lits = jnp.asarray(np.asarray(rows, dtype=np.int32))
+        self.num_vars = self._bucket(num_vars)
+        self.num_clauses = target_c
+        self.dropped = dropped
+
+
+def make_solve_step(num_vars: int):
+    """Build the jitted lockstep solve function for a fixed var count.
+
+    Returns fn(lits[C,K], assign[B,V+1], key) ->
+      (assign', status[B]) with status 0=undecided 1=sat-candidate
+      2=conflict-without-decision.
+    """
+    jax, jnp = _require_jax()
+
+    V1 = num_vars + 1
+
+    def clause_scan(lits, assign_lane):
+        # lit value: +1 sat, -1 false, 0 unknown; padding counts false
+        var_idx = jnp.abs(lits)                       # [C, K]
+        vals = jnp.sign(lits) * assign_lane[var_idx]  # [C, K]
+        is_real = lits != 0
+        sat = jnp.any((vals > 0) & is_real, axis=1)           # [C]
+        num_unknown = jnp.sum((vals == 0) & is_real, axis=1)  # [C]
+        all_false = jnp.all((vals < 0) | ~is_real, axis=1) & jnp.any(
+            is_real, axis=1
+        )
+        conflict = jnp.any(all_false)
+        # unit clauses: exactly one unknown literal and not satisfied
+        unit = (~sat) & (num_unknown == 1)
+        unknown_here = (vals == 0) & is_real
+        # the single unknown literal of each unit clause
+        forced_lit = jnp.sum(
+            jnp.where(unit[:, None] & unknown_here, lits, 0), axis=1
+        )  # [C]
+        forced_pos = jnp.zeros(V1, dtype=jnp.int8).at[
+            jnp.where(forced_lit > 0, forced_lit, 0)
+        ].max(jnp.where(forced_lit > 0, jnp.int8(1), jnp.int8(0)))
+        forced_neg = jnp.zeros(V1, dtype=jnp.int8).at[
+            jnp.where(forced_lit < 0, -forced_lit, 0)
+        ].max(jnp.where(forced_lit < 0, jnp.int8(1), jnp.int8(0)))
+        # contradictory forcing is also a conflict
+        conflict = conflict | jnp.any((forced_pos & forced_neg)[1:] == 1)
+        delta = forced_pos.astype(jnp.int8) - forced_neg.astype(jnp.int8)
+        new_assign = jnp.where(
+            assign_lane == 0, delta, assign_lane
+        ).astype(jnp.int8)
+        progressed = jnp.any(new_assign != assign_lane)
+        return new_assign, conflict, progressed, sat
+
+    def propagate(lits, assign_lane):
+        def body(carry):
+            assign_lane, _, _, i = carry
+            new_assign, conflict, progressed, _ = clause_scan(
+                lits, assign_lane
+            )
+            return (new_assign, conflict, progressed, i + 1)
+
+        def cond(carry):
+            _, conflict, progressed, i = carry
+            return (~conflict) & progressed & (i < PROPAGATE_ITERS)
+
+        assign_lane, conflict, _, _ = jax.lax.while_loop(
+            cond, body, (assign_lane, False, True, 0)
+        )
+        return assign_lane, conflict
+
+    def decide(assign_lane, key):
+        # lowest-index unassigned variable (input bits are allocated
+        # before the gates that consume them), random phase
+        unassigned = (assign_lane == 0).at[0].set(False)
+        any_open = jnp.any(unassigned)
+        var = jnp.argmax(unassigned)  # first True
+        phase = jnp.where(
+            jax.random.bernoulli(key), jnp.int8(1), jnp.int8(-1)
+        )
+        return (
+            jnp.where(
+                any_open, assign_lane.at[var].set(phase), assign_lane
+            ),
+            any_open,
+        )
+
+    def solve_lane(lits, assign_lane, key):
+        # round 0: pure propagation — conflict here is sound UNSAT
+        assign_lane, conflict0 = propagate(lits, assign_lane)
+
+        def round_body(i, carry):
+            assign_lane, done = carry
+            subkey = jax.random.fold_in(key, i)
+            new_assign, any_open = decide(assign_lane, subkey)
+            new_assign, conflict = propagate(lits, new_assign)
+            # On conflict, revert the round (no learning): a later round
+            # may pick the opposite phase.  Lanes are never "complete"
+            # (the clause pool is shared, so foreign vars stay open);
+            # SAT detection happens on the host by evaluating the
+            # original terms under the propagated partial assignment.
+            new_done = done | ~any_open
+            keep = jnp.where(conflict | done, assign_lane, new_assign)
+            return (keep, new_done)
+
+        assign_lane, _ = jax.lax.fori_loop(
+            0, DECISION_ROUNDS, round_body, (assign_lane, conflict0)
+        )
+        status = jnp.where(conflict0, 2, 0)
+        return assign_lane, status
+
+    batched = jax.vmap(solve_lane, in_axes=(None, 0, 0))
+    return jax.jit(batched)
+
+
+class BatchedSatBackend:
+    """Host-side orchestration of the device lockstep solver."""
+
+    def __init__(self):
+        self.pool = DevicePool()
+        self._step_cache: Dict[int, object] = {}
+        self._seed = 0
+
+    def check_assumption_sets(
+        self, ctx, assumption_sets: List[List[int]]
+    ) -> List[Optional[bool]]:
+        """For each assumption set over ctx's clause pool return
+        True (verified SAT candidate assignment), False (sound UNSAT), or
+        None (undecided — caller falls back to CDCL).
+
+        The returned SAT verdicts are *candidates*: the caller must
+        verify the model against the original constraints (we only
+        guarantee consistency with the device-resident clause subset).
+        """
+        jax, jnp = _require_jax()
+        num_vars = ctx.solver.num_vars
+        if self.pool.version != ctx.pool_version or (
+            self.pool.num_vars < num_vars
+        ):
+            self.pool.refresh(ctx.clauses_py, num_vars)
+            self.pool.version = ctx.pool_version
+
+        batch = len(assumption_sets)
+        V1 = self.pool.num_vars + 1
+        assign = np.zeros((batch, V1), dtype=np.int8)
+        assign[:, 1] = 1  # constant-TRUE anchor
+        for lane, assumptions in enumerate(assumption_sets):
+            for lit in assumptions:
+                var = abs(lit)
+                if var < V1:
+                    assign[lane, var] = 1 if lit > 0 else -1
+
+        step = self._step_cache.get(self.pool.num_vars)
+        if step is None:
+            step = make_solve_step(self.pool.num_vars)
+            self._step_cache = {self.pool.num_vars: step}
+
+        self._seed += 1
+        keys = jax.random.split(
+            jax.random.PRNGKey(self._seed), batch
+        )
+        final_assign, status = step(
+            self.pool.lits, jnp.asarray(assign), keys
+        )
+        status = np.asarray(status)
+        final_assign = np.asarray(final_assign)
+
+        results: List[Optional[bool]] = []
+        self.last_assignments = final_assign
+        for lane in range(batch):
+            if status[lane] == 2:
+                results.append(False)
+            else:
+                results.append(None)  # candidate: host verifies the model
+        return results
+
+    @staticmethod
+    def _max_var(ctx) -> int:
+        max_var = 1
+        for clause in ctx.clauses_py:
+            for lit in clause:
+                max_var = max(max_var, abs(lit))
+        return max_var
+
+
+_backend: Optional[BatchedSatBackend] = None
+
+
+def get_backend() -> BatchedSatBackend:
+    global _backend
+    if _backend is None:
+        _backend = BatchedSatBackend()
+    return _backend
+
+
+def batch_check_states(constraint_sets) -> List[Optional[bool]]:
+    """Feasibility verdicts for a frontier of constraint sets.
+
+    True = SAT (model verified against the term constraints),
+    False = UNSAT (sound), None = undecided (caller uses CDCL).
+    """
+    from mythril_tpu.smt import terms as T
+    from mythril_tpu.smt.solver import get_blast_context
+
+    ctx = get_blast_context()
+    assumption_sets: List[Optional[List[int]]] = []
+    decided: List[Optional[bool]] = [None] * len(constraint_sets)
+
+    for i, constraints in enumerate(constraint_sets):
+        lits = []
+        falsy = False
+        for c in constraints:
+            if isinstance(c, bool):
+                if not c:
+                    falsy = True
+                    break
+                continue
+            node = c.raw if hasattr(c, "raw") else c
+            if node is T.FALSE:
+                falsy = True
+                break
+            if node is T.TRUE:
+                continue
+            lits.append(ctx.blast_lit(node))
+        if falsy:
+            decided[i] = False
+            assumption_sets.append(None)
+        else:
+            assumption_sets.append(lits)
+
+    open_indices = [i for i, d in enumerate(decided) if d is None]
+    if not open_indices:
+        return decided
+
+    backend = get_backend()
+    verdicts = backend.check_assumption_sets(
+        ctx, [assumption_sets[i] for i in open_indices]
+    )
+
+    for lane, i in enumerate(open_indices):
+        verdict = verdicts[lane]
+        if verdict is False:
+            decided[i] = False
+            continue
+        # candidate lane: verify the (possibly partial) assignment by
+        # evaluating the original terms; unassigned leaves default 0
+        env = _env_from_assignment(ctx, backend.last_assignments[lane])
+        ok = True
+        for c in constraint_sets[i]:
+            node = c.raw if hasattr(c, "raw") else c
+            if isinstance(node, bool):
+                continue
+            if T.evaluate(node, env) is not True:
+                ok = False
+                break
+        decided[i] = True if ok else None
+    return decided
+
+
+def _env_from_assignment(ctx, assignment: np.ndarray):
+    """Build an EvalEnv from a device assignment vector (mirrors
+    BlastContext._extract_model but reads array values)."""
+    from mythril_tpu.smt import terms as T
+
+    def bit_of(lit: int) -> int:
+        if lit == 1:
+            return 1
+        if lit == -1:
+            return 0
+        value = assignment[abs(lit)] if abs(lit) < len(assignment) else 0
+        bit = 1 if value > 0 else 0
+        return bit if lit > 0 else 1 - bit
+
+    env = T.EvalEnv()
+    for node_id, bits in ctx.var_bits.items():
+        value = 0
+        for i, lit in enumerate(bits):
+            value |= bit_of(lit) << i
+        env.variables[node_id] = value
+    for node_id, lit in ctx.bool_var_lits.items():
+        env.variables[node_id] = bool(bit_of(lit))
+    for _ in range(3):
+        for base_id, reads in ctx.array_reads.items():
+            table = env.arrays.setdefault(base_id, {})
+            for idx_node, bits in reads:
+                idx_val = T.evaluate(idx_node, env)
+                value = 0
+                for i, lit in enumerate(bits):
+                    value |= bit_of(lit) << i
+                table[idx_val] = value
+        for func_id, apps in ctx.uf_apps.items():
+            for args, bits in apps:
+                arg_vals = tuple(T.evaluate(a, env) for a in args)
+                value = 0
+                for i, lit in enumerate(bits):
+                    value |= bit_of(lit) << i
+                env.ufs[(func_id, arg_vals)] = value
+    return env

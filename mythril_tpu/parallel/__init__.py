@@ -1,0 +1,10 @@
+"""Device-mesh parallelism for the batched solver and corpus analysis.
+
+The reference is single-process/single-threaded (SURVEY.md §2.16); this
+package is specified from the TPU north star instead of ported:
+
+- ``mesh``: 2-D mesh (``dp`` lanes x ``cp`` clause shards).  Frontier
+  lanes are data-parallel; the clause pool is sharded over ``cp`` with
+  per-iteration ``psum`` merges of forced literals — propagation over a
+  pool larger than one chip's HBM rides ICI collectives.
+"""

@@ -1,0 +1,174 @@
+"""Sharded lockstep SAT propagation over a jax.sharding.Mesh.
+
+Layout:
+- ``dp`` axis: frontier lanes (assignment vectors) — pure data parallel.
+- ``cp`` axis: the clause pool is sharded row-wise; each device scans
+  its clause shard and the per-variable forced-literal vectors and
+  conflict flags are combined with ``lax.psum`` over ``cp`` each BCP
+  iteration.  This is the collective clause-exchange component from
+  BASELINE.json: state that prunes one lane's search propagates to
+  every chip holding part of the pool.
+
+``dryrun_multichip`` in __graft_entry__.py builds this mesh on N virtual
+devices and executes one full training-equivalent step (frontier
+feasibility solve) end to end.
+"""
+
+import logging
+from functools import partial
+from typing import Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+PROPAGATE_ITERS = 64
+DECISION_ROUNDS = 8
+
+
+def build_mesh(n_devices: int = None, dp: int = None, cp: int = None):
+    """Build a dp x cp mesh over the available (or first n) devices."""
+    import jax
+
+    devices = jax.devices()[: n_devices or len(jax.devices())]
+    count = len(devices)
+    if dp is None or cp is None:
+        # favor lane parallelism; clause sharding gets the rest
+        dp = 1
+        while dp * 2 <= count and (count // (dp * 2)) * (dp * 2) == count:
+            dp *= 2
+        cp = count // dp
+    mesh_devices = np.asarray(devices).reshape(dp, cp)
+    from jax.sharding import Mesh
+
+    return Mesh(mesh_devices, ("dp", "cp"))
+
+
+def make_sharded_solve(mesh, num_vars: int):
+    """Jitted sharded solve: lits[C,K] sharded over cp rows, assign
+    [B,V+1] sharded over dp, keys[B,2] over dp."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    V1 = num_vars + 1
+
+    def clause_scan_local(lits, assign_lane):
+        var_idx = jnp.abs(lits)
+        vals = jnp.sign(lits) * assign_lane[var_idx]
+        is_real = lits != 0
+        sat = jnp.any((vals > 0) & is_real, axis=1)
+        num_unknown = jnp.sum((vals == 0) & is_real, axis=1)
+        all_false = jnp.all((vals < 0) | ~is_real, axis=1) & jnp.any(
+            is_real, axis=1
+        )
+        local_conflict = jnp.any(all_false)
+        unit = (~sat) & (num_unknown == 1)
+        unknown_here = (vals == 0) & is_real
+        forced_lit = jnp.sum(
+            jnp.where(unit[:, None] & unknown_here, lits, 0), axis=1
+        )
+        forced_pos = jnp.zeros(V1, dtype=jnp.int32).at[
+            jnp.where(forced_lit > 0, forced_lit, 0)
+        ].max(jnp.where(forced_lit > 0, 1, 0))
+        forced_neg = jnp.zeros(V1, dtype=jnp.int32).at[
+            jnp.where(forced_lit < 0, -forced_lit, 0)
+        ].max(jnp.where(forced_lit < 0, 1, 0))
+        return forced_pos, forced_neg, local_conflict
+
+    def propagate(lits, assign_lane):
+        def body(carry):
+            assign_lane, _, _, i = carry
+            pos, neg, local_conflict = clause_scan_local(lits, assign_lane)
+            # merge forced literals + conflicts across the clause shards
+            pos = jax.lax.psum(pos, "cp")
+            neg = jax.lax.psum(neg, "cp")
+            conflict = (
+                jax.lax.psum(local_conflict.astype(jnp.int32), "cp") > 0
+            )
+            conflict = conflict | jnp.any((pos * neg)[1:] > 0)
+            delta = jnp.sign(pos - neg).astype(jnp.int8)
+            new_assign = jnp.where(assign_lane == 0, delta, assign_lane)
+            progressed = jnp.any(new_assign != assign_lane)
+            return (new_assign, conflict, progressed, i + 1)
+
+        def cond(carry):
+            _, conflict, progressed, i = carry
+            return (~conflict) & progressed & (i < PROPAGATE_ITERS)
+
+        assign_lane, conflict, _, _ = jax.lax.while_loop(
+            cond, body, (assign_lane, False, True, 0)
+        )
+        return assign_lane, conflict
+
+    def solve_lane(lits, assign_lane, key):
+        assign_lane, conflict0 = propagate(lits, assign_lane)
+
+        def round_body(i, carry):
+            assign_lane, done = carry
+            subkey = jax.random.fold_in(key, i)
+            unassigned = (assign_lane == 0).at[0].set(False)
+            any_open = jnp.any(unassigned)
+            var = jnp.argmax(unassigned)
+            phase = jnp.where(
+                jax.random.bernoulli(subkey), jnp.int8(1), jnp.int8(-1)
+            )
+            candidate = jnp.where(
+                any_open, assign_lane.at[var].set(phase), assign_lane
+            )
+            candidate, conflict = propagate(lits, candidate)
+            keep = jnp.where(conflict | done, assign_lane, candidate)
+            return (keep, done | ~any_open)
+
+        assign_lane, _ = jax.lax.fori_loop(
+            0, DECISION_ROUNDS, round_body, (assign_lane, conflict0)
+        )
+        return assign_lane, jnp.where(conflict0, 2, 0)
+
+    def solve_shard(lits_shard, assign_shard, keys_shard):
+        # vmap over the local lanes; clause shard shared per device
+        return jax.vmap(solve_lane, in_axes=(None, 0, 0))(
+            lits_shard, assign_shard, keys_shard
+        )
+
+    sharded = shard_map(
+        solve_shard,
+        mesh=mesh,
+        in_specs=(P("cp", None), P("dp", None), P("dp")),
+        out_specs=(P("dp", None), P("dp")),
+        check_rep=False,
+    )
+    return jax.jit(sharded)
+
+
+def sharded_frontier_solve(
+    mesh, lits: np.ndarray, assign: np.ndarray, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Solve a frontier batch on the mesh; pads lanes to the dp size and
+    clause rows to the cp size."""
+    import jax
+    import jax.numpy as jnp
+
+    dp = mesh.shape["dp"]
+    cp = mesh.shape["cp"]
+    batch = assign.shape[0]
+    pad_lanes = (-batch) % dp
+    if pad_lanes:
+        assign = np.concatenate(
+            [assign, np.zeros((pad_lanes, assign.shape[1]), np.int8)]
+        )
+    pad_rows = (-lits.shape[0]) % cp
+    if pad_rows:
+        lits = np.concatenate(
+            [lits, np.zeros((pad_rows, lits.shape[1]), np.int32)]
+        )
+    keys = jax.random.split(jax.random.PRNGKey(seed), assign.shape[0])
+    solve = make_sharded_solve(mesh, assign.shape[1] - 1)
+    final_assign, status = solve(
+        jnp.asarray(lits), jnp.asarray(assign), keys
+    )
+    return (
+        np.asarray(final_assign)[:batch],
+        np.asarray(status)[:batch],
+    )

@@ -37,6 +37,10 @@ def _const_bits(value: int, width: int) -> List[int]:
 class BlastContext:
     def __init__(self):
         self.solver = SatSolver()
+        # host-side mirror of the clause pool for the batched TPU backend
+        # (the native solver owns its own copy); list of literal tuples
+        self.clauses_py: List[Tuple[int, ...]] = []
+        self.pool_version = 0
         self.bits_cache: Dict[int, List[int]] = {}
         self.lit_cache: Dict[int, int] = {}
         self.gate_cache: Dict[Tuple, int] = {}
@@ -52,6 +56,8 @@ class BlastContext:
 
     def _clause(self, lits: Sequence[int]) -> None:
         self.solver.add_clause(lits)
+        self.clauses_py.append(tuple(lits))
+        self.pool_version += 1
         self.clause_count += 1
 
     def new_lit(self) -> int:

@@ -336,6 +336,17 @@ def concat(parts: List[Node]) -> Node:
             merged.append(p)
     if len(merged) == 1:
         return merged[0]
+    # concat of contiguous extracts over one base collapses back into a
+    # single extract (mstore/mload word roundtrips hit this constantly)
+    if all(p.op == "extract" for p in merged):
+        base = merged[0].args[0]
+        if all(p.args[0] is base for p in merged):
+            contiguous = all(
+                merged[i].params[1] == merged[i + 1].params[0] + 1
+                for i in range(len(merged) - 1)
+            )
+            if contiguous:
+                return extract(merged[0].params[0], merged[-1].params[1], base)
     width = sum(p.width for p in merged)
     return _I.get("concat", tuple(merged), (), width)
 

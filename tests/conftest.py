@@ -16,6 +16,15 @@ if "host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The axon TPU plugin ignores JAX_PLATFORMS; jax.config wins.  Import is
+# deferred so the XLA_FLAGS above are seen at backend initialization.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REFERENCE_ROOT = "/root/reference"

@@ -1,0 +1,121 @@
+"""State-model unit tests (reference: tests/laser/state/)."""
+
+import pytest
+
+from mythril_tpu.disassembler.disassembly import Disassembly
+from mythril_tpu.laser.ethereum.evm_exceptions import (
+    StackOverflowException,
+    StackUnderflowException,
+)
+from mythril_tpu.laser.ethereum.state.account import Account, Storage
+from mythril_tpu.laser.ethereum.state.calldata import (
+    BasicConcreteCalldata,
+    BasicSymbolicCalldata,
+    ConcreteCalldata,
+    SymbolicCalldata,
+)
+from mythril_tpu.laser.ethereum.state.machine_state import MachineStack, MachineState
+from mythril_tpu.laser.ethereum.state.memory import Memory
+from mythril_tpu.laser.ethereum.state.world_state import WorldState
+from mythril_tpu.smt import symbol_factory
+
+
+def test_machine_stack_limits():
+    stack = MachineStack()
+    for i in range(1023):
+        stack.append(i)
+    with pytest.raises(StackOverflowException):
+        stack.append(1)
+    stack2 = MachineStack()
+    with pytest.raises(StackUnderflowException):
+        stack2.pop()
+    with pytest.raises(StackUnderflowException):
+        stack2[-1]
+
+
+def test_mem_extend_charges_gas():
+    state = MachineState(gas_limit=8_000_000)
+    assert state.memory_size == 0
+    state.mem_extend(0, 32)
+    assert state.memory_size == 32
+    gas_after_one_word = state.min_gas_used
+    assert gas_after_one_word == 3  # 1 word linear cost
+    state.mem_extend(0, 32)  # no growth, no charge
+    assert state.min_gas_used == gas_after_one_word
+
+
+def test_memory_word_roundtrip():
+    memory = Memory()
+    memory.extend(64)
+    memory.write_word_at(0, 0xDEADBEEF)
+    assert memory.get_word_at(0).value == 0xDEADBEEF
+    sym = symbol_factory.BitVecSym("memword", 256)
+    memory.write_word_at(32, sym)
+    assert memory.get_word_at(32).raw is sym.raw
+
+
+def test_concrete_calldata():
+    calldata = ConcreteCalldata("1", [1, 2, 3, 4])
+    assert calldata.size == 4
+    assert calldata[2].value == 3
+    word = calldata.get_word_at(0)
+    assert word.value == int.from_bytes(bytes([1, 2, 3, 4] + [0] * 28), "big")
+    assert calldata.concrete(None) == [1, 2, 3, 4]
+
+
+def test_symbolic_calldata_oob_reads_zero():
+    from mythril_tpu.smt.solver import Solver, sat
+
+    calldata = SymbolicCalldata("7")
+    value = calldata[symbol_factory.BitVecVal(10, 256)]
+    s = Solver()
+    s.add(calldata.calldatasize == 5)
+    # read at 10 with size 5 must be 0
+    s.add(value == 0)
+    assert s.check() is sat
+    s2 = Solver()
+    s2.add(calldata.calldatasize == 5)
+    s2.add(value == 9)
+    from mythril_tpu.smt.solver import unsat
+
+    assert s2.check() is unsat
+
+
+def test_basic_calldata_variants():
+    concrete = BasicConcreteCalldata("1", [9, 8, 7])
+    assert concrete[1] == 8
+    symbolic = BasicSymbolicCalldata("2")
+    v = symbolic[symbol_factory.BitVecVal(0, 256)]
+    assert v.size == 8
+
+
+def test_storage_concrete_vs_symbolic_defaults():
+    concrete = Storage(concrete=True, address=symbol_factory.BitVecVal(1, 256))
+    assert concrete[symbol_factory.BitVecVal(5, 256)].value == 0
+    symbolic = Storage(concrete=False, address=symbol_factory.BitVecVal(1, 256))
+    assert symbolic[symbol_factory.BitVecVal(5, 256)].value is None
+
+
+def test_world_state_copy_isolates_accounts():
+    ws = WorldState()
+    account = ws.create_account(
+        balance=100, address=0x42, concrete_storage=True, code=Disassembly("00")
+    )
+    account.storage[symbol_factory.BitVecVal(0, 256)] = symbol_factory.BitVecVal(
+        7, 256
+    )
+    import copy as copy_module
+
+    ws2 = copy_module.copy(ws)
+    ws2.accounts[0x42].storage[
+        symbol_factory.BitVecVal(0, 256)
+    ] = symbol_factory.BitVecVal(9, 256)
+    assert ws.accounts[0x42].storage[symbol_factory.BitVecVal(0, 256)].value == 7
+    assert ws2.accounts[0x42].storage[symbol_factory.BitVecVal(0, 256)].value == 9
+
+
+def test_world_state_autocreates_accounts():
+    ws = WorldState()
+    account = ws[symbol_factory.BitVecVal(0x1234, 256)]
+    assert account.address.value == 0x1234
+    assert 0x1234 in ws.accounts
