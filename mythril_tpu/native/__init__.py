@@ -108,9 +108,12 @@ class SatSolver:
         self.num_vars = max(self.num_vars, var)
         return var
 
-    def add_clause(self, lits: Sequence[int]) -> None:
+    def add_clause(self, lits: Sequence[int]) -> bool:
+        """False when the clause makes the instance trivially UNSAT."""
         arr = (ctypes.c_int32 * len(lits))(*lits)
-        self._lib.cdcl_add_clause(self._handle, arr, len(lits))
+        return bool(
+            self._lib.cdcl_add_clause(self._handle, arr, len(lits))
+        )
 
     def solve(
         self,

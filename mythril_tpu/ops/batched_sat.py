@@ -86,12 +86,21 @@ class DevicePool:
         self.dropped = dropped
 
 
-def make_solve_step(num_vars: int):
-    """Build the jitted lockstep solve function for a fixed var count.
+def build_solve_lane(
+    num_vars: int,
+    reduce_hook=None,
+    propagate_iters: int = PROPAGATE_ITERS,
+    decision_rounds: int = DECISION_ROUNDS,
+):
+    """Build the per-lane gather-style solve function (traceable).
 
-    Returns fn(lits[C,K], assign[B,V+1], key) ->
-      (assign', status[B]) with status 0=undecided 1=sat-candidate
-      2=conflict-without-decision.
+    ``solve_lane(lits[C,K], assign[V+1], key) -> (assign', status)``
+    with status 0 = undecided, 2 = conflict-without-decision (sound
+    UNSAT).  This single definition backs both the single-chip jit path
+    (``make_solve_step``) and the mesh-sharded path
+    (parallel/mesh.py), which passes a ``reduce_hook(pos, neg,
+    conflict)`` merging forced-literal votes and conflict flags across
+    clause shards (psum over the ``cp`` mesh axis).
     """
     jax, jnp = _require_jax()
 
@@ -115,32 +124,32 @@ def make_solve_step(num_vars: int):
         forced_lit = jnp.sum(
             jnp.where(unit[:, None] & unknown_here, lits, 0), axis=1
         )  # [C]
-        forced_pos = jnp.zeros(V1, dtype=jnp.int8).at[
+        forced_pos = jnp.zeros(V1, dtype=jnp.int32).at[
             jnp.where(forced_lit > 0, forced_lit, 0)
-        ].max(jnp.where(forced_lit > 0, jnp.int8(1), jnp.int8(0)))
-        forced_neg = jnp.zeros(V1, dtype=jnp.int8).at[
+        ].max(jnp.where(forced_lit > 0, 1, 0))
+        forced_neg = jnp.zeros(V1, dtype=jnp.int32).at[
             jnp.where(forced_lit < 0, -forced_lit, 0)
-        ].max(jnp.where(forced_lit < 0, jnp.int8(1), jnp.int8(0)))
-        # contradictory forcing is also a conflict
-        conflict = conflict | jnp.any((forced_pos & forced_neg)[1:] == 1)
-        delta = forced_pos.astype(jnp.int8) - forced_neg.astype(jnp.int8)
-        new_assign = jnp.where(
-            assign_lane == 0, delta, assign_lane
-        ).astype(jnp.int8)
-        progressed = jnp.any(new_assign != assign_lane)
-        return new_assign, conflict, progressed, sat
+        ].max(jnp.where(forced_lit < 0, 1, 0))
+        return forced_pos, forced_neg, conflict
 
     def propagate(lits, assign_lane):
         def body(carry):
             assign_lane, _, _, i = carry
-            new_assign, conflict, progressed, _ = clause_scan(
-                lits, assign_lane
-            )
+            pos, neg, conflict = clause_scan(lits, assign_lane)
+            if reduce_hook is not None:
+                pos, neg, conflict = reduce_hook(pos, neg, conflict)
+            # contradictory forcing is also a conflict
+            conflict = conflict | jnp.any((pos * neg)[1:] > 0)
+            delta = jnp.sign(pos - neg).astype(jnp.int8)
+            new_assign = jnp.where(
+                assign_lane == 0, delta, assign_lane
+            ).astype(jnp.int8)
+            progressed = jnp.any(new_assign != assign_lane)
             return (new_assign, conflict, progressed, i + 1)
 
         def cond(carry):
             _, conflict, progressed, i = carry
-            return (~conflict) & progressed & (i < PROPAGATE_ITERS)
+            return (~conflict) & progressed & (i < propagate_iters)
 
         assign_lane, conflict, _, _ = jax.lax.while_loop(
             cond, body, (assign_lane, False, True, 0)
@@ -182,12 +191,20 @@ def make_solve_step(num_vars: int):
             return (keep, new_done)
 
         assign_lane, _ = jax.lax.fori_loop(
-            0, DECISION_ROUNDS, round_body, (assign_lane, conflict0)
+            0, decision_rounds, round_body, (assign_lane, conflict0)
         )
         status = jnp.where(conflict0, 2, 0)
         return assign_lane, status
 
-    batched = jax.vmap(solve_lane, in_axes=(None, 0, 0))
+    return solve_lane
+
+
+def make_solve_step(num_vars: int):
+    """Jitted single-chip lockstep solve over the whole lane batch:
+    fn(lits[C,K], assign[B,V+1], keys[B,2]) -> (assign', status[B])."""
+    jax, _ = _require_jax()
+
+    batched = jax.vmap(build_solve_lane(num_vars), in_axes=(None, 0, 0))
     return jax.jit(batched)
 
 
@@ -210,6 +227,18 @@ class BatchedSatBackend:
         verify the model against the original constraints (we only
         guarantee consistency with the device-resident clause subset).
         """
+        from mythril_tpu.ops.pallas_prop import get_pallas_backend
+
+        pallas = get_pallas_backend()
+        if pallas.available_for(ctx):
+            # fused MXU kernel: dense incidence matmuls, whole loop in
+            # VMEM, no clause-width cap (see ops/pallas_prop.py)
+            results, assignments = pallas.check_assumption_sets(
+                ctx, assumption_sets
+            )
+            self.last_assignments = assignments
+            return results
+
         jax, jnp = _require_jax()
         num_vars = ctx.solver.num_vars
         if self.pool.version != ctx.pool_version or (
@@ -251,15 +280,6 @@ class BatchedSatBackend:
             else:
                 results.append(None)  # candidate: host verifies the model
         return results
-
-    @staticmethod
-    def _max_var(ctx) -> int:
-        max_var = 1
-        for clause in ctx.clauses_py:
-            for lit in clause:
-                max_var = max(max_var, abs(lit))
-        return max_var
-
 
 _backend: Optional[BatchedSatBackend] = None
 

@@ -1,0 +1,417 @@
+"""Fused Pallas TPU kernel for batched SAT propagation + probing.
+
+The gather-style step in :mod:`ops.batched_sat` reads ``assign[|lit|]``
+per clause literal — irregular access the VPU handles but the MXU
+cannot.  This module reformulates Boolean constraint propagation as
+dense *clause-incidence matmuls* so the whole propagate→decide→probe
+loop runs as systolic-array work with every operand resident in VMEM:
+
+- ``P[c, v] = 1`` iff variable ``v`` occurs positively in clause ``c``
+  (``N`` likewise for negative occurrences), stored bf16.
+- With the assignment ``A[b, v] ∈ {-1, 0, +1}`` (f32):
+    ``true_cnt  = relu(A)·Pᵀ + relu(-A)·Nᵀ``   (satisfied literals)
+    ``false_cnt = relu(-A)·Pᵀ + relu(A)·Nᵀ``   (falsified literals)
+  A clause is a conflict when ``false_cnt == width``, and a *unit* when
+  unsatisfied with exactly one unknown literal.  The variables forced by
+  unit clauses come back through the transposed products
+  ``unit·P`` / ``unit·N`` masked to unknown positions — i.e. the
+  scatter step is also a matmul.  Counts are exact: 0/1 bf16 products
+  accumulate in f32 (``preferred_element_type``) without rounding below
+  2^24.
+
+Unlike the gather path, the dense form represents clauses of *any*
+width, so no clause is dropped from the device pool
+(``batched_sat.MAX_CLAUSE_WIDTH`` does not apply here).
+
+One kernel invocation runs, entirely in VMEM:
+  1. propagation to fixpoint from the assumption literals — a conflict
+     here is a sound UNSAT verdict for the lane (status 2);
+  2. ``rounds`` probe rounds: pick the lowest unassigned variable per
+     lane, set a host-supplied random phase, re-propagate, revert the
+     round on conflict (no clause learning — undecided lanes fall back
+     to the native CDCL on the host, see batched_sat).
+
+The dense pool costs ``C·V`` cells so it only fits small/medium pools
+(`fits()` gates on MAX_CELLS, sized for ~8 MB of VMEM);
+larger pools use the gather path.  Reference counterpart: this whole
+file replaces serial ``z3.Solver.check`` dispatch
+(mythril/laser/smt/solver/solver.py:47-57) — there is nothing to port;
+the design follows the north star in BASELINE.json.
+"""
+
+import functools
+import logging
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+# The incidence matrices live in HBM; the kernel streams clause tiles
+# through VMEM (grid over the clause axis), so C is bounded only by
+# sweep time / HBM, while V and B are bounded by what fits in VMEM
+# alongside one tile (see make_dense_solve's tile-size choice).
+MAX_VARS_DENSE = 8192    # V bucket cap (columns of a tile)
+MAX_CLAUSES_DENSE = 1 << 17
+# product cap: 4 incidence matrices at bf16 cost 8*C*V bytes of HBM
+# (plus the same again host-side during a rebuild) — 2^24 cells = 128 MB
+MAX_CELLS_DENSE = 1 << 24
+MAX_LANES = 64
+PROPAGATE_ITERS = 256
+DECISION_ROUNDS = 24
+
+
+def pallas_enabled() -> Optional[bool]:
+    """Tri-state gate: True (forced on, interpret off-TPU), False
+    (forced off), None (auto: on iff running on real TPU)."""
+    flag = os.environ.get("MYTHRIL_TPU_PALLAS", "").lower()
+    if flag in ("1", "true", "force"):
+        return True
+    if flag in ("0", "false", "off"):
+        return False
+    return None
+
+
+def _use_pallas() -> bool:
+    forced = pallas_enabled()
+    if forced is not None:
+        return forced
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _bucket(n: int, floor: int = 256) -> int:
+    size = floor
+    while size < n:
+        size *= 2
+    return size
+
+
+class DenseClausePool:
+    """Host-built dense incidence matrices, refreshed on pool growth."""
+
+    def __init__(self):
+        self.version = -1
+        self.P = None       # [C, V] bf16 on device
+        self.N = None
+        self.Pt = None      # [V, C] bf16 (transpose shipped from host)
+        self.Nt = None
+        self.width = None   # [1, C] f32
+        self.num_vars = 0   # V - 1 usable ids (column == var id)
+        self.C = 0
+        self.V = 0
+        # host mirrors so incremental growth only fills new rows
+        # (pool_version bumps once per added clause; a full rebuild per
+        # bump would be quadratic over the analysis)
+        self._P_host = None
+        self._N_host = None
+        self._w_host = None
+        self._built_clauses = 0
+
+    def fits(self, num_clauses: int, num_vars: int) -> bool:
+        C = _bucket(num_clauses)
+        V = _bucket(num_vars + 1)
+        return (
+            C <= MAX_CLAUSES_DENSE
+            and V <= MAX_VARS_DENSE
+            and C * V <= MAX_CELLS_DENSE
+        )
+
+    def refresh(self, clauses_py: Sequence[Tuple[int, ...]], num_vars: int):
+        import jax.numpy as jnp
+
+        C = _bucket(max(1, len(clauses_py)))
+        V = _bucket(num_vars + 1)
+        if (C, V) != (self.C, self.V) or self._P_host is None:
+            # bucket growth: rebuild the host mirrors at the new shape
+            self._P_host = np.zeros((C, V), dtype=np.float32)
+            self._N_host = np.zeros((C, V), dtype=np.float32)
+            self._w_host = np.zeros((1, C), dtype=np.float32)
+            self._built_clauses = 0
+        P, N, width = self._P_host, self._N_host, self._w_host
+        for c in range(self._built_clauses, len(clauses_py)):
+            clause = clauses_py[c]
+            for lit in clause:
+                if lit > 0:
+                    P[c, lit] = 1.0
+                else:
+                    N[c, -lit] = 1.0
+            width[0, c] = len(clause)
+        self._built_clauses = len(clauses_py)
+        self.P = jnp.asarray(P, dtype=jnp.bfloat16)
+        self.N = jnp.asarray(N, dtype=jnp.bfloat16)
+        self.Pt = jnp.asarray(P.T.copy(), dtype=jnp.bfloat16)
+        self.Nt = jnp.asarray(N.T.copy(), dtype=jnp.bfloat16)
+        self.width = jnp.asarray(width)
+        self.num_vars = V - 1
+        self.C, self.V = C, V
+
+
+def _tile_c(V: int) -> int:
+    """Clause-tile height: keep 4 bf16 tiles of [TC, V] under ~4 MB."""
+    return max(64, min(256, (1 << 19) // V))
+
+
+def _make_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
+    """One full clause scan, tiled over the clause axis.
+
+    Grid step i streams tile i of P/N (and their transposes) HBM→VMEM,
+    runs the four incidence matmuls on the MXU, and accumulates the
+    forced-literal counts and conflict flags into revisited output
+    blocks (TPU grids run sequentially, so read-modify-write across
+    grid steps is well-defined).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    natural = (((1,), (0,)), ((), ()))  # [M,K] x [K,N] -> [M,N]
+
+    def kernel(
+        p_ref, n_ref, pt_ref, nt_ref, w_ref, a_ref,
+        fpos_ref, fneg_ref, conf_ref,
+    ):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            fpos_ref[:] = jnp.zeros((B, V), dtype=jnp.float32)
+            fneg_ref[:] = jnp.zeros((B, V), dtype=jnp.float32)
+            conf_ref[:] = jnp.zeros((B, 1), dtype=jnp.float32)
+
+        P = p_ref[:]    # [TC, V]
+        N = n_ref[:]
+        Pt = pt_ref[:]  # [V, TC]
+        Nt = nt_ref[:]
+        width = w_ref[:]  # [1, TC]
+        A = a_ref[:]      # [B, V]
+
+        pos = jnp.maximum(A, 0.0).astype(jnp.bfloat16)
+        neg = jnp.maximum(-A, 0.0).astype(jnp.bfloat16)
+        true_cnt = lax.dot_general(
+            pos, Pt, natural, preferred_element_type=jnp.float32
+        ) + lax.dot_general(
+            neg, Nt, natural, preferred_element_type=jnp.float32
+        )  # [B, TC]
+        false_cnt = lax.dot_general(
+            neg, Pt, natural, preferred_element_type=jnp.float32
+        ) + lax.dot_general(
+            pos, Nt, natural, preferred_element_type=jnp.float32
+        )
+        real = width > 0.5
+        all_false = real & (false_cnt > width - 0.5)
+        unk_cnt = width - true_cnt - false_cnt
+        unit = (true_cnt < 0.5) & real & (unk_cnt > 0.5) & (unk_cnt < 1.5)
+        u = unit.astype(jnp.bfloat16)
+        fpos_ref[:] += lax.dot_general(
+            u, P, natural, preferred_element_type=jnp.float32
+        )
+        fneg_ref[:] += lax.dot_general(
+            u, N, natural, preferred_element_type=jnp.float32
+        )
+        conf_ref[:] = jnp.maximum(
+            conf_ref[:],
+            jnp.any(all_false, axis=1, keepdims=True).astype(jnp.float32),
+        )
+
+    grid = (C // TC,)
+    vm = pltpu.VMEM
+    full = lambda i: (0, 0)  # noqa: E731 — revisit the same block
+    call = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TC, V), lambda i: (i, 0), memory_space=vm),
+            pl.BlockSpec((TC, V), lambda i: (i, 0), memory_space=vm),
+            pl.BlockSpec((V, TC), lambda i: (0, i), memory_space=vm),
+            pl.BlockSpec((V, TC), lambda i: (0, i), memory_space=vm),
+            pl.BlockSpec((1, TC), lambda i: (0, i), memory_space=vm),
+            pl.BlockSpec((B, V), full, memory_space=vm),
+        ],
+        out_specs=(
+            pl.BlockSpec((B, V), full, memory_space=vm),
+            pl.BlockSpec((B, V), full, memory_space=vm),
+            pl.BlockSpec((B, 1), full, memory_space=vm),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, V), jnp.float32),
+            jax.ShapeDtypeStruct((B, V), jnp.float32),
+            jax.ShapeDtypeStruct((B, 1), jnp.float32),
+        ),
+        interpret=interpret,
+    )
+    return call
+
+
+@functools.lru_cache(maxsize=8)
+def make_dense_solve(
+    C: int, V: int, B: int, rounds: int, interpret: bool
+):
+    """Build the solve function for fixed (clauses, vars, lanes) shapes.
+
+    Returns fn(P[C,V]bf16, N[C,V]bf16, Pt[V,C]bf16, Nt[V,C]bf16,
+    width[1,C]f32, A0[B,V]f32, phases[rounds,B]f32) ->
+    (A[B,V]f32, status[B,1]i32) with status 0 = undecided (host
+    verifies or falls back) and 2 = UNSAT (conflict with zero
+    decisions).  The clause scan runs as the tiled Pallas kernel; the
+    fixpoint/probing control loop is plain lax around it (everything
+    still compiles to one XLA program).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    TC = _tile_c(V)
+    sweep = _make_sweep(C, V, B, TC, interpret)
+
+    def solve(P, N, Pt, Nt, width, A0, phases):
+        def propagate(A, frozen):
+            """BCP to fixpoint; frozen/conflicted lanes keep their A.
+            Masks are f32 0/1 (i1 loop carries don't lower cleanly)."""
+
+            def body(carry):
+                A, confl, _, i = carry
+                fpos, fneg, conf = sweep(P, N, Pt, Nt, width, A)
+                unassigned = A == 0.0
+                force_pos = (fpos > 0.5) & unassigned
+                force_neg = (fneg > 0.5) & unassigned
+                conflict_now = (conf > 0.5) | jnp.any(
+                    force_pos & force_neg, axis=1, keepdims=True
+                )
+                delta = jnp.where(force_pos, 1.0, 0.0) - jnp.where(
+                    force_neg, 1.0, 0.0
+                )
+                newA = jnp.where(unassigned, delta, A)
+                active = (frozen < 0.5) & (confl < 0.5)
+                A2 = jnp.where(active, newA, A)
+                confl2 = jnp.maximum(
+                    confl,
+                    jnp.where(conflict_now & (frozen < 0.5), 1.0, 0.0),
+                )
+                progressed = jnp.any(A2 != A).astype(jnp.int32)
+                return A2, confl2, progressed, i + 1
+
+            def cond(carry):
+                _, _, progressed, i = carry
+                return (progressed > 0) & (i < PROPAGATE_ITERS)
+
+            A, confl, _, _ = lax.while_loop(
+                cond,
+                body,
+                (A, jnp.zeros((B, 1), dtype=jnp.float32), jnp.int32(1), 0),
+            )
+            return A, confl
+
+        A, conflict0 = propagate(A0, jnp.zeros((B, 1), dtype=jnp.float32))
+
+        col = lax.broadcasted_iota(jnp.int32, (B, V), 1)
+
+        def round_body(r, carry):
+            A, done = carry
+            open_mask = (A == 0.0) & (col > 0)  # column 0 is no var id
+            any_open = jnp.any(open_mask, axis=1, keepdims=True)
+            var = jnp.argmax(open_mask.astype(jnp.float32), axis=1)
+            onehot = col == var[:, None]
+            phase = phases[r, :][:, None]  # [B, 1]
+            active = any_open & (done < 0.5)
+            trial = jnp.where(onehot & active, phase, A)
+            trialA, confl = propagate(trial, done)
+            # conflict => revert the whole round; opposite phase may be
+            # tried by a later round (no learning on-device)
+            A = jnp.where((confl > 0.5) | (done > 0.5), A, trialA)
+            return A, jnp.maximum(done, jnp.where(any_open, 0.0, 1.0))
+
+        A, _ = lax.fori_loop(0, rounds, round_body, (A, conflict0))
+        status = jnp.where(conflict0 > 0.5, 2, 0).astype(jnp.int32)
+        return A, status
+
+    return jax.jit(solve)
+
+
+class PallasSatBackend:
+    """Drives the fused kernel over lane chunks; same verdict contract
+    as BatchedSatBackend (status 2 = sound UNSAT, else host verifies)."""
+
+    def __init__(self):
+        self.pool = DenseClausePool()
+        self._seed = 0
+
+    def available_for(self, ctx) -> bool:
+        return _use_pallas() and self.pool.fits(
+            len(ctx.clauses_py), ctx.solver.num_vars
+        )
+
+    def check_assumption_sets(
+        self, ctx, assumption_sets: List[List[int]]
+    ) -> Tuple[List[Optional[bool]], np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+
+        interpret = jax.default_backend() != "tpu"
+        num_vars = ctx.solver.num_vars
+        if self.pool.version != ctx.pool_version or (
+            self.pool.num_vars < num_vars
+        ):
+            self.pool.refresh(ctx.clauses_py, num_vars)
+            self.pool.version = ctx.pool_version
+
+        V = self.pool.V
+        batch = len(assumption_sets)
+        assignments = np.zeros((batch, V), dtype=np.int8)
+        statuses = np.zeros(batch, dtype=np.int32)
+        for start in range(0, batch, MAX_LANES):
+            chunk = assumption_sets[start : start + MAX_LANES]
+            B = max(8, _bucket(len(chunk), floor=8))
+            A0 = np.zeros((B, V), dtype=np.float32)
+            A0[:, 1] = 1.0  # constant-TRUE anchor
+            for lane, lits in enumerate(chunk):
+                for lit in lits:
+                    if abs(lit) < V:
+                        A0[lane, abs(lit)] = 1.0 if lit > 0 else -1.0
+            self._seed += 1
+            phases = jnp.where(
+                jax.random.bernoulli(
+                    jax.random.PRNGKey(self._seed), shape=(DECISION_ROUNDS, B)
+                ),
+                1.0,
+                -1.0,
+            ).astype(jnp.float32)
+            step = make_dense_solve(
+                self.pool.C, V, B, DECISION_ROUNDS, interpret
+            )
+            A, st = step(
+                self.pool.P,
+                self.pool.N,
+                self.pool.Pt,
+                self.pool.Nt,
+                self.pool.width,
+                jnp.asarray(A0),
+                phases,
+            )
+            n = len(chunk)
+            assignments[start : start + n] = np.asarray(
+                A, dtype=np.float32
+            )[:n].astype(np.int8)
+            statuses[start : start + n] = np.asarray(st)[:n, 0]
+
+        results: List[Optional[bool]] = [
+            False if statuses[i] == 2 else None for i in range(batch)
+        ]
+        return results, assignments
+
+
+_pallas_backend: Optional[PallasSatBackend] = None
+
+
+def get_pallas_backend() -> PallasSatBackend:
+    global _pallas_backend
+    if _pallas_backend is None:
+        _pallas_backend = PallasSatBackend()
+    return _pallas_backend

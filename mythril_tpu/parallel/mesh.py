@@ -22,6 +22,9 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
+# smaller budgets than the single-chip defaults: every BCP iteration
+# costs a psum over cp, so the sharded path leans on the CDCL tail
+# sooner rather than paying collective latency for deep probe rounds
 PROPAGATE_ITERS = 64
 DECISION_ROUNDS = 8
 
@@ -46,85 +49,31 @@ def build_mesh(n_devices: int = None, dp: int = None, cp: int = None):
 
 def make_sharded_solve(mesh, num_vars: int):
     """Jitted sharded solve: lits[C,K] sharded over cp rows, assign
-    [B,V+1] sharded over dp, keys[B,2] over dp."""
+    [B,V+1] sharded over dp, keys[B,2] over dp.
+
+    The BCP/probe core is ops.batched_sat.build_solve_lane; this wrapper
+    only supplies the cross-shard reduce (psum of forced-literal votes
+    and conflict flags over the clause axis) and the shard_map layout.
+    """
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map
 
-    V1 = num_vars + 1
+    from mythril_tpu.ops.batched_sat import build_solve_lane
 
-    def clause_scan_local(lits, assign_lane):
-        var_idx = jnp.abs(lits)
-        vals = jnp.sign(lits) * assign_lane[var_idx]
-        is_real = lits != 0
-        sat = jnp.any((vals > 0) & is_real, axis=1)
-        num_unknown = jnp.sum((vals == 0) & is_real, axis=1)
-        all_false = jnp.all((vals < 0) | ~is_real, axis=1) & jnp.any(
-            is_real, axis=1
-        )
-        local_conflict = jnp.any(all_false)
-        unit = (~sat) & (num_unknown == 1)
-        unknown_here = (vals == 0) & is_real
-        forced_lit = jnp.sum(
-            jnp.where(unit[:, None] & unknown_here, lits, 0), axis=1
-        )
-        forced_pos = jnp.zeros(V1, dtype=jnp.int32).at[
-            jnp.where(forced_lit > 0, forced_lit, 0)
-        ].max(jnp.where(forced_lit > 0, 1, 0))
-        forced_neg = jnp.zeros(V1, dtype=jnp.int32).at[
-            jnp.where(forced_lit < 0, -forced_lit, 0)
-        ].max(jnp.where(forced_lit < 0, 1, 0))
-        return forced_pos, forced_neg, local_conflict
+    def reduce_over_cp(pos, neg, conflict):
+        pos = jax.lax.psum(pos, "cp")
+        neg = jax.lax.psum(neg, "cp")
+        conflict = jax.lax.psum(conflict.astype(jnp.int32), "cp") > 0
+        return pos, neg, conflict
 
-    def propagate(lits, assign_lane):
-        def body(carry):
-            assign_lane, _, _, i = carry
-            pos, neg, local_conflict = clause_scan_local(lits, assign_lane)
-            # merge forced literals + conflicts across the clause shards
-            pos = jax.lax.psum(pos, "cp")
-            neg = jax.lax.psum(neg, "cp")
-            conflict = (
-                jax.lax.psum(local_conflict.astype(jnp.int32), "cp") > 0
-            )
-            conflict = conflict | jnp.any((pos * neg)[1:] > 0)
-            delta = jnp.sign(pos - neg).astype(jnp.int8)
-            new_assign = jnp.where(assign_lane == 0, delta, assign_lane)
-            progressed = jnp.any(new_assign != assign_lane)
-            return (new_assign, conflict, progressed, i + 1)
-
-        def cond(carry):
-            _, conflict, progressed, i = carry
-            return (~conflict) & progressed & (i < PROPAGATE_ITERS)
-
-        assign_lane, conflict, _, _ = jax.lax.while_loop(
-            cond, body, (assign_lane, False, True, 0)
-        )
-        return assign_lane, conflict
-
-    def solve_lane(lits, assign_lane, key):
-        assign_lane, conflict0 = propagate(lits, assign_lane)
-
-        def round_body(i, carry):
-            assign_lane, done = carry
-            subkey = jax.random.fold_in(key, i)
-            unassigned = (assign_lane == 0).at[0].set(False)
-            any_open = jnp.any(unassigned)
-            var = jnp.argmax(unassigned)
-            phase = jnp.where(
-                jax.random.bernoulli(subkey), jnp.int8(1), jnp.int8(-1)
-            )
-            candidate = jnp.where(
-                any_open, assign_lane.at[var].set(phase), assign_lane
-            )
-            candidate, conflict = propagate(lits, candidate)
-            keep = jnp.where(conflict | done, assign_lane, candidate)
-            return (keep, done | ~any_open)
-
-        assign_lane, _ = jax.lax.fori_loop(
-            0, DECISION_ROUNDS, round_body, (assign_lane, conflict0)
-        )
-        return assign_lane, jnp.where(conflict0, 2, 0)
+    solve_lane = build_solve_lane(
+        num_vars,
+        reduce_hook=reduce_over_cp,
+        propagate_iters=PROPAGATE_ITERS,
+        decision_rounds=DECISION_ROUNDS,
+    )
 
     def solve_shard(lits_shard, assign_shard, keys_shard):
         # vmap over the local lanes; clause shard shared per device
