@@ -338,7 +338,28 @@ def create_func_to_hash_parser(parser: argparse.ArgumentParser) -> None:
 
 def create_hash_to_addr_parser(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
-        "hash", help="Find the address from hash", metavar="FUNCTION_NAME"
+        "hash",
+        help="contract code hash (0x + 64 hex chars) to resolve to an "
+        "address",
+        metavar="HASH",
+    )
+    parser.add_argument(
+        "--leveldb-dir",
+        help="specify leveldb directory for search or direct access "
+        "operations",
+        metavar="LEVELDB_PATH",
+    )
+
+
+def create_leveldb_parser(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "search", help="search expression", metavar="EXPRESSION"
+    )
+    parser.add_argument(
+        "--leveldb-dir",
+        help="specify leveldb directory for search or direct access "
+        "operations",
+        metavar="LEVELDB_PATH",
     )
 
 
@@ -402,7 +423,7 @@ def main() -> None:
     create_func_to_hash_parser(func_to_hash_parser)
     hash_to_addr_parser = subparsers.add_parser(
         "hash-to-address",
-        help="Returns the functions from signature database for the hash",
+        help="Returns the address for a contract code hash (LevelDB)",
     )
     create_hash_to_addr_parser(hash_to_addr_parser)
     subparsers.add_parser("version", parents=[output_parser], help="Outputs the version")
@@ -412,9 +433,10 @@ def main() -> None:
     subparsers.add_parser(
         "truffle", help="(unavailable) analyze a truffle project"
     )
-    subparsers.add_parser(
-        "leveldb-search", help="(unavailable) search a local geth LevelDB"
+    leveldb_search_parser = subparsers.add_parser(
+        "leveldb-search", help="Searches the code fragment in local leveldb"
     )
+    create_leveldb_parser(leveldb_search_parser)
     subparsers.add_parser("help", add_help=False)
 
     args = parser.parse_args()
@@ -584,12 +606,27 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
         print(MythrilDisassembler.hash_for_function_signature(args.func_name))
         sys.exit()
 
-    if args.command == "hash-to-address":
-        from mythril_tpu.support.signatures import SignatureDB
+    if args.command in ("hash-to-address", "leveldb-search"):
+        from mythril_tpu.mythril.mythril_leveldb import MythrilLevelDB
 
-        sig_db = SignatureDB()
-        for name in sig_db.get(args.hash):
-            print(name)
+        config = MythrilConfig()
+        leveldb_dir = (
+            getattr(args, "leveldb_dir", None) or config.leveldb_dir
+        )
+        try:
+            config.set_api_leveldb(leveldb_dir)
+        except Exception as e:
+            exit_with_error(
+                "text", f"Cannot open LevelDB at {leveldb_dir}: {e}"
+            )
+        searcher = MythrilLevelDB(config.eth_db)
+        try:
+            if args.command == "leveldb-search":
+                searcher.search_db(args.search)
+            else:
+                searcher.contract_hash_to_address(args.hash)
+        except CriticalError as e:
+            exit_with_error("text", str(e))
         sys.exit()
 
     if args.command == "list-detectors":
@@ -603,7 +640,7 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
                 print(f"{module_data['classname']}: {module_data['title']}")
         sys.exit()
 
-    if args.command in ("pro", "truffle", "leveldb-search"):
+    if args.command in ("pro", "truffle"):
         exit_with_error(
             getattr(args, "outform", "text"),
             f"The '{args.command}' command is not available in this build "

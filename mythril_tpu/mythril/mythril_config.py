@@ -20,6 +20,13 @@ class MythrilConfig:
         self.leveldb_dir = None
         self._init_config()
         self.eth: Optional[EthJsonRpc] = None
+        self.eth_db = None
+
+    def set_api_leveldb(self, leveldb_path: str) -> None:
+        """Open a geth LevelDB for direct (offline) chain access."""
+        from mythril_tpu.ethereum.interface.leveldb.client import EthLevelDB
+
+        self.eth_db = EthLevelDB(leveldb_path)
 
     @staticmethod
     def _init_mythril_dir() -> str:

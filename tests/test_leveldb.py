@@ -1,0 +1,317 @@
+"""LevelDB chain-access subsystem tests.
+
+Covers the storage format (snappy, log records, SSTs, MANIFEST), the
+RLP codec, the Merkle Patricia trie, and the geth schema layers
+(EthLevelDB / State / AccountIndexer / MythrilLevelDB) over a
+self-built fixture database — the role the reference delegated to
+plyvel + a checked-in binary fixture (reference tests/leveldb_test.py).
+"""
+
+import os
+import random
+
+import pytest
+
+from mythril_tpu.ethereum.interface.leveldb import snappy
+from mythril_tpu.ethereum.interface.leveldb.storage import (
+    LevelDB, Table, TableBuilder, build_write_batch, internal_key,
+    parse_write_batch, read_log_records, write_fixture_db,
+    write_log_records, TYPE_VALUE,
+)
+from mythril_tpu.ethereum.interface.leveldb.trie import (
+    TrieBuilder, TrieReader,
+)
+from mythril_tpu.support import rlp
+from mythril_tpu.support.crypto import keccak256
+
+
+# ---------------------------------------------------------------------------
+# codecs
+# ---------------------------------------------------------------------------
+
+
+def test_snappy_roundtrip():
+    rng = random.Random(7)
+    cases = [
+        b"",
+        b"a",
+        b"abcabcabcabcabcabcabc" * 50,      # copy-heavy
+        bytes(rng.randrange(256) for _ in range(5000)),  # literal-heavy
+        b"\x00" * 100000,                   # long runs
+    ]
+    for data in cases:
+        packed = snappy.compress(data)
+        assert snappy.decompress(packed) == data
+
+
+def test_snappy_rejects_garbage():
+    with pytest.raises(snappy.SnappyError):
+        snappy.decompress(b"\xff\xff\xff\xff\xff")
+
+
+def test_rlp_roundtrip():
+    items = [
+        b"",
+        b"\x01",
+        b"\x7f",
+        b"\x80",
+        b"hello world",
+        b"x" * 100,
+        [],
+        [b"a", [b"b", [b"c"]], b""],
+        [b"k" * 60, [b"v" * 1000]],
+    ]
+    for item in items:
+        assert rlp.decode(rlp.encode(item)) == item
+
+
+def test_rlp_integers():
+    for value in (0, 1, 127, 128, 256, 2**64, 2**255):
+        assert rlp.decode_int(rlp.encode_int(value)) == value
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(b"\x81\x01")  # non-canonical single byte
+
+
+# ---------------------------------------------------------------------------
+# storage format
+# ---------------------------------------------------------------------------
+
+
+def test_log_format_roundtrip_with_fragmentation():
+    records = [b"small", b"x" * 100000, b"tail"]  # forces FIRST/MID/LAST
+    data = write_log_records(records)
+    assert list(read_log_records(data)) == records
+
+
+def test_write_batch_roundtrip():
+    ops = [(TYPE_VALUE, b"key%d" % i, b"val%d" % i) for i in range(5)]
+    batch = build_write_batch(42, ops)
+    parsed = list(parse_write_batch(batch))
+    assert [(s, k, v) for s, _, k, v in parsed] == [
+        (42 + i, b"key%d" % i, b"val%d" % i) for i in range(5)
+    ]
+
+
+def test_table_roundtrip_and_search():
+    rng = random.Random(3)
+    records = {
+        b"key-%06d" % i: bytes(rng.randrange(256) for _ in range(50))
+        for i in range(500)
+    }
+    builder = TableBuilder(block_size=512)
+    for seq, (key, value) in enumerate(sorted(records.items()), 1):
+        builder.add(internal_key(key, seq, TYPE_VALUE), value)
+    table = Table(builder.finish())
+    for key, value in records.items():
+        found = table.get(key)
+        assert found is not None and found[2] == value
+    assert table.get(b"missing") is None
+    assert len(list(table.entries())) == 500
+
+
+@pytest.mark.parametrize("via_log", [True, False])
+def test_leveldb_open_and_get(tmp_path, via_log):
+    records = {b"k%03d" % i: b"v%d" % (i * i) for i in range(200)}
+    path = str(tmp_path / "db")
+    write_fixture_db(path, records, via_log=via_log)
+    db = LevelDB(path)
+    for key, value in records.items():
+        assert db.get(key) == value
+    assert db.get(b"nope") is None
+    assert dict(db.items()) == records
+
+
+# ---------------------------------------------------------------------------
+# trie
+# ---------------------------------------------------------------------------
+
+
+def test_trie_build_and_read():
+    entries = {
+        b"acct-%d" % i: rlp.encode([b"\x01", b"%d" % i]) for i in range(50)
+    }
+    builder = TrieBuilder(secure=True)
+    for key, value in entries.items():
+        builder.put(key, value)
+    root = builder.commit()
+    reader = TrieReader(builder.nodes, root, secure=True)
+    for key, value in entries.items():
+        assert reader.get(key) == value
+    assert reader.get(b"missing-key") is None
+    # enumeration sees every leaf
+    assert len(list(reader.items())) == 50
+
+
+def test_trie_empty():
+    builder = TrieBuilder()
+    root = builder.commit()
+    reader = TrieReader({}, root)
+    assert reader.get(b"anything") is None
+    assert list(reader.items()) == []
+
+
+# ---------------------------------------------------------------------------
+# geth fixture end-to-end
+# ---------------------------------------------------------------------------
+
+CONTRACT_ADDRESS = bytes.fromhex("a" * 40)
+EOA_ADDRESS = bytes.fromhex("b" * 40)
+# PUSH1 0 CALLDATALOAD ... CALLER SUICIDE — distinctive, searchable
+CONTRACT_CODE = bytes.fromhex("600035330158ff")
+
+
+def _header(number, parent, state_root):
+    return [
+        parent, b"\x00" * 32, b"\x00" * 20, state_root, b"\x00" * 32,
+        b"\x00" * 32, b"\x00" * 256, rlp.encode_int(1),
+        rlp.encode_int(number), rlp.encode_int(8000000),
+        rlp.encode_int(0), rlp.encode_int(1438269988 + number),
+        b"", b"\x00" * 32, b"\x00" * 8,
+    ]
+
+
+def build_geth_fixture(path):
+    """Two-block chain: a contract account with storage and an EOA."""
+    records = {}
+
+    # storage trie for the contract: slot 0 = 0x2a, slot 3 = 0xbeef
+    storage = TrieBuilder(secure=True)
+    storage.put((0).to_bytes(32, "big"), rlp.encode(rlp.encode_int(0x2A)))
+    storage.put((3).to_bytes(32, "big"), rlp.encode(rlp.encode_int(0xBEEF)))
+    storage_root = storage.commit()
+    records.update(storage.nodes)
+
+    code_hash = keccak256(CONTRACT_CODE)
+    records[code_hash] = CONTRACT_CODE
+
+    state = TrieBuilder(secure=True)
+    state.put(
+        CONTRACT_ADDRESS,
+        rlp.encode([
+            rlp.encode_int(1), rlp.encode_int(1000), storage_root, code_hash,
+        ]),
+    )
+    from mythril_tpu.ethereum.interface.leveldb.trie import EMPTY_ROOT
+    from mythril_tpu.ethereum.interface.leveldb.state import BLANK_CODE_HASH
+
+    state.put(
+        EOA_ADDRESS,
+        rlp.encode([
+            rlp.encode_int(7), rlp.encode_int(5), EMPTY_ROOT,
+            BLANK_CODE_HASH,
+        ]),
+    )
+    state_root = state.commit()
+    records.update(state.nodes)
+
+    # blocks 0 and 1
+    genesis = _header(0, b"\x00" * 32, state_root)
+    genesis_rlp = rlp.encode(genesis)
+    genesis_hash = keccak256(genesis_rlp)
+    head = _header(1, genesis_hash, state_root)
+    head_rlp = rlp.encode(head)
+    head_hash = keccak256(head_rlp)
+
+    def num8(n):
+        return n.to_bytes(8, "big")
+
+    records[b"h" + num8(0) + genesis_hash] = genesis_rlp
+    records[b"h" + num8(1) + head_hash] = head_rlp
+    records[b"h" + num8(0) + b"n"] = genesis_hash
+    records[b"h" + num8(1) + b"n"] = head_hash
+    records[b"H" + genesis_hash] = num8(0)
+    records[b"H" + head_hash] = num8(1)
+    records[b"LastBlock"] = head_hash
+
+    # block 1 body: one legacy tx to the contract; receipts index it
+    tx = [
+        rlp.encode_int(0), rlp.encode_int(1), rlp.encode_int(21000),
+        CONTRACT_ADDRESS, rlp.encode_int(0), b"", rlp.encode_int(27),
+        b"\x01", b"\x02",
+    ]
+    records[b"b" + num8(1) + head_hash] = rlp.encode([[tx], []])
+    receipt = [
+        b"\x01", rlp.encode_int(21000), b"\x00" * 256, b"\x00" * 32,
+        CONTRACT_ADDRESS, [], rlp.encode_int(21000),
+    ]
+    records[b"r" + num8(1) + head_hash] = rlp.encode([receipt])
+
+    write_fixture_db(path, records, via_log=False)
+    return state_root
+
+
+@pytest.fixture
+def geth_db(tmp_path):
+    path = str(tmp_path / "geth" / "chaindata")
+    build_geth_fixture(path)
+    from mythril_tpu.ethereum.interface.leveldb.client import EthLevelDB
+
+    return EthLevelDB(path)
+
+
+def test_eth_leveldb_account_access(geth_db):
+    assert geth_db.eth_getCode(CONTRACT_ADDRESS) == (
+        "0x" + CONTRACT_CODE.hex()
+    )
+    assert geth_db.eth_getBalance(CONTRACT_ADDRESS) == 1000
+    assert geth_db.eth_getBalance(EOA_ADDRESS) == 5
+    assert geth_db.eth_getStorageAt(CONTRACT_ADDRESS, 0) == (
+        "0x" + (0x2A).to_bytes(32, "big").hex()
+    )
+    assert geth_db.eth_getStorageAt(CONTRACT_ADDRESS, 3) == (
+        "0x" + (0xBEEF).to_bytes(32, "big").hex()
+    )
+    assert geth_db.eth_getStorageAt(CONTRACT_ADDRESS, 9) == (
+        "0x" + (0).to_bytes(32, "big").hex()
+    )
+
+
+def test_eth_leveldb_headers(geth_db):
+    header = geth_db.eth_getBlockHeaderByNumber(1)
+    assert rlp.decode_int(header.number) == 1
+    block = geth_db.eth_getBlockByNumber(1)
+    assert block is not None and block["body"] is not None
+
+
+def test_eth_leveldb_contract_enumeration(geth_db):
+    contracts = list(geth_db.get_contracts())
+    assert len(contracts) == 1
+    contract, address_hash, balance = contracts[0]
+    assert balance == 1000
+    assert address_hash == keccak256(CONTRACT_ADDRESS)
+
+
+def test_account_indexer_resolves_address(geth_db):
+    # the indexer ran at open; the tx "to" address must be recoverable
+    resolved = geth_db.reader._get_address_by_hash(
+        keccak256(CONTRACT_ADDRESS)
+    )
+    assert resolved == CONTRACT_ADDRESS
+
+
+def test_search_and_hash_to_address(geth_db, capsys):
+    from mythril_tpu.mythril.mythril_leveldb import MythrilLevelDB
+
+    facade = MythrilLevelDB(geth_db)
+    facade.search_db("code#PUSH1#")
+    out = capsys.readouterr().out
+    assert "0x" + CONTRACT_ADDRESS.hex() in out
+    assert "balance: 1000" in out
+
+    facade.contract_hash_to_address(
+        "0x" + keccak256(CONTRACT_CODE).hex()
+    )
+    out = capsys.readouterr().out
+    assert "0x" + CONTRACT_ADDRESS.hex() in out
+
+
+def test_sidecar_index_persists(tmp_path):
+    path = str(tmp_path / "chaindata")
+    build_geth_fixture(path)
+    from mythril_tpu.ethereum.interface.leveldb.client import EthLevelDB
+
+    EthLevelDB(path)  # first open builds + commits the index
+    assert os.path.exists(os.path.join(path, "mythril_tpu_index.json"))
+    # second open must see the committed index and skip re-indexing
+    db2 = EthLevelDB(path)
+    assert db2.reader._get_last_indexed_number() == 1
