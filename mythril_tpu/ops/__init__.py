@@ -6,3 +6,51 @@
 - ``u256``: 8x32-bit limb arithmetic primitives for batched EVM state
   stepping (used by later rounds' lockstep interpreter).
 """
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+_jax_configured = False
+
+
+def configure_jax() -> None:
+    """One-time process-wide JAX setup.
+
+    - Honor JAX_PLATFORMS via jax.config: the axon TPU plugin ignores
+      the env var, and with a wedged device tunnel a CPU-only run would
+      otherwise hang inside TPU plugin discovery (same workaround as
+      tests/conftest.py).
+    - Point the persistent compilation cache at the repo (first TPU
+      compile of the solve step costs ~10-40 s; cached reloads are
+      near-instant across processes).
+    """
+    global _jax_configured
+    if _jax_configured:
+        return
+    _jax_configured = True
+    try:
+        import jax
+
+        platforms = os.environ.get("JAX_PLATFORMS")
+        if platforms:
+            jax.config.update("jax_platforms", platforms)
+        if (platforms or "").lower() == "cpu":
+            # CPU AOT cache entries are machine-feature specific and can
+            # SIGILL when reloaded on a different host; the cache only
+            # pays off for TPU compiles anyway
+            return
+
+        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        if cache_dir is None:
+            cache_dir = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)
+                ))),
+                ".jax_cache",
+            )
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:  # cache is an optimization, never fatal
+        log.debug("persistent compilation cache unavailable: %s", e)

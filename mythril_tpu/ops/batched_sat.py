@@ -42,6 +42,9 @@ def _require_jax():
     import jax
     import jax.numpy as jnp
 
+    from mythril_tpu.ops import configure_jax
+
+    configure_jax()
     return jax, jnp
 
 
@@ -231,13 +234,22 @@ class BatchedSatBackend:
 
         pallas = get_pallas_backend()
         if pallas.available_for(ctx):
-            # fused MXU kernel: dense incidence matmuls, whole loop in
-            # VMEM, no clause-width cap (see ops/pallas_prop.py)
-            results, assignments = pallas.check_assumption_sets(
-                ctx, assumption_sets
+            # fused MXU kernels over the per-call cone: dense incidence
+            # matmuls, BCP + WalkSAT, no clause-width cap.  None means
+            # the cone exceeded the dense caps — gather path below.
+            dense = pallas.check_assumption_sets(ctx, assumption_sets)
+            if dense is not None:
+                results, assignments = dense
+                self.last_assignments = assignments
+                return results
+
+        from mythril_tpu.ops.device_health import device_ok
+
+        if not device_ok():
+            self.last_assignments = np.zeros(
+                (len(assumption_sets), ctx.solver.num_vars + 1), np.int8
             )
-            self.last_assignments = assignments
-            return results
+            return [None] * len(assumption_sets)
 
         jax, jnp = _require_jax()
         num_vars = ctx.solver.num_vars
@@ -330,12 +342,27 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     if not open_indices:
         return decided
 
+    # dedupe identical assumption sets: sibling states forked in the
+    # same VM step often share most (sometimes all) constraints
+    unique: Dict[Tuple[int, ...], int] = {}
+    rep_indices: List[int] = []
+    lane_of: List[int] = []
+    for i in open_indices:
+        lits_key = tuple(sorted(assumption_sets[i]))
+        lane = unique.get(lits_key)
+        if lane is None:
+            lane = len(rep_indices)
+            unique[lits_key] = lane
+            rep_indices.append(i)
+        lane_of.append(lane)
+
     backend = get_backend()
     verdicts = backend.check_assumption_sets(
-        ctx, [assumption_sets[i] for i in open_indices]
+        ctx, [assumption_sets[i] for i in rep_indices]
     )
 
-    for lane, i in enumerate(open_indices):
+    for pos, i in enumerate(open_indices):
+        lane = lane_of[pos]
         verdict = verdicts[lane]
         if verdict is False:
             decided[i] = False

@@ -1,0 +1,102 @@
+"""Accelerator failure detection.
+
+The reference framework's failure story is per-contract try/except and a
+timeout ladder (mythril/mythril/mythril_analyzer.py:164-176,
+mythril/laser/ethereum/svm.py:230-245); it has no accelerator to lose.
+This build does: the TPU is reached over a tunnel that can wedge, and
+both backend *initialization* and a ``block_until_ready`` on a wedged
+device block forever, taking the whole analysis with them.
+
+``device_ok()`` probes once per process: backend discovery plus a tiny
+jitted reduction run in a daemon thread while the caller waits with a
+deadline.  On timeout the device is marked unhealthy and every device
+path (Pallas kernel, gather backend, mesh) degrades to the native CDCL
+solver — analysis results are identical, only the batching speedup is
+lost.  The probe thread is left behind on purpose: it is parked inside
+the runtime and will die with the process.
+
+Env overrides:
+  MYTHRIL_TPU_HEALTH_TIMEOUT  probe deadline in seconds (default 60;
+                              first TPU compile takes ~20-40 s)
+  MYTHRIL_TPU_HEALTH=ok|bad   skip probing entirely
+"""
+
+import logging
+import os
+import threading
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_verdict: Optional[bool] = None
+_backend_name: Optional[str] = None
+
+
+def _probe() -> bool:
+    global _backend_name
+    timeout_s = float(os.environ.get("MYTHRIL_TPU_HEALTH_TIMEOUT", "60"))
+    result = {}
+
+    def run():
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            result["backend"] = jax.default_backend()
+            if result["backend"] == "cpu":
+                result["value"] = 8128  # in-process; nothing to probe
+                return
+            x = jnp.arange(128, dtype=jnp.int32)
+            result["value"] = int(jax.jit(jnp.sum)(x).block_until_ready())
+        except Exception as e:  # noqa: BLE001 — any failure means "bad"
+            result["error"] = e
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    thread.join(timeout_s)
+    _backend_name = result.get("backend")
+    if thread.is_alive():
+        log.warning(
+            "accelerator probe did not answer within %.0fs; "
+            "falling back to the native CPU solver", timeout_s,
+        )
+        return False
+    if "error" in result:
+        log.warning("accelerator probe failed (%s); using CPU solver",
+                    result["error"])
+        return False
+    return result.get("value") == 8128
+
+
+def device_ok() -> bool:
+    """True when the default JAX backend initializes and answers a
+    trivial computation within the deadline.  Cached per process."""
+    global _verdict
+    if _verdict is not None:
+        return _verdict
+    with _lock:
+        if _verdict is not None:
+            return _verdict
+        forced = os.environ.get("MYTHRIL_TPU_HEALTH", "").lower()
+        if forced in ("ok", "good", "1"):
+            _verdict = True
+        elif forced in ("bad", "0"):
+            _verdict = False
+        else:
+            _verdict = _probe()
+        return _verdict
+
+
+def backend_name() -> Optional[str]:
+    """The backend discovered by the probe ('tpu', 'cpu', ...); None if
+    the probe has not run or backend init itself hung."""
+    if _verdict is None:
+        device_ok()
+    return _backend_name
+
+
+def reset_for_tests() -> None:
+    global _verdict, _backend_name
+    _verdict = None
+    _backend_name = None

@@ -1,10 +1,9 @@
-"""Fused Pallas TPU kernel for batched SAT propagation + probing.
+"""Fused Pallas TPU kernels for batched SAT: cone-restricted BCP + WalkSAT.
 
 The gather-style step in :mod:`ops.batched_sat` reads ``assign[|lit|]``
 per clause literal — irregular access the VPU handles but the MXU
-cannot.  This module reformulates Boolean constraint propagation as
-dense *clause-incidence matmuls* so the whole propagate→decide→probe
-loop runs as systolic-array work with every operand resident in VMEM:
+cannot.  This module reformulates clause evaluation as dense
+*clause-incidence matmuls* so every sweep runs as systolic-array work:
 
 - ``P[c, v] = 1`` iff variable ``v`` occurs positively in clause ``c``
   (``N`` likewise for negative occurrences), stored bf16.
@@ -12,31 +11,44 @@ loop runs as systolic-array work with every operand resident in VMEM:
     ``true_cnt  = relu(A)·Pᵀ + relu(-A)·Nᵀ``   (satisfied literals)
     ``false_cnt = relu(-A)·Pᵀ + relu(A)·Nᵀ``   (falsified literals)
   A clause is a conflict when ``false_cnt == width``, and a *unit* when
-  unsatisfied with exactly one unknown literal.  The variables forced by
-  unit clauses come back through the transposed products
-  ``unit·P`` / ``unit·N`` masked to unknown positions — i.e. the
+  unsatisfied with exactly one unknown literal; forced variables and
+  WalkSAT flip scores come back through the transposed products — the
   scatter step is also a matmul.  Counts are exact: 0/1 bf16 products
   accumulate in f32 (``preferred_element_type``) without rounding below
   2^24.
 
-Unlike the gather path, the dense form represents clauses of *any*
-width, so no clause is dropped from the device pool
-(``batched_sat.MAX_CLAUSE_WIDTH`` does not apply here).
+Two lessons are baked into the shape of this file (measured on the
+embedded corpus, see git history):
 
-One kernel invocation runs, entirely in VMEM:
-  1. propagation to fixpoint from the assumption literals — a conflict
-     here is a sound UNSAT verdict for the lane (status 2);
-  2. ``rounds`` probe rounds: pick the lowest unassigned variable per
-     lane, set a host-supplied random phase, re-propagate, revert the
-     round on conflict (no clause learning — undecided lanes fall back
-     to the native CDCL on the host, see batched_sat).
+1. **Sweep the cone, not the pool.**  The blast context's clause pool
+   grows monotonically over a whole contract analysis (tens of
+   thousands of clauses), but one feasibility query only constrains its
+   *defining cone* — usually a few hundred clauses.  Sweeping the full
+   pool made each device call stream ~1 GB of incidence matrix per BCP
+   iteration.  ``BlastContext.cone()`` extracts the per-batch cone on
+   the host and the dense matrices are built over remapped cone
+   variables, shrinking sweeps by orders of magnitude.
 
-The dense pool costs ``C·V`` cells so it only fits small/medium pools
-(`fits()` gates on MAX_CELLS, sized for ~8 MB of VMEM);
-larger pools use the gather path.  Reference counterpart: this whole
-file replaces serial ``z3.Solver.check`` dispatch
-(mythril/laser/smt/solver/solver.py:47-57) — there is nothing to port;
-the design follows the north star in BASELINE.json.
+2. **Complete assignments beat single-variable probes.**  Probing one
+   decision variable per round needs a full BCP fixpoint per probe and
+   almost never completes an assignment.  Instead, after one BCP
+   fixpoint (sound UNSAT detection), lanes are *completed* with random
+   phases and improved by batched WalkSAT: one sweep per round scores
+   every variable by its unsatisfied-clause count, and the best-scoring
+   free variable per lane is flipped.  A lane whose cone has zero
+   unsatisfied clauses is a SAT candidate; the host verifies it against
+   the original terms before trusting it.
+
+Soundness contract (same as the gather path): UNSAT only from a BCP
+conflict with zero decisions (every pool clause holds globally, so a
+conflict under a clause subset is real); SAT only after host-side
+verification of the concrete model.  Undecided lanes fall back to the
+native CDCL.
+
+Reference counterpart: this whole file replaces serial
+``z3.Solver.check`` dispatch (mythril/laser/smt/solver/solver.py:47-57)
+— there is nothing to port; the design follows the north star in
+BASELINE.json.
 """
 
 import functools
@@ -48,23 +60,23 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
-# The incidence matrices live in HBM; the kernel streams clause tiles
-# through VMEM (grid over the clause axis), so C is bounded only by
-# sweep time / HBM, while V and B are bounded by what fits in VMEM
-# alongside one tile (see make_dense_solve's tile-size choice).
-MAX_VARS_DENSE = 8192    # V bucket cap (columns of a tile)
-MAX_CLAUSES_DENSE = 1 << 17
-# product cap: 4 incidence matrices at bf16 cost 8*C*V bytes of HBM
-# (plus the same again host-side during a rebuild) — 2^24 cells = 128 MB
-MAX_CELLS_DENSE = 1 << 24
-MAX_LANES = 64
-PROPAGATE_ITERS = 256
-DECISION_ROUNDS = 24
+# Per-call dense cone caps: V and C are bucketed powers of two; the
+# four bf16 incidence matrices cost 8*C*V bytes of HBM.
+MAX_VARS_DENSE = 4096
+MAX_CLAUSES_DENSE = 1 << 15
+MAX_CELLS_DENSE = 1 << 22    # 4M cells = 32 MB for the four matrices
+MAX_LANES = 64               # per-chunk cap, further shrunk for wide V
+# the [B,V] assignment + two forced-count outputs stay VMEM-resident
+# across all grid steps; cap their f32 footprint (~12*B*V bytes)
+MAX_LANE_CELLS = 1 << 18
+PROPAGATE_ITERS = 256        # BCP fixpoint cap (loop exits on no-progress)
+WALK_ROUNDS = 48             # one sweep per round
+RESTART_EVERY = 12           # re-randomize stuck lanes every N rounds
 
 
 def pallas_enabled() -> Optional[bool]:
     """Tri-state gate: True (forced on, interpret off-TPU), False
-    (forced off), None (auto: on iff running on real TPU)."""
+    (forced off), None (auto: on iff running on a healthy TPU)."""
     flag = os.environ.get("MYTHRIL_TPU_PALLAS", "").lower()
     if flag in ("1", "true", "force"):
         return True
@@ -75,17 +87,21 @@ def pallas_enabled() -> Optional[bool]:
 
 def _use_pallas() -> bool:
     forced = pallas_enabled()
-    if forced is not None:
-        return forced
-    try:
-        import jax
-
-        return jax.default_backend() == "tpu"
-    except Exception:
+    if forced is False:
         return False
+    # device_ok() wraps even backend discovery in a deadline — never
+    # touch jax.default_backend() directly here (a wedged TPU tunnel
+    # hangs inside backend init, see ops/device_health.py)
+    from mythril_tpu.ops.device_health import backend_name, device_ok
+
+    if not device_ok():
+        return False
+    if backend_name() != "tpu":
+        return bool(forced)  # interpret mode only when forced (tests)
+    return True
 
 
-def _bucket(n: int, floor: int = 256) -> int:
+def _bucket(n: int, floor: int = 128) -> int:
     size = floor
     while size < n:
         size *= 2
@@ -93,10 +109,13 @@ def _bucket(n: int, floor: int = 256) -> int:
 
 
 class DenseClausePool:
-    """Host-built dense incidence matrices, refreshed on pool growth."""
+    """Dense incidence matrices over an explicit clause list.
+
+    Used per-call over remapped cone clauses (the primary path) and
+    directly over small whole pools in tests.
+    """
 
     def __init__(self):
-        self.version = -1
         self.P = None       # [C, V] bf16 on device
         self.N = None
         self.Pt = None      # [V, C] bf16 (transpose shipped from host)
@@ -105,16 +124,10 @@ class DenseClausePool:
         self.num_vars = 0   # V - 1 usable ids (column == var id)
         self.C = 0
         self.V = 0
-        # host mirrors so incremental growth only fills new rows
-        # (pool_version bumps once per added clause; a full rebuild per
-        # bump would be quadratic over the analysis)
-        self._P_host = None
-        self._N_host = None
-        self._w_host = None
-        self._built_clauses = 0
 
-    def fits(self, num_clauses: int, num_vars: int) -> bool:
-        C = _bucket(num_clauses)
+    @staticmethod
+    def fits(num_clauses: int, num_vars: int) -> bool:
+        C = _bucket(max(1, num_clauses))
         V = _bucket(num_vars + 1)
         return (
             C <= MAX_CLAUSES_DENSE
@@ -127,22 +140,16 @@ class DenseClausePool:
 
         C = _bucket(max(1, len(clauses_py)))
         V = _bucket(num_vars + 1)
-        if (C, V) != (self.C, self.V) or self._P_host is None:
-            # bucket growth: rebuild the host mirrors at the new shape
-            self._P_host = np.zeros((C, V), dtype=np.float32)
-            self._N_host = np.zeros((C, V), dtype=np.float32)
-            self._w_host = np.zeros((1, C), dtype=np.float32)
-            self._built_clauses = 0
-        P, N, width = self._P_host, self._N_host, self._w_host
-        for c in range(self._built_clauses, len(clauses_py)):
-            clause = clauses_py[c]
+        P = np.zeros((C, V), dtype=np.float32)
+        N = np.zeros((C, V), dtype=np.float32)
+        width = np.zeros((1, C), dtype=np.float32)
+        for c, clause in enumerate(clauses_py):
             for lit in clause:
                 if lit > 0:
                     P[c, lit] = 1.0
                 else:
                     N[c, -lit] = 1.0
             width[0, c] = len(clause)
-        self._built_clauses = len(clauses_py)
         self.P = jnp.asarray(P, dtype=jnp.bfloat16)
         self.N = jnp.asarray(N, dtype=jnp.bfloat16)
         self.Pt = jnp.asarray(P.T.copy(), dtype=jnp.bfloat16)
@@ -152,19 +159,20 @@ class DenseClausePool:
         self.C, self.V = C, V
 
 
-def _tile_c(V: int) -> int:
-    """Clause-tile height: keep 4 bf16 tiles of [TC, V] under ~4 MB."""
-    return max(64, min(256, (1 << 19) // V))
+def _tile_c(C: int, V: int) -> int:
+    """Clause-tile height: keep 4 bf16 tiles of [TC, V] under ~4 MB.
+    Never exceeds C (both are powers of two, so TC always divides C)."""
+    return min(C, max(64, min(256, (1 << 19) // V)))
 
 
-def _make_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
-    """One full clause scan, tiled over the clause axis.
+def _make_bcp_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
+    """One full clause scan over a partial assignment, tiled over the
+    clause axis: returns forced-literal votes and conflict flags.
 
     Grid step i streams tile i of P/N (and their transposes) HBM→VMEM,
-    runs the four incidence matmuls on the MXU, and accumulates the
-    forced-literal counts and conflict flags into revisited output
-    blocks (TPU grids run sequentially, so read-modify-write across
-    grid steps is well-defined).
+    runs the incidence matmuls on the MXU, and accumulates into
+    revisited output blocks (TPU grids run sequentially, so
+    read-modify-write across grid steps is well-defined).
     """
     import jax
     import jax.numpy as jnp
@@ -250,35 +258,114 @@ def _make_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
     return call
 
 
-@functools.lru_cache(maxsize=8)
+def _make_walk_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
+    """One full clause scan over a *complete* assignment: returns per-var
+    unsatisfied-clause participation scores and per-lane unsat counts."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    natural = (((1,), (0,)), ((), ()))
+
+    def kernel(
+        p_ref, n_ref, pt_ref, nt_ref, w_ref, x_ref,
+        score_ref, nunsat_ref,
+    ):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            score_ref[:] = jnp.zeros((B, V), dtype=jnp.float32)
+            nunsat_ref[:] = jnp.zeros((B, 1), dtype=jnp.float32)
+
+        P = p_ref[:]
+        N = n_ref[:]
+        Pt = pt_ref[:]
+        Nt = nt_ref[:]
+        width = w_ref[:]
+        X = x_ref[:]
+
+        pos = jnp.maximum(X, 0.0).astype(jnp.bfloat16)
+        neg = jnp.maximum(-X, 0.0).astype(jnp.bfloat16)
+        false_cnt = lax.dot_general(
+            neg, Pt, natural, preferred_element_type=jnp.float32
+        ) + lax.dot_general(
+            pos, Nt, natural, preferred_element_type=jnp.float32
+        )  # [B, TC]
+        real = width > 0.5
+        unsat = real & (false_cnt > width - 0.5)
+        u = unsat.astype(jnp.bfloat16)
+        # every literal of an unsatisfied clause is falsified, so the
+        # flip score of a variable is simply its membership count
+        score_ref[:] += lax.dot_general(
+            u, P, natural, preferred_element_type=jnp.float32
+        ) + lax.dot_general(
+            u, N, natural, preferred_element_type=jnp.float32
+        )
+        nunsat_ref[:] += jnp.sum(
+            unsat.astype(jnp.float32), axis=1, keepdims=True
+        )
+
+    grid = (C // TC,)
+    vm = pltpu.VMEM
+    full = lambda i: (0, 0)  # noqa: E731
+    call = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TC, V), lambda i: (i, 0), memory_space=vm),
+            pl.BlockSpec((TC, V), lambda i: (i, 0), memory_space=vm),
+            pl.BlockSpec((V, TC), lambda i: (0, i), memory_space=vm),
+            pl.BlockSpec((V, TC), lambda i: (0, i), memory_space=vm),
+            pl.BlockSpec((1, TC), lambda i: (0, i), memory_space=vm),
+            pl.BlockSpec((B, V), full, memory_space=vm),
+        ],
+        out_specs=(
+            pl.BlockSpec((B, V), full, memory_space=vm),
+            pl.BlockSpec((B, 1), full, memory_space=vm),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, V), jnp.float32),
+            jax.ShapeDtypeStruct((B, 1), jnp.float32),
+        ),
+        interpret=interpret,
+    )
+    return call
+
+
+@functools.lru_cache(maxsize=16)
 def make_dense_solve(
     C: int, V: int, B: int, rounds: int, interpret: bool
 ):
     """Build the solve function for fixed (clauses, vars, lanes) shapes.
 
     Returns fn(P[C,V]bf16, N[C,V]bf16, Pt[V,C]bf16, Nt[V,C]bf16,
-    width[1,C]f32, A0[B,V]f32, phases[rounds,B]f32) ->
-    (A[B,V]f32, status[B,1]i32) with status 0 = undecided (host
-    verifies or falls back) and 2 = UNSAT (conflict with zero
-    decisions).  The clause scan runs as the tiled Pallas kernel; the
-    fixpoint/probing control loop is plain lax around it (everything
-    still compiles to one XLA program).
+    width[1,C]f32, A0[B,V]f32, key) -> (A[B,V]f32, status[B,1]i32)
+    with status 2 = UNSAT (BCP conflict with zero decisions, sound),
+    1 = complete satisfying assignment for the device clause set (host
+    must verify against the original terms), 0 = undecided.  The clause
+    scans run as tiled Pallas kernels; the fixpoint/WalkSAT control
+    loop is plain lax around them (everything compiles to one XLA
+    program).
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    TC = _tile_c(V)
-    sweep = _make_sweep(C, V, B, TC, interpret)
+    TC = _tile_c(C, V)
+    bcp_sweep = _make_bcp_sweep(C, V, B, TC, interpret)
+    walk_sweep = _make_walk_sweep(C, V, B, TC, interpret)
 
-    def solve(P, N, Pt, Nt, width, A0, phases):
-        def propagate(A, frozen):
-            """BCP to fixpoint; frozen/conflicted lanes keep their A.
+    def solve(P, N, Pt, Nt, width, A0, key):
+        def propagate(A):
+            """BCP to fixpoint; conflicted lanes keep their A.
             Masks are f32 0/1 (i1 loop carries don't lower cleanly)."""
 
             def body(carry):
                 A, confl, _, i = carry
-                fpos, fneg, conf = sweep(P, N, Pt, Nt, width, A)
+                fpos, fneg, conf = bcp_sweep(P, N, Pt, Nt, width, A)
                 unassigned = A == 0.0
                 force_pos = (fpos > 0.5) & unassigned
                 force_neg = (fneg > 0.5) & unassigned
@@ -289,11 +376,9 @@ def make_dense_solve(
                     force_neg, 1.0, 0.0
                 )
                 newA = jnp.where(unassigned, delta, A)
-                active = (frozen < 0.5) & (confl < 0.5)
-                A2 = jnp.where(active, newA, A)
+                A2 = jnp.where(confl < 0.5, newA, A)
                 confl2 = jnp.maximum(
-                    confl,
-                    jnp.where(conflict_now & (frozen < 0.5), 1.0, 0.0),
+                    confl, jnp.where(conflict_now, 1.0, 0.0)
                 )
                 progressed = jnp.any(A2 != A).astype(jnp.int32)
                 return A2, confl2, progressed, i + 1
@@ -309,97 +394,147 @@ def make_dense_solve(
             )
             return A, confl
 
-        A, conflict0 = propagate(A0, jnp.zeros((B, 1), dtype=jnp.float32))
+        A, conflict0 = propagate(A0)
 
         col = lax.broadcasted_iota(jnp.int32, (B, V), 1)
+        free = (A == 0.0) & (col > 1)  # col 0 unused, col 1 = TRUE anchor
+
+        def rademacher(k):
+            return jnp.where(
+                jax.random.bernoulli(k, shape=(B, V)), 1.0, -1.0
+            ).astype(jnp.float32)
+
+        X0 = jnp.where(free, rademacher(jax.random.fold_in(key, 0)), A)
 
         def round_body(r, carry):
-            A, done = carry
-            open_mask = (A == 0.0) & (col > 0)  # column 0 is no var id
-            any_open = jnp.any(open_mask, axis=1, keepdims=True)
-            var = jnp.argmax(open_mask.astype(jnp.float32), axis=1)
-            onehot = col == var[:, None]
-            phase = phases[r, :][:, None]  # [B, 1]
-            active = any_open & (done < 0.5)
-            trial = jnp.where(onehot & active, phase, A)
-            trialA, confl = propagate(trial, done)
-            # conflict => revert the whole round; opposite phase may be
-            # tried by a later round (no learning on-device)
-            A = jnp.where((confl > 0.5) | (done > 0.5), A, trialA)
-            return A, jnp.maximum(done, jnp.where(any_open, 0.0, 1.0))
+            X, bestX, satisfied = carry
+            score, nunsat = walk_sweep(P, N, Pt, Nt, width, X)
+            now_sat = nunsat < 0.5
+            newly = now_sat & (satisfied < 0.5)
+            bestX = jnp.where(newly, X, bestX)
+            sat2 = jnp.maximum(satisfied, now_sat.astype(jnp.float32))
+            # flip the highest-scoring free variable (noise breaks ties)
+            noise = jax.random.uniform(
+                jax.random.fold_in(key, 2 * r + 1), (B, V)
+            )
+            masked = jnp.where(free & (score > 0.5), score + noise, -1.0)
+            var = jnp.argmax(masked, axis=1)
+            flip = (col == var[:, None]) & (
+                jnp.max(masked, axis=1, keepdims=True) > 0.0
+            )
+            Xn = jnp.where(flip, -X, X)
+            # periodic restart: re-randomize free vars of stuck lanes
+            restart = (r % RESTART_EVERY) == (RESTART_EVERY - 1)
+            rand = rademacher(jax.random.fold_in(key, 2 * r + 2))
+            Xn = jnp.where(
+                jnp.logical_and(restart, free), rand, Xn
+            )
+            X2 = jnp.where(sat2 > 0.5, X, Xn)  # freeze satisfied lanes
+            return X2, bestX, sat2
 
-        A, _ = lax.fori_loop(0, rounds, round_body, (A, conflict0))
-        status = jnp.where(conflict0 > 0.5, 2, 0).astype(jnp.int32)
-        return A, status
+        _, bestX, satisfied = lax.fori_loop(
+            0, rounds, round_body, (X0, X0, jnp.zeros((B, 1), jnp.float32))
+        )
+
+        status = jnp.where(
+            conflict0 > 0.5,
+            2,
+            jnp.where(satisfied > 0.5, 1, 0),
+        ).astype(jnp.int32)
+        outA = jnp.where(satisfied > 0.5, bestX, A)
+        return outA, status
 
     return jax.jit(solve)
 
 
 class PallasSatBackend:
-    """Drives the fused kernel over lane chunks; same verdict contract
-    as BatchedSatBackend (status 2 = sound UNSAT, else host verifies)."""
+    """Drives the fused kernels over per-call cone problems; same verdict
+    contract as BatchedSatBackend (False = sound UNSAT, None = host
+    verifies the returned assignment or falls back to CDCL)."""
 
     def __init__(self):
-        self.pool = DenseClausePool()
         self._seed = 0
 
     def available_for(self, ctx) -> bool:
-        return _use_pallas() and self.pool.fits(
-            len(ctx.clauses_py), ctx.solver.num_vars
-        )
+        return _use_pallas()
 
     def check_assumption_sets(
         self, ctx, assumption_sets: List[List[int]]
-    ) -> Tuple[List[Optional[bool]], np.ndarray]:
+    ) -> Optional[Tuple[List[Optional[bool]], np.ndarray]]:
+        """None when the per-call cone exceeds the dense caps (the
+        caller falls through to the gather backend)."""
         import jax
         import jax.numpy as jnp
 
-        interpret = jax.default_backend() != "tpu"
-        num_vars = ctx.solver.num_vars
-        if self.pool.version != ctx.pool_version or (
-            self.pool.num_vars < num_vars
-        ):
-            self.pool.refresh(ctx.clauses_py, num_vars)
-            self.pool.version = ctx.pool_version
+        from mythril_tpu.ops import configure_jax
 
-        V = self.pool.V
+        configure_jax()
+        interpret = jax.default_backend() != "tpu"
         batch = len(assumption_sets)
-        assignments = np.zeros((batch, V), dtype=np.int8)
+        orig_v1 = ctx.solver.num_vars + 1
+        assignments = np.zeros((batch, orig_v1), dtype=np.int8)
+        assignments[:, 1] = 1
+
+        # host-side cone extraction over the union of all lanes' roots
+        all_lits = sorted({l for lits in assumption_sets for l in lits})
+        clause_idx, cone_vars = ctx.cone(all_lits)
+        remap = {1: 1}
+        for var in sorted(cone_vars):
+            if var not in remap:
+                remap[var] = len(remap) + 1
+        for lits in assumption_sets:
+            for lit in lits:
+                if abs(lit) not in remap:
+                    remap[abs(lit)] = len(remap) + 1
+        num_cone_vars = len(remap)
+
+        if not DenseClausePool.fits(len(clause_idx), num_cone_vars):
+            log.debug(
+                "cone too large for dense kernel (%d clauses, %d vars)",
+                len(clause_idx), num_cone_vars,
+            )
+            return None  # caller falls through to the gather backend
+
+        cone_clauses = [
+            tuple(
+                (1 if lit > 0 else -1) * remap[abs(lit)]
+                for lit in ctx.clauses_py[ci]
+            )
+            for ci in clause_idx
+        ]
+        pool = DenseClausePool()
+        pool.refresh(cone_clauses, num_cone_vars)
+        inverse = np.zeros(pool.V, dtype=np.int64)
+        for var, col in remap.items():
+            inverse[col] = var
+
+        V = pool.V
         statuses = np.zeros(batch, dtype=np.int32)
-        for start in range(0, batch, MAX_LANES):
-            chunk = assumption_sets[start : start + MAX_LANES]
+        chunk_lanes = max(8, min(MAX_LANES, MAX_LANE_CELLS // V))
+        for start in range(0, batch, chunk_lanes):
+            chunk = assumption_sets[start : start + chunk_lanes]
             B = max(8, _bucket(len(chunk), floor=8))
             A0 = np.zeros((B, V), dtype=np.float32)
             A0[:, 1] = 1.0  # constant-TRUE anchor
             for lane, lits in enumerate(chunk):
                 for lit in lits:
-                    if abs(lit) < V:
-                        A0[lane, abs(lit)] = 1.0 if lit > 0 else -1.0
+                    A0[lane, remap[abs(lit)]] = 1.0 if lit > 0 else -1.0
             self._seed += 1
-            phases = jnp.where(
-                jax.random.bernoulli(
-                    jax.random.PRNGKey(self._seed), shape=(DECISION_ROUNDS, B)
-                ),
-                1.0,
-                -1.0,
-            ).astype(jnp.float32)
-            step = make_dense_solve(
-                self.pool.C, V, B, DECISION_ROUNDS, interpret
-            )
+            key = jax.random.PRNGKey(self._seed)
+            step = make_dense_solve(pool.C, V, B, WALK_ROUNDS, interpret)
             A, st = step(
-                self.pool.P,
-                self.pool.N,
-                self.pool.Pt,
-                self.pool.Nt,
-                self.pool.width,
-                jnp.asarray(A0),
-                phases,
+                pool.P, pool.N, pool.Pt, pool.Nt, pool.width,
+                jnp.asarray(A0), key,
             )
             n = len(chunk)
-            assignments[start : start + n] = np.asarray(
-                A, dtype=np.float32
-            )[:n].astype(np.int8)
+            A_host = np.asarray(A, dtype=np.float32)[:n]
             statuses[start : start + n] = np.asarray(st)[:n, 0]
+            # map cone columns back to original variable ids
+            signs = np.sign(A_host).astype(np.int8)  # [n, V]
+            for lane in range(n):
+                assignments[start + lane, inverse[1:num_cone_vars + 1]] = (
+                    signs[lane, 1 : num_cone_vars + 1]
+                )
 
         results: List[Optional[bool]] = [
             False if statuses[i] == 2 else None for i in range(batch)

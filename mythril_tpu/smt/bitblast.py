@@ -49,16 +49,62 @@ class BlastContext:
         self.array_reads: Dict[int, List[Tuple[T.Node, List[int]]]] = {}
         self.uf_apps: Dict[int, List[Tuple[Tuple[T.Node, ...], List[int]]]] = {}
         self.clause_count = 0
+        # defining-cone index: var -> indices of the clauses that define
+        # it.  By construction (Tseitin), the defined gate is the
+        # youngest variable in its defining clauses, so the default
+        # owner is max(|lit|); congruence clauses (array reads, UF apps)
+        # pass explicit extra owners.  Used by the device backends to
+        # extract the cone of influence of a query instead of sweeping
+        # the whole pool (ops/pallas_prop.py).
+        self.def_clauses: Dict[int, List[int]] = {}
 
     # ------------------------------------------------------------------
     # gates
     # ------------------------------------------------------------------
 
-    def _clause(self, lits: Sequence[int]) -> None:
+    def _clause(self, lits: Sequence[int], owners: Sequence[int] = ()) -> None:
         self.solver.add_clause(lits)
+        index = len(self.clauses_py)
         self.clauses_py.append(tuple(lits))
+        owner = max((abs(l) for l in lits), default=0)
+        if owner > 1:
+            self.def_clauses.setdefault(owner, []).append(index)
+        for extra in owners:
+            if abs(extra) > 1 and abs(extra) != owner:
+                self.def_clauses.setdefault(abs(extra), []).append(index)
         self.pool_version += 1
         self.clause_count += 1
+
+    def cone(self, root_lits: Sequence[int]):
+        """(clause_indices, vars) of the defining cone of ``root_lits``.
+
+        Walks defining clauses backward from the roots: every variable's
+        semantics (the gates computing it from the query's free inputs)
+        is included; clauses merely *consuming* a cone variable for some
+        unrelated constraint are not.  Propagation restricted to the
+        cone is sound for UNSAT (every pool clause holds globally) and
+        complete enough for model probing (free inputs are in the cone).
+        """
+        seen_vars = set()
+        seen_clauses = set()
+        clause_indices: List[int] = []
+        stack = [abs(l) for l in root_lits if abs(l) > 1]
+        while stack:
+            var = stack.pop()
+            if var in seen_vars:
+                continue
+            seen_vars.add(var)
+            for ci in self.def_clauses.get(var, ()):
+                if ci in seen_clauses:
+                    continue
+                seen_clauses.add(ci)
+                clause_indices.append(ci)
+                for lit in self.clauses_py[ci]:
+                    w = abs(lit)
+                    if w > 1 and w not in seen_vars:
+                        stack.append(w)
+        clause_indices.sort()
+        return clause_indices, seen_vars
 
     def new_lit(self) -> int:
         return self.solver.new_var()
@@ -374,8 +420,8 @@ class BlastContext:
         for prev_idx, prev_bits in reads:
             same = self.eq_lit(idx_bits, self.blast_bits(prev_idx))
             for a, b in zip(bits, prev_bits):
-                self._clause([-same, -a, b])
-                self._clause([-same, a, -b])
+                self._clause([-same, -a, b], owners=(a,))
+                self._clause([-same, a, -b], owners=(a,))
         reads.append((idx, bits))
         return bits
 
@@ -397,8 +443,8 @@ class BlastContext:
                 ]
             )
             for a, b in zip(bits, prev_bits):
-                self._clause([-same, -a, b])
-                self._clause([-same, a, -b])
+                self._clause([-same, -a, b], owners=(a,))
+                self._clause([-same, a, -b], owners=(a,))
         apps.append((args, bits))
         return bits
 

@@ -20,6 +20,10 @@ class Args(object, metaclass=Singleton):
         # TPU-build extras
         self.batched_solving = True          # batch frontier feasibility checks
         self.batch_lanes = 64                # target lanes per TPU solver batch
+        # below this many undecided lanes the native CDCL wins outright
+        # (device dispatch + sweep latency exceeds the whole CPU solve);
+        # measured on the embedded corpus, see laser/batch.py
+        self.device_min_lanes = 8
 
 
 args = Args()

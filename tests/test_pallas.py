@@ -126,13 +126,16 @@ def test_differential_random_cnf_vs_cdcl():
         pool = DenseClausePool()
         pool.refresh(clauses, num_vars + 1)
         B = 8
+        import jax
         import jax.numpy as jnp
 
         A0 = np.zeros((B, pool.V), dtype=np.float32)
         A0[:, 1] = 1.0
-        phases = jnp.ones((24, B), dtype=jnp.float32)
         step = make_dense_solve(pool.C, pool.V, B, 24, True)
-        _, st = step(pool.P, pool.N, pool.Pt, pool.Nt, pool.width, jnp.asarray(A0), phases)
+        _, st = step(
+            pool.P, pool.N, pool.Pt, pool.Nt, pool.width,
+            jnp.asarray(A0), jax.random.PRNGKey(trial),
+        )
         kernel_unsat = int(np.asarray(st)[0, 0]) == 2
         truths.append(truth)
         kernel_unsats += kernel_unsat
@@ -147,6 +150,7 @@ def test_differential_random_cnf_vs_cdcl():
 def test_wide_clauses_not_dropped():
     """Clauses wider than the gather path's MAX_CLAUSE_WIDTH are fully
     represented densely: an unsatisfiable wide instance conflicts."""
+    import jax
     import jax.numpy as jnp
 
     num_vars = 16
@@ -157,7 +161,9 @@ def test_wide_clauses_not_dropped():
     B = 8
     A0 = np.zeros((B, pool.V), dtype=np.float32)
     A0[:, 1] = 1.0
-    phases = jnp.ones((4, B), dtype=jnp.float32)
     step = make_dense_solve(pool.C, pool.V, B, 4, True)
-    _, st = step(pool.P, pool.N, pool.Pt, pool.Nt, pool.width, jnp.asarray(A0), phases)
+    _, st = step(
+        pool.P, pool.N, pool.Pt, pool.Nt, pool.width,
+        jnp.asarray(A0), jax.random.PRNGKey(0),
+    )
     assert int(np.asarray(st)[0, 0]) == 2
