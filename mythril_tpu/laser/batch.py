@@ -45,20 +45,23 @@ def prune_infeasible(states: List) -> List:
             continue
         undecided.append(state)
 
-    # gate on the number of *unique* constraint sets: sibling forks often
-    # share identical constraints, and a deduped 1-2 lane device dispatch
-    # costs more than the whole CPU solve (terms are interned, so node
-    # identity is a sound dedupe key)
-    unique_sets = {
-        frozenset(
-            id(c.raw) if hasattr(c, "raw") else id(c)
-            for c in s.world_state.constraints
-            if not isinstance(c, bool)
-        )
-        for s in undecided
-    }
     min_lanes = max(2, getattr(args, "device_min_lanes", 8))
-    if len(unique_sets) >= min_lanes and args.batched_solving:
+    use_batch = args.batched_solving and len(undecided) >= min_lanes
+    if use_batch:
+        # gate on the number of *unique* constraint sets: sibling forks
+        # often share identical constraints, and a deduped 1-2 lane
+        # device dispatch costs more than the whole CPU solve (terms are
+        # interned, so node identity is a sound dedupe key)
+        unique_sets = {
+            frozenset(
+                id(c.raw) if hasattr(c, "raw") else id(c)
+                for c in s.world_state.constraints
+                if not isinstance(c, bool)
+            )
+            for s in undecided
+        }
+        use_batch = len(unique_sets) >= min_lanes
+    if use_batch:
         try:
             from mythril_tpu.ops.batched_sat import batch_check_states
 

@@ -40,6 +40,9 @@ def _probe() -> bool:
 
     def run():
         try:
+            from mythril_tpu.ops import configure_jax
+
+            configure_jax()  # honor JAX_PLATFORMS before backend init
             import jax
             import jax.numpy as jnp
 
@@ -90,9 +93,22 @@ def device_ok() -> bool:
 
 def backend_name() -> Optional[str]:
     """The backend discovered by the probe ('tpu', 'cpu', ...); None if
-    the probe has not run or backend init itself hung."""
+    backend init itself hung.  When the probe was skipped via
+    MYTHRIL_TPU_HEALTH=ok the operator asserts the device is healthy,
+    so a direct (undeadlined) backend query is acceptable."""
+    global _backend_name
     if _verdict is None:
         device_ok()
+    if _backend_name is None and _verdict:
+        try:
+            from mythril_tpu.ops import configure_jax
+
+            configure_jax()
+            import jax
+
+            _backend_name = jax.default_backend()
+        except Exception as e:  # noqa: BLE001
+            log.warning("backend query failed: %s", e)
     return _backend_name
 
 

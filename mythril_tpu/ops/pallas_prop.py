@@ -467,9 +467,13 @@ class PallasSatBackend:
         import jax.numpy as jnp
 
         from mythril_tpu.ops import configure_jax
+        from mythril_tpu.ops.device_health import backend_name
 
         configure_jax()
-        interpret = jax.default_backend() != "tpu"
+        # backend_name() keeps backend discovery under the health
+        # deadline (a direct jax.default_backend() here could be the
+        # process's first backend init and hang on a wedged tunnel)
+        interpret = backend_name() != "tpu"
         batch = len(assumption_sets)
         orig_v1 = ctx.solver.num_vars + 1
         assignments = np.zeros((batch, orig_v1), dtype=np.int8)

@@ -49,6 +49,12 @@ class BlastContext:
         self.array_reads: Dict[int, List[Tuple[T.Node, List[int]]]] = {}
         self.uf_apps: Dict[int, List[Tuple[Tuple[T.Node, ...], List[int]]]] = {}
         self.clause_count = 0
+        # recent satisfying assignments: paths grow one branch condition
+        # at a time, so the previous model very often still satisfies the
+        # extended constraint set — verifying a candidate is a term-DAG
+        # walk, orders of magnitude cheaper than a CDCL search
+        self.recent_models: List[T.EvalEnv] = []
+        self._freevar_cache: Dict[int, frozenset] = {}
         # defining-cone index: var -> indices of the clauses that define
         # it.  By construction (Tseitin), the defined gate is the
         # youngest variable in its defining clauses, so the default
@@ -506,17 +512,131 @@ class BlastContext:
         conflict_budget: int = -1,
     ) -> Tuple[int, Optional[T.EvalEnv]]:
         """Returns (status, env) with status in SatSolver.{SAT,UNSAT,UNKNOWN}."""
-        assumptions = []
+        nodes = []
         for c in constraints:
             if c is T.FALSE:
                 return SatSolver.UNSAT, None
             if c is T.TRUE:
                 continue
-            assumptions.append(self.blast_lit(c))
+            nodes.append(c)
+        env = self._probe_candidates(nodes)
+        if env is not None:
+            return SatSolver.SAT, env
+        assumptions = [self.blast_lit(c) for c in nodes]
         status = self.solver.solve(assumptions, conflict_budget, timeout_s)
         if status != SatSolver.SAT:
             return status, None
-        return status, self._extract_model()
+        env = self._extract_model()
+        self._remember_model(env)
+        return status, env
+
+    # ------------------------------------------------------------------
+    # word-level candidate probing (pre-CDCL fast path)
+    # ------------------------------------------------------------------
+
+    def _free_vars(self, node: T.Node) -> frozenset:
+        """Free var/bvar nodes of a DAG, cached by node id."""
+        cached = self._freevar_cache.get(node.id)
+        if cached is not None:
+            return cached
+        out = set()
+        stack = [node]
+        seen = set()
+        while stack:
+            n = stack.pop()
+            if n.id in seen:
+                continue
+            seen.add(n.id)
+            hit = self._freevar_cache.get(n.id)
+            if hit is not None:
+                out |= hit
+                continue
+            if n.op in ("var", "bvar"):
+                out.add(n)
+            stack.extend(n.args)
+        result = frozenset(out)
+        self._freevar_cache[node.id] = result
+        return result
+
+    @staticmethod
+    def _equality_hints(nodes: Sequence[T.Node]) -> Dict[int, int]:
+        """var node id -> forced value from top-level ``var == const``
+        conjuncts (the dominant constraint shape: function selectors,
+        fixed callvalues, storage keys)."""
+        hints: Dict[int, int] = {}
+        work = list(nodes)
+        while work:
+            n = work.pop()
+            if n.op == "band":
+                work.extend(n.args)
+                continue
+            if n.op == "bvar":
+                hints[n.id] = True
+                continue
+            if n.op == "bnot" and n.args[0].op == "bvar":
+                hints[n.args[0].id] = False
+                continue
+            if n.op == "eq":
+                a, b = n.args
+                if a.op == "var" and b.op == "const":
+                    hints.setdefault(a.id, b.params[0])
+                elif b.op == "var" and a.op == "const":
+                    hints.setdefault(b.id, a.params[0])
+        return hints
+
+    def _probe_candidates(
+        self, nodes: Sequence[T.Node]
+    ) -> Optional[T.EvalEnv]:
+        """Try a handful of cheap structured assignments before paying
+        for a CDCL search.  Any env for which every constraint evaluates
+        to True is a genuine model (evaluation is total: missing
+        variables/array cells/UF values default to 0)."""
+        if not nodes:
+            return T.EvalEnv()
+        free: set = set()
+        for n in nodes:
+            free |= self._free_vars(n)
+        hints = self._equality_hints(nodes)
+        bv = [n for n in free if n.op == "var"]
+
+        def filled(base: Dict[int, int], fill) -> Dict[int, int]:
+            out = dict(hints)
+            out.update(base)
+            for n in bv:
+                if n.id not in out:
+                    out[n.id] = fill(n)
+            return out
+
+        candidates: List[T.EvalEnv] = [
+            T.EvalEnv(variables=dict(hints)),  # hints + zeros
+            T.EvalEnv(variables=filled({}, lambda n: T.mask(n.width))),
+            T.EvalEnv(variables=filled({}, lambda n: 1 << (n.width - 1))),
+        ]
+        for env in self.recent_models:
+            merged = dict(env.variables)
+            merged.update(hints)
+            candidates.append(
+                T.EvalEnv(
+                    variables=merged,
+                    arrays={k: dict(v) for k, v in env.arrays.items()},
+                    ufs=dict(env.ufs),
+                )
+            )
+        for env in candidates:
+            cache: Dict[int, object] = {}
+            try:
+                if all(
+                    T.evaluate(n, env, cache) is True for n in nodes
+                ):
+                    self._remember_model(env)
+                    return env
+            except Exception:  # noqa: BLE001 — probe failure is normal
+                continue
+        return None
+
+    def _remember_model(self, env: T.EvalEnv, keep: int = 6) -> None:
+        self.recent_models.insert(0, env)
+        del self.recent_models[keep:]
 
     def _bits_value(self, bits: List[int]) -> int:
         value = 0

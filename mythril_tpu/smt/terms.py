@@ -181,6 +181,10 @@ def mul(a: Node, b: Node) -> Node:
                 return const(0, a.width)
             if x.value == 1:
                 return y
+            if (x.value & (x.value - 1)) == 0:
+                # multiplication by 2^k is a left shift (constant shifts
+                # lower to rewiring in the bit-blaster)
+                return shl(y, const(x.value.bit_length() - 1, a.width))
     if a.is_const:
         a, b = b, a
     return _bin("mul", a, b)
@@ -193,6 +197,12 @@ def udiv(a: Node, b: Node) -> Node:
         return const(a.value // b.value, a.width)
     if b.is_const and b.value == 1:
         return a
+    if b.is_const and b.value and (b.value & (b.value - 1)) == 0:
+        # division by 2^k is a right shift; the bit-blaster lowers a
+        # constant shift to rewiring, while a udiv circuit is ~W^2
+        # gates — solc's selector dispatch (PUSH29 2^224; DIV) hits
+        # this on every function entry
+        return lshr(a, const(b.value.bit_length() - 1, a.width))
     return _bin("udiv", a, b)
 
 
@@ -216,6 +226,9 @@ def urem(a: Node, b: Node) -> Node:
         if b.value == 0:
             return a
         return const(a.value % b.value, a.width)
+    if b.is_const and b.value and (b.value & (b.value - 1)) == 0:
+        # x % 2^k == x & (2^k - 1): bitwise AND instead of a divider
+        return bv_and(a, const(b.value - 1, a.width))
     return _bin("urem", a, b)
 
 
