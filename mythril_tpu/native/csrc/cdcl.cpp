@@ -89,12 +89,24 @@ class Solver {
     }
     if (out.empty()) { ok_ = false; return false; }
     if (out.size() == 1) {
+      // global unit: belongs at level 0 (kills any saved trail — rare)
+      if (decision_level() > 0) { cancelUntil(0); prev_assumptions_.clear(); }
       if (value(out[0]) == -1) { ok_ = false; return false; }
       if (value(out[0]) == 0) {
         uncheckedEnqueue(out[0], -1);
         if (propagate() != -1) { ok_ = false; return false; }
       }
       return true;
+    }
+    if (decision_level() > 0) {
+      // Clause addition invalidates the saved assumption trail (the
+      // clause may be falsified by kept assignments).  Mid-trail
+      // attachment was tried and lost badly: under a kept trail most
+      // fresh Tseitin clauses are unit, turning every blast into a
+      // propagation storm.  Queries interleave blasting and solving,
+      // so prefix reuse only pays off for blast-free repeats.
+      cancelUntil(0);
+      prev_assumptions_.clear();
     }
     attach(out, false);
     return true;
@@ -105,12 +117,24 @@ class Solver {
             double time_budget_s) {
     conflict_core_.clear();
     if (!ok_) return -1;
+    // Assumption-prefix trail reuse: queries arrive as incrementally
+    // growing path-constraint sets, so consecutive calls usually share
+    // a long assumption prefix.  Decision level i+1 always holds
+    // assumptions_[i] (search() re-decides them in order after any
+    // backjump), so keeping the first k matching levels skips
+    // re-propagating the shared cone — the dominant cost of a query
+    // against a large clause pool.
+    size_t k = 0;
+    size_t max_k = std::min(prev_assumptions_.size(), (size_t)n_assumps);
+    if ((int)max_k > decision_level()) max_k = (size_t)decision_level();
+    while (k < max_k && prev_assumptions_[k] == assumps[k]) ++k;
+    cancelUntil((int)k);
     assumptions_.assign(assumps, assumps + n_assumps);
+    prev_assumptions_ = assumptions_;
     budget_conflicts_ = conflict_budget;
     deadline_ = time_budget_s > 0 ? now() + time_budget_s : -1.0;
     conflicts_this_call_ = 0;
     model_.clear();
-    cancelUntil(0);
 
     int restart = 0;
     int status = 0;
@@ -118,14 +142,14 @@ class Solver {
       int64_t luby_len = 100 * luby(restart++);
       status = search(luby_len);
       if (budget_conflicts_ >= 0 && conflicts_this_call_ >= budget_conflicts_)
-        { if (status == 0) { cancelUntil(0); return 0; } }
+        { if (status == 0) break; }
       if (deadline_ > 0 && now() > deadline_)
-        { if (status == 0) { cancelUntil(0); return 0; } }
+        { if (status == 0) break; }
     }
     if (status == 1) {
       model_.assign(assigns_.begin(), assigns_.end());
     }
-    cancelUntil(0);
+    // keep the trail: the next call reuses the matching prefix
     return status;
   }
 
@@ -158,6 +182,7 @@ class Solver {
   vector<Var> heap_;
   vector<int> heap_pos_;
   vector<Lit> assumptions_;
+  vector<Lit> prev_assumptions_;  // for assumption-prefix trail reuse
   vector<Lit> conflict_core_;
   vector<int8_t> model_;
   int64_t budget_conflicts_ = -1;
@@ -472,10 +497,11 @@ class Solver {
             now() > deadline_)
           return 0;
         if (local_conflicts >= conflicts_allowed) {
-          cancelUntil((int)assumptions_.size() <= decision_level()
-                          ? (int)assumptions_.size()
-                          : 0);
-          cancelUntil(0);
+          // restart: undo search decisions but keep the assumption
+          // levels — re-propagating a large assumption cone on every
+          // restart dwarfs the restart's benefit
+          cancelUntil(std::min(decision_level(),
+                               (int)assumptions_.size()));
           return 0;  // restart
         }
       } else {
