@@ -427,9 +427,17 @@ def main() -> None:
     )
     create_hash_to_addr_parser(hash_to_addr_parser)
     subparsers.add_parser("version", parents=[output_parser], help="Outputs the version")
-    subparsers.add_parser(
-        "pro", help="(unavailable) MythX cloud analysis", parents=[output_parser]
+    pro_parser = subparsers.add_parser(
+        "pro",
+        help="Submits the contract to a cloud analysis endpoint "
+        "(requires MYTHX_API_URL)",
+        parents=[
+            rpc_parser, utilities_parser, creation_input_parser,
+            runtime_input_parser, output_parser,
+        ],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
     )
+    create_analyzer_parser(pro_parser)
     subparsers.add_parser(
         "truffle", help="(unavailable) analyze a truffle project"
     )
@@ -482,6 +490,18 @@ def execute_command(
     parser: argparse.ArgumentParser,
     args: argparse.Namespace,
 ) -> None:
+    if args.command == "pro":
+        from mythril_tpu import mythx
+
+        try:
+            report = mythx.analyze(disassembler.contracts)
+        except mythx.MythXApiError as e:
+            raise CriticalError(str(e)) from e
+        print(
+            report.as_json() if args.outform == "json" else report.as_text()
+        )
+        return
+
     if args.command in DISASSEMBLE_LIST:
         if disassembler.contracts[0].code:
             print("Runtime Disassembly: \n" + disassembler.contracts[0].get_easm())
@@ -640,10 +660,24 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
                 print(f"{module_data['classname']}: {module_data['title']}")
         sys.exit()
 
-    if args.command in ("pro", "truffle"):
+    if args.command == "pro":
+        # cheap precheck before any compile/load work; the actual
+        # submission happens in execute_command via the shared
+        # disassembler/load_code path
+        from mythril_tpu import mythx
+
+        if mythx.api_url() is None:
+            exit_with_error(
+                getattr(args, "outform", "text"),
+                "The 'pro' command submits contracts to a cloud analysis "
+                "endpoint; set MYTHX_API_URL to use it (this environment "
+                "has no network egress by default).",
+            )
+
+    if args.command == "truffle":
         exit_with_error(
             getattr(args, "outform", "text"),
-            f"The '{args.command}' command is not available in this build "
+            "The 'truffle' command is not available in this build "
             "(its external backend does not exist in this environment).",
         )
 

@@ -1,0 +1,282 @@
+"""Batched 256-bit arithmetic as 8x32-bit limbs (little-endian).
+
+The lockstep EVM stepper keeps machine words as ``uint32[..., 8]``
+arrays so whole frontiers of stacks/storage move through the VPU/MXU in
+one op (reference counterpart: Python bigints inside
+mythril/laser/ethereum/instructions.py — nothing to port; EVM words are
+256-bit and TPUs have 32-bit lanes, so limbs are the canonical
+representation, cf. the scaling-book recipe of mapping math to
+hardware-native tiles).
+
+All functions broadcast over leading batch dimensions and are
+jit/vmap-safe: carry chains are statically unrolled (8 or 16 steps), no
+data-dependent control flow.  64-bit integers are never used (TPU lanes
+are 32-bit; x64 emulation is global and slow), so multiplication works
+in 16-bit half-limbs whose column sums provably fit in uint32.
+"""
+
+from typing import Tuple
+
+import numpy as np
+
+LIMBS = 32  # bits per limb
+NUM_LIMBS = 8
+MASK32 = 0xFFFFFFFF
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# host <-> device conversion
+# ---------------------------------------------------------------------------
+
+
+def from_int(value: int, batch_shape: Tuple[int, ...] = ()) -> np.ndarray:
+    """Python int -> uint32[*batch_shape, 8] (value broadcast)."""
+    value &= (1 << 256) - 1
+    limbs = np.array(
+        [(value >> (32 * i)) & MASK32 for i in range(NUM_LIMBS)],
+        dtype=np.uint32,
+    )
+    return np.broadcast_to(limbs, batch_shape + (NUM_LIMBS,)).copy()
+
+
+def to_int(limbs) -> int:
+    """uint32[8] -> Python int (single word, not batched)."""
+    arr = np.asarray(limbs, dtype=np.uint64)
+    assert arr.shape[-1] == NUM_LIMBS
+    value = 0
+    for i in range(NUM_LIMBS - 1, -1, -1):
+        value = (value << 32) | int(arr[..., i])
+    return value
+
+
+# ---------------------------------------------------------------------------
+# add / sub / neg
+# ---------------------------------------------------------------------------
+
+
+def add(a, b):
+    """(a + b) mod 2^256, elementwise over leading batch dims."""
+    jnp = _jnp()
+    out = []
+    carry = jnp.zeros(a.shape[:-1], dtype=jnp.uint32)
+    for i in range(NUM_LIMBS):
+        s = a[..., i] + b[..., i]
+        c1 = (s < a[..., i]).astype(jnp.uint32)
+        s2 = s + carry
+        c2 = (s2 < s).astype(jnp.uint32)
+        out.append(s2)
+        carry = c1 | c2  # at most one of them fires
+    return jnp.stack(out, axis=-1)
+
+
+def bit_not(a):
+    jnp = _jnp()
+    return (~a).astype(jnp.uint32)
+
+
+def neg(a):
+    """two's complement negate mod 2^256"""
+    jnp = _jnp()
+    one = jnp.zeros_like(a).at[..., 0].set(1)
+    return add(bit_not(a), one)
+
+
+def sub(a, b):
+    """(a - b) mod 2^256"""
+    return add(a, neg(b))
+
+
+# ---------------------------------------------------------------------------
+# comparisons
+# ---------------------------------------------------------------------------
+
+
+def eq(a, b):
+    jnp = _jnp()
+    return jnp.all(a == b, axis=-1)
+
+
+def is_zero(a):
+    jnp = _jnp()
+    return jnp.all(a == 0, axis=-1)
+
+
+def ult(a, b):
+    """unsigned a < b (lexicographic from the most significant limb)"""
+    jnp = _jnp()
+    result = jnp.zeros(a.shape[:-1], dtype=bool)
+    decided = jnp.zeros(a.shape[:-1], dtype=bool)
+    for i in range(NUM_LIMBS - 1, -1, -1):
+        lt = a[..., i] < b[..., i]
+        ne = a[..., i] != b[..., i]
+        result = jnp.where(~decided & ne, lt, result)
+        decided = decided | ne
+    return result
+
+
+def ule(a, b):
+    return ~ult(b, a)
+
+
+def slt(a, b):
+    """signed a < b (two's complement)"""
+    jnp = _jnp()
+    sign_a = (a[..., -1] >> 31).astype(bool)
+    sign_b = (b[..., -1] >> 31).astype(bool)
+    return jnp.where(sign_a == sign_b, ult(a, b), sign_a)
+
+
+# ---------------------------------------------------------------------------
+# bitwise
+# ---------------------------------------------------------------------------
+
+
+def bit_and(a, b):
+    return a & b
+
+
+def bit_or(a, b):
+    return a | b
+
+
+def bit_xor(a, b):
+    return a ^ b
+
+
+# ---------------------------------------------------------------------------
+# shifts (shift amount is a plain int32/uint32 array, not limbs —
+# amounts >= 256 yield 0 / sign-fill like the EVM)
+# ---------------------------------------------------------------------------
+
+
+def _limb_select(a, idx, fill):
+    """a[..., idx] with out-of-range idx -> fill (idx may be negative)."""
+    jnp = _jnp()
+    valid = (idx >= 0) & (idx < NUM_LIMBS)
+    safe = jnp.clip(idx, 0, NUM_LIMBS - 1)
+    gathered = jnp.take_along_axis(
+        a, safe[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    return jnp.where(valid, gathered, fill)
+
+
+def shl(a, amount):
+    """a << amount mod 2^256; amount: uint32[...] (broadcast)"""
+    jnp = _jnp()
+    # clamp before the signed cast: uint32 amounts >= 2^31 must not
+    # wrap negative and dodge the >= 256 overflow guard
+    amount = jnp.minimum(amount.astype(jnp.uint32), 257).astype(jnp.int32)
+    word = amount // 32
+    bit = (amount % 32).astype(jnp.uint32)
+    zero = jnp.zeros(a.shape[:-1], dtype=jnp.uint32)
+    out = []
+    for i in range(NUM_LIMBS):
+        lo = _limb_select(a, i - word, zero)
+        hi = _limb_select(a, i - word - 1, zero)
+        # (lo << bit) | (hi >> (32 - bit)); bit==0 must not shift by 32
+        hi_part = jnp.where(
+            bit == 0, jnp.zeros_like(hi), hi >> (32 - bit)
+        )
+        out.append(((lo << bit) | hi_part).astype(jnp.uint32))
+    result = jnp.stack(out, axis=-1)
+    return jnp.where((amount >= 256)[..., None], 0, result)
+
+
+def lshr(a, amount):
+    """logical a >> amount; amount: uint32[...]"""
+    jnp = _jnp()
+    # clamp before the signed cast: uint32 amounts >= 2^31 must not
+    # wrap negative and dodge the >= 256 overflow guard
+    amount = jnp.minimum(amount.astype(jnp.uint32), 257).astype(jnp.int32)
+    word = amount // 32
+    bit = (amount % 32).astype(jnp.uint32)
+    zero = jnp.zeros(a.shape[:-1], dtype=jnp.uint32)
+    out = []
+    for i in range(NUM_LIMBS):
+        lo = _limb_select(a, i + word, zero)
+        hi = _limb_select(a, i + word + 1, zero)
+        lo_part = lo >> bit
+        hi_part = jnp.where(
+            bit == 0, jnp.zeros_like(hi), hi << (32 - bit)
+        )
+        out.append((lo_part | hi_part).astype(jnp.uint32))
+    result = jnp.stack(out, axis=-1)
+    return jnp.where((amount >= 256)[..., None], 0, result)
+
+
+def sar(a, amount):
+    """arithmetic a >> amount (EVM SAR: fill with the sign bit)"""
+    jnp = _jnp()
+    sign = (a[..., -1] >> 31).astype(jnp.uint32)  # 0 or 1
+    fill_word = jnp.where(sign == 1, jnp.uint32(MASK32), jnp.uint32(0))
+    # clamp before the signed cast: uint32 amounts >= 2^31 must not
+    # wrap negative and dodge the >= 256 overflow guard
+    amount = jnp.minimum(amount.astype(jnp.uint32), 257).astype(jnp.int32)
+    word = amount // 32
+    bit = (amount % 32).astype(jnp.uint32)
+    out = []
+    for i in range(NUM_LIMBS):
+        lo = _limb_select(a, i + word, fill_word)
+        hi = _limb_select(a, i + word + 1, fill_word)
+        lo_part = lo >> bit
+        hi_part = jnp.where(
+            bit == 0, jnp.zeros_like(hi), hi << (32 - bit)
+        )
+        out.append((lo_part | hi_part).astype(jnp.uint32))
+    result = jnp.stack(out, axis=-1)
+    overflow = jnp.broadcast_to(fill_word[..., None], result.shape)
+    return jnp.where((amount >= 256)[..., None], overflow, result)
+
+
+# ---------------------------------------------------------------------------
+# multiplication (16-bit half-limb schoolbook)
+# ---------------------------------------------------------------------------
+
+
+def mul(a, b):
+    """(a * b) mod 2^256.
+
+    Half-limb schoolbook: 16x16-bit products split into lo/hi 16-bit
+    halves before column accumulation, so every column sum is bounded by
+    32 * (2^16 - 1) < 2^21 — no uint32 overflow, no 64-bit ops.
+    """
+    jnp = _jnp()
+    H = 16  # half-limbs per word
+
+    ah = []
+    bh = []
+    for i in range(NUM_LIMBS):
+        ah.append(a[..., i] & 0xFFFF)
+        ah.append(a[..., i] >> 16)
+        bh.append(b[..., i] & 0xFFFF)
+        bh.append(b[..., i] >> 16)
+
+    cols = [None] * (H + 1)  # one extra for the last hi overflow
+
+    def acc(j, v):
+        cols[j] = v if cols[j] is None else cols[j] + v
+
+    for i in range(H):
+        for j in range(H - i):
+            p = ah[i] * bh[j]  # < 2^32 - 2^17: exact in uint32
+            acc(i + j, p & 0xFFFF)
+            if i + j + 1 < H:
+                acc(i + j + 1, p >> 16)
+
+    zero = jnp.zeros(a.shape[:-1], dtype=jnp.uint32)
+    carry = zero
+    halves = []
+    for j in range(H):
+        total = (zero if cols[j] is None else cols[j]) + carry
+        halves.append(total & 0xFFFF)
+        carry = total >> 16
+    out = []
+    for i in range(NUM_LIMBS):
+        out.append(halves[2 * i] | (halves[2 * i + 1] << 16))
+    return jnp.stack(out, axis=-1)

@@ -1,0 +1,93 @@
+"""Cloud-submission module tests (mythril_tpu/mythx) with a mocked
+transport — request payload shape, polling flow, and response->Issue
+conversion.  Live submission needs network access and is out of scope
+here (the reference's mythx tests mock pythx the same way)."""
+
+import pytest
+
+from mythril_tpu import mythx
+from mythril_tpu.solidity.evmcontract import EVMContract
+
+
+class FakeTransport:
+    def __init__(self, issues_response):
+        self.token = None
+        self.requests = []
+        self.issues_response = issues_response
+        self.polls = 0
+
+    def post(self, path, payload):
+        self.requests.append(("POST", path, payload))
+        if path == "/v1/auth/login":
+            return {"jwt": {"access": "tok"}}
+        if path == "/v1/analyses":
+            return {"uuid": "abc-123"}
+        raise AssertionError(path)
+
+    def get(self, path):
+        self.requests.append(("GET", path, None))
+        if path == "/v1/analyses/abc-123":
+            self.polls += 1
+            return {"status": "Finished" if self.polls >= 1 else "Queued"}
+        if path == "/v1/analyses/abc-123/issues":
+            return self.issues_response
+        raise AssertionError(path)
+
+
+ISSUES_RESPONSE = [
+    {
+        "issues": [
+            {
+                "swcID": "SWC-106",
+                "swcTitle": "Unprotected SELFDESTRUCT",
+                "severity": "High",
+                "description": {"head": "Anyone can kill it", "tail": "..."},
+                "locations": [{"sourceMap": "146:1:0"}],
+                "contract": "MAIN",
+                "function": "kill()",
+            }
+        ]
+    }
+]
+
+
+def test_analyze_flow_and_conversion():
+    contract = EVMContract(code="0x6001600101", name="MAIN")
+    transport = FakeTransport(ISSUES_RESPONSE)
+    report = mythx.analyze([contract], transport=transport)
+    issues = list(report.issues.values())
+    assert len(issues) == 1
+    issue = issues[0]
+    assert issue.swc_id == "106"
+    assert issue.address == 146
+    assert issue.severity == "High"
+    # auth happened before submission, with a bearer token set after
+    assert transport.requests[0][1] == "/v1/auth/login"
+    assert transport.token == "tok"
+    submitted = [r for r in transport.requests if r[1] == "/v1/analyses"]
+    assert submitted and submitted[0][2]["deployedBytecode"].startswith("0x")
+
+
+def test_payload_shape():
+    contract = EVMContract(
+        code="0x6001", creation_code="0x6002", name="Tok"
+    )
+    payload = mythx.build_request_payload(contract)
+    assert payload["contractName"] == "Tok"
+    assert payload["bytecode"] == "0x6002"
+    assert payload["deployedBytecode"] == "0x6001"
+    assert payload["analysisMode"] == "quick"
+
+
+def test_analyze_without_endpoint_raises():
+    with pytest.raises(mythx.MythXApiError, match="MYTHX_API_URL"):
+        mythx.analyze([EVMContract(code="0x6001")], transport=None)
+
+
+def test_issue_conversion_handles_sparse_fields():
+    issues = mythx.issues_from_response(
+        [{"issues": [{"swcID": "SWC-101", "description": "plain text"}]}]
+    )
+    assert issues[0].swc_id == "101"
+    assert issues[0].description_head == "plain text"
+    assert issues[0].address == 0

@@ -1,0 +1,139 @@
+"""Differential tests for the 256-bit limb primitives (ops/u256.py):
+every op is compared against Python bigint arithmetic over random and
+adversarial operands, batched, under jit."""
+
+import random
+
+import numpy as np
+import pytest
+
+from mythril_tpu.ops import u256
+
+M256 = (1 << 256) - 1
+
+EDGE = [
+    0,
+    1,
+    2,
+    (1 << 32) - 1,
+    1 << 32,
+    (1 << 128) - 1,
+    1 << 128,
+    M256,
+    M256 - 1,
+    1 << 255,
+    (1 << 255) - 1,
+]
+
+
+def _pairs(n=40, seed=7):
+    rng = random.Random(seed)
+    pairs = [(x, y) for x in EDGE for y in EDGE[:4]]
+    for _ in range(n):
+        pairs.append(
+            (rng.getrandbits(256), rng.getrandbits(rng.choice([8, 64, 256])))
+        )
+    return pairs
+
+
+def _batch(pairs):
+    a = np.stack([u256.from_int(x) for x, _ in pairs])
+    b = np.stack([u256.from_int(y) for _, y in pairs])
+    return a, b
+
+
+def test_roundtrip():
+    for x in EDGE:
+        assert u256.to_int(u256.from_int(x)) == x
+
+
+@pytest.mark.parametrize(
+    "name,fn,ref",
+    [
+        ("add", u256.add, lambda x, y: (x + y) & M256),
+        ("sub", u256.sub, lambda x, y: (x - y) & M256),
+        ("mul", u256.mul, lambda x, y: (x * y) & M256),
+        ("and", u256.bit_and, lambda x, y: x & y),
+        ("or", u256.bit_or, lambda x, y: x | y),
+        ("xor", u256.bit_xor, lambda x, y: x ^ y),
+    ],
+)
+def test_binary_ops(name, fn, ref):
+    import jax
+
+    pairs = _pairs()
+    a, b = _batch(pairs)
+    out = np.asarray(jax.jit(fn)(a, b))
+    for k, (x, y) in enumerate(pairs):
+        assert u256.to_int(out[k]) == ref(x, y), (name, hex(x), hex(y))
+
+
+@pytest.mark.parametrize(
+    "name,fn,ref",
+    [
+        ("eq", u256.eq, lambda x, y: x == y),
+        ("ult", u256.ult, lambda x, y: x < y),
+        ("ule", u256.ule, lambda x, y: x <= y),
+        (
+            "slt",
+            u256.slt,
+            lambda x, y: (x - (1 << 256) if x >> 255 else x)
+            < (y - (1 << 256) if y >> 255 else y),
+        ),
+    ],
+)
+def test_comparisons(name, fn, ref):
+    import jax
+
+    pairs = _pairs()
+    pairs += [(x, x) for x in EDGE]  # equality diagonal
+    a, b = _batch(pairs)
+    out = np.asarray(jax.jit(fn)(a, b))
+    for k, (x, y) in enumerate(pairs):
+        assert bool(out[k]) == ref(x, y), (name, hex(x), hex(y))
+
+
+@pytest.mark.parametrize(
+    "name,fn,ref",
+    [
+        ("shl", u256.shl, lambda x, s: (x << s) & M256 if s < 256 else 0),
+        ("lshr", u256.lshr, lambda x, s: x >> s if s < 256 else 0),
+        (
+            "sar",
+            u256.sar,
+            lambda x, s: (
+                ((x - (1 << 256)) >> min(s, 255)) & M256
+                if x >> 255
+                else (x >> s if s < 256 else 0)
+            ),
+        ),
+    ],
+)
+def test_shifts(name, fn, ref):
+    import jax
+
+    rng = random.Random(3)
+    values = EDGE + [rng.getrandbits(256) for _ in range(10)]
+    amounts = [
+        0, 1, 16, 31, 32, 33, 63, 64, 127, 128, 255, 256, 300,
+        (1 << 31), (1 << 32) - 1,  # must not wrap negative internally
+    ]
+    cases = [(v, s) for v in values for s in amounts]
+    a = np.stack([u256.from_int(v) for v, _ in cases])
+    s = np.asarray([s for _, s in cases], dtype=np.uint32)
+    out = np.asarray(jax.jit(fn)(a, s))
+    for k, (v, sh) in enumerate(cases):
+        assert u256.to_int(out[k]) == ref(v, sh), (name, hex(v), sh)
+
+
+def test_neg_is_zero():
+    import jax
+
+    values = EDGE
+    a = np.stack([u256.from_int(v) for v in values])
+    out = np.asarray(jax.jit(u256.neg)(a))
+    for k, v in enumerate(values):
+        assert u256.to_int(out[k]) == (-v) & M256
+    z = np.asarray(jax.jit(u256.is_zero)(a))
+    for k, v in enumerate(values):
+        assert bool(z[k]) == (v == 0)
