@@ -1,5 +1,6 @@
-"""Benchmark: end-to-end analysis wall-clock on an embedded vulnerable
-corpus (the BASELINE.md protocol scaled to a self-contained run).
+"""Benchmark: end-to-end analysis wall-clock over the reference's
+compiled contract corpus (BASELINE.md protocol), falling back to an
+embedded assembler-built corpus when the reference tree is absent.
 
 Prints ONE json line:
   {"metric": ..., "value": N, "unit": "s", "vs_baseline": N}
@@ -10,13 +11,32 @@ recorded wall-clock of reference Mythril's own default configuration on
 comparable single-contract corpora from its CI era (~60s per contract
 batch with Z3 on CPU — the nominal budget BASELINE.md's protocol
 implies); treat it as indicative until a true side-by-side exists.
+
+Every contract must also yield its expected SWC findings — a fast run
+that misses findings exits nonzero (perf never trades against the
+detection oracle).
 """
 
 import json
+import os
 import sys
 import time
 
 NOMINAL_REFERENCE_WALL_S = 60.0
+
+REFERENCE_INPUTS = "/root/reference/tests/testdata/inputs"
+
+# (file, tx_count, minimum expected SWC ids) — see tests/test_detection.py
+REFERENCE_CORPUS = [
+    ("suicide.sol.o", 1, {"106"}),
+    ("origin.sol.o", 1, {"115"}),
+    ("exceptions.sol.o", 1, {"110"}),
+    ("returnvalue.sol.o", 1, {"104", "107"}),
+    ("calls.sol.o", 1, {"104", "107"}),
+    ("overflow.sol.o", 2, {"101"}),
+    ("underflow.sol.o", 2, {"101"}),
+    ("ether_send.sol.o", 2, {"105"}),
+]
 
 
 def _corpus():
@@ -64,12 +84,25 @@ def _corpus():
     ]
 
 
+def _full_corpus():
+    """Reference compiled corpus when mounted, else the embedded one."""
+    cases = []
+    if os.path.isdir(REFERENCE_INPUTS):
+        for filename, tx_count, expected in REFERENCE_CORPUS:
+            path = os.path.join(REFERENCE_INPUTS, filename)
+            if os.path.exists(path):
+                code = open(path).read().strip()
+                cases.append((filename.split(".")[0], code, tx_count, expected))
+    return cases + _corpus()
+
+
 def main() -> None:
     import logging
 
     logging.basicConfig(level=logging.CRITICAL)
     logging.getLogger("mythril_tpu").setLevel(logging.CRITICAL)
 
+    from mythril_tpu.analysis.module.loader import ModuleLoader
     from mythril_tpu.analysis.security import fire_lasers
     from mythril_tpu.analysis.symbolic import SymExecWrapper
     from mythril_tpu.laser.ethereum.time_handler import time_handler
@@ -80,9 +113,12 @@ def main() -> None:
     total_contracts = 0
     missed = []
     begin = time.time()
-    for name, code, tx_count, expected_swcs in _corpus():
+    for name, code, tx_count, expected_swcs in _full_corpus():
         reset_blast_context()
         clear_model_cache()
+        for module in ModuleLoader().get_detection_modules():
+            module.reset_module()
+            module.cache.clear()
         contract = EVMContract(code=code, name=name)
         time_handler.start_execution(300)
         sym = SymExecWrapper(
