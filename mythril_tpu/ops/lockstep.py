@@ -1,0 +1,666 @@
+"""Lockstep batched EVM interpreter — whole frontiers stepped per device op.
+
+The reference steps ONE state at a time through a Python dict dispatch
+(mythril/laser/ethereum/svm.py:221 worklist loop +
+instructions.py:231 evaluate).  This module is the TPU-native
+counterpart for the concrete/concolic regime: machine state is kept as
+struct-of-arrays over a lane batch and every VM step advances ALL lanes
+at once:
+
+- ``stack``:  uint32[B, S, 8]   (256-bit words as 8x32-bit limbs, LSW first)
+- ``sp/pc``:  int32[B]
+- ``memory``: uint8[B, M]       (byte-addressed, fixed arena)
+- ``storage``: associative arrays key/val uint32[B, K, 8] + used mask
+- ``halt``:   int32[B]          (0 run, 1 stop, 2 return, 3 revert,
+                                 4 exception, 5 needs-host)
+
+Dispatch is SIMT-style: per step the opcode vector selects per-group
+lane masks, and each group's batched handler runs under ``lax.cond`` on
+"any lane needs it" — so a frontier that never divides never pays for
+the 256-round division loop, while correlated frontiers (the common
+case: same contract, many inputs) execute one or two groups per step.
+One shared program (code + precomputed JUMPDEST validity) serves the
+whole batch: the multi-input concolic/fuzzing regime.
+
+Ops that require host services (KECCAK, external calls, tx context
+beyond the static env) halt the lane with NEEDS_HOST so a driver can
+service and resume — same philosophy as the batched solver's CDCL
+fallback.  Lanes are independent, so the batch axis shards cleanly
+over a device mesh (see __graft_entry__.dryrun_multichip).
+"""
+
+import functools
+from typing import NamedTuple
+
+import numpy as np
+
+from mythril_tpu.ops import u256
+
+STACK_SLOTS = 64
+MEMORY_BYTES = 4096
+STORAGE_SLOTS = 32
+
+RUNNING, STOPPED, RETURNED, REVERTED, ERROR, NEEDS_HOST = 0, 1, 2, 3, 4, 5
+
+
+class Program(NamedTuple):
+    """Host-prepared shared bytecode: padded code + jumpdest validity."""
+
+    code: np.ndarray        # uint8[L + 33] (zero padded)
+    jumpdest: np.ndarray    # bool[L + 33]
+    length: int
+
+
+def prepare_program(code: bytes) -> Program:
+    arr = np.frombuffer(code, dtype=np.uint8)
+    valid = np.zeros(len(arr) + 33, dtype=bool)
+    i = 0
+    while i < len(arr):
+        op = arr[i]
+        if op == 0x5B:
+            valid[i] = True
+        i += 33 - 32 + (op - 0x5F) if 0x60 <= op <= 0x7F else 1
+    padded = np.concatenate([arr, np.zeros(33, dtype=np.uint8)])
+    return Program(padded, valid, len(arr))
+
+
+class EVMState(NamedTuple):
+    stack: object    # u32[B, S, 8]
+    sp: object       # i32[B]
+    pc: object       # i32[B]
+    memory: object   # u8[B, M]
+    skeys: object    # u32[B, K, 8]
+    svals: object    # u32[B, K, 8]
+    sused: object    # bool[B, K]
+    calldata: object  # u8[B, C]
+    calldatasize: object  # i32[B]
+    callvalue: object     # u32[B, 8]
+    caller: object        # u32[B, 8]
+    halt: object     # i32[B]
+    ret_off: object  # i32[B]
+    ret_len: object  # i32[B]
+
+
+def init_state(batch: int, calldata: np.ndarray, calldatasize, callvalue=None,
+               caller=None, storage_keys=None, storage_vals=None):
+    """Fresh SoA state; calldata uint8[B, C] (padded so windowed reads
+    at any in-size offset stay inside the arena)."""
+    import jax.numpy as jnp
+
+    B = batch
+    calldata = np.concatenate(
+        [np.asarray(calldata, np.uint8), np.zeros((batch, 32), np.uint8)],
+        axis=1,
+    )
+    if callvalue is None:
+        callvalue = np.zeros((B, 8), np.uint32)
+    if caller is None:
+        caller = np.zeros((B, 8), np.uint32)
+    skeys = np.zeros((B, STORAGE_SLOTS, 8), np.uint32)
+    svals = np.zeros((B, STORAGE_SLOTS, 8), np.uint32)
+    sused = np.zeros((B, STORAGE_SLOTS), bool)
+    if storage_keys is not None:
+        n = storage_keys.shape[1]
+        skeys[:, :n] = storage_keys
+        svals[:, :n] = storage_vals
+        sused[:, :n] = True
+    return EVMState(
+        stack=jnp.zeros((B, STACK_SLOTS, 8), jnp.uint32),
+        sp=jnp.zeros(B, jnp.int32),
+        pc=jnp.zeros(B, jnp.int32),
+        memory=jnp.zeros((B, MEMORY_BYTES), jnp.uint8),
+        skeys=jnp.asarray(skeys),
+        svals=jnp.asarray(svals),
+        sused=jnp.asarray(sused),
+        calldata=jnp.asarray(calldata, jnp.uint8),
+        calldatasize=jnp.asarray(calldatasize, jnp.int32),
+        callvalue=jnp.asarray(callvalue, jnp.uint32),
+        caller=jnp.asarray(caller, jnp.uint32),
+        halt=jnp.zeros(B, jnp.int32),
+        ret_off=jnp.zeros(B, jnp.int32),
+        ret_len=jnp.zeros(B, jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# batched stack helpers (mask-aware)
+# ---------------------------------------------------------------------------
+
+
+def _peek(state, depth):
+    """stack[sp - 1 - depth] per lane -> u32[B, 8] (clamped)."""
+    import jax.numpy as jnp
+
+    idx = jnp.clip(state.sp - 1 - depth, 0, STACK_SLOTS - 1)
+    B = state.sp.shape[0]
+    return state.stack[jnp.arange(B), idx]
+
+
+def _set_at(stack, idx, value, mask):
+    import jax.numpy as jnp
+
+    B = stack.shape[0]
+    idx = jnp.clip(idx, 0, STACK_SLOTS - 1)
+    updated = stack.at[jnp.arange(B), idx].set(value)
+    return jnp.where(mask[:, None, None], updated, stack)
+
+
+def _binop(state, mask, fn):
+    """pop a, b; push fn(a, b) — the shape of most arithmetic ops."""
+    import jax.numpy as jnp
+
+    a = _peek(state, 0)
+    b = _peek(state, 1)
+    result = fn(a, b)
+    stack = _set_at(state.stack, state.sp - 2, result, mask)
+    sp = jnp.where(mask, state.sp - 1, state.sp)
+    pc = jnp.where(mask, state.pc + 1, state.pc)
+    return state._replace(stack=stack, sp=sp, pc=pc)
+
+
+def _cmp_to_word(flag):
+    import jax.numpy as jnp
+
+    return jnp.zeros(flag.shape + (8,), jnp.uint32).at[..., 0].set(
+        flag.astype(jnp.uint32)
+    )
+
+
+def _bytes_to_word(window):
+    """uint8[B, 32] big-endian -> u32[B, 8] little-limb."""
+    import jax.numpy as jnp
+
+    w = window.astype(jnp.uint32)
+    limbs = []
+    for i in range(8):  # limb i holds bytes [31-4i-3 .. 31-4i]
+        hi = 31 - 4 * i - 3
+        limbs.append(
+            (w[:, hi] << 24) | (w[:, hi + 1] << 16)
+            | (w[:, hi + 2] << 8) | (w[:, hi + 3])
+        )
+    return jnp.stack(limbs, axis=-1)
+
+
+def _word_to_bytes(word):
+    """u32[B, 8] -> uint8[B, 32] big-endian."""
+    import jax.numpy as jnp
+
+    parts = []
+    for i in range(7, -1, -1):
+        limb = word[:, i]
+        parts += [limb >> 24, (limb >> 16) & 0xFF, (limb >> 8) & 0xFF,
+                  limb & 0xFF]
+    return jnp.stack(parts, axis=-1).astype(jnp.uint8)
+
+
+def _gather32(arena, offset):
+    """32 bytes per lane at dynamic byte offsets (clamped to the arena)."""
+    import jax
+    import jax.numpy as jnp
+
+    offset = jnp.clip(offset, 0, arena.shape[1] - 32)
+    return jax.vmap(
+        lambda row, o: jax.lax.dynamic_slice(row, (o,), (32,))
+    )(arena, offset)
+
+
+def _scatter32(arena, offset, data, mask):
+    import jax
+    import jax.numpy as jnp
+
+    offset = jnp.clip(offset, 0, arena.shape[1] - 32)
+    updated = jax.vmap(
+        lambda row, o, d: jax.lax.dynamic_update_slice(row, d, (o,))
+    )(arena, offset, data)
+    return jnp.where(mask[:, None], updated, arena)
+
+
+# ---------------------------------------------------------------------------
+# the step function
+# ---------------------------------------------------------------------------
+
+
+def make_step(program: Program):
+    """Build step(state) -> state for one shared program."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    code = jnp.asarray(program.code)
+    jumpdest = jnp.asarray(program.jumpdest)
+    code_len = program.length
+
+    def guarded(mask, fn):
+        """Run a batched handler only when some lane selects it."""
+
+        def apply(state):
+            return lax.cond(jnp.any(mask), lambda s: fn(s, mask),
+                            lambda s: s, state)
+
+        return apply
+
+    def underflow_check(state, op, need):
+        bad = (state.halt == RUNNING) & (state.sp < need[op])
+        return state._replace(
+            halt=jnp.where(bad, ERROR, state.halt)
+        )
+
+    def step(state):
+        B = state.sp.shape[0]
+        pc = jnp.clip(state.pc, 0, code.shape[0] - 1)
+        op = code[pc].astype(jnp.int32)
+        # lanes at/after code end implicitly STOP
+        op = jnp.where(state.pc >= code_len, 0x00, op)
+        live = state.halt == RUNNING
+
+        # stack-underflow precheck (table built host-side)
+        need = jnp.asarray(_POPS_TABLE)
+        state = underflow_check(state, op, need)
+        live = state.halt == RUNNING
+
+        def m(*opcodes):
+            sel = jnp.zeros_like(live)
+            for oc in opcodes:
+                sel = sel | (op == oc)
+            return sel & live
+
+        # --- STOP ---
+        def h_stop(s, mask):
+            return s._replace(halt=jnp.where(mask, STOPPED, s.halt))
+
+        # --- cheap arithmetic / bitwise / comparison group ---
+        def h_arith(s, mask):
+            for oc, fn in [
+                (0x01, u256.add), (0x03, u256.sub), (0x16, u256.bit_and),
+                (0x17, u256.bit_or), (0x18, u256.bit_xor),
+            ]:
+                sub_mask = mask & (op == oc)
+                s = lax.cond(
+                    jnp.any(sub_mask),
+                    lambda s, f=fn, mm=sub_mask: _binop(s, mm, f),
+                    lambda s: s, s,
+                )
+            for oc, cmp in [
+                (0x10, lambda a, b: u256.ult(a, b)),
+                (0x11, lambda a, b: u256.ult(b, a)),
+                (0x12, u256.slt),
+                (0x13, lambda a, b: u256.slt(b, a)),
+                (0x14, u256.eq),
+            ]:
+                sub_mask = mask & (op == oc)
+                s = lax.cond(
+                    jnp.any(sub_mask),
+                    lambda s, c=cmp, mm=sub_mask: _binop(
+                        s, mm, lambda a, b: _cmp_to_word(c(a, b))
+                    ),
+                    lambda s: s, s,
+                )
+            return s
+
+        # --- mul (heavier; own group) ---
+        def h_mul(s, mask):
+            return _binop(s, mask, u256.mul)
+
+        # --- division family (256-round loops; only when present) ---
+        def h_div(s, mask):
+            for oc, fn in [
+                (0x04, lambda a, b: u256.udivmod(a, b)[0]),
+                (0x05, u256.sdiv),
+                (0x06, lambda a, b: u256.udivmod(a, b)[1]),
+                (0x07, u256.smod),
+            ]:
+                sub_mask = mask & (op == oc)
+                s = lax.cond(
+                    jnp.any(sub_mask),
+                    lambda s, f=fn, mm=sub_mask: _binop(s, mm, f),
+                    lambda s: s, s,
+                )
+            return s
+
+        def h_exp(s, mask):
+            return _binop(s, mask, lambda a, b: u256.exp(a, b))
+
+        # --- shifts ---
+        def h_shift(s, mask):
+            def shift_fn(a, b):
+                # stack order: top = shift amount, second = value
+                amount = a[..., 0]
+                big = ~u256.is_zero(
+                    u256.bit_and(a, jnp.asarray(
+                        u256.from_int(((1 << 256) - 1) ^ 0xFFFFFFFF)
+                    ))
+                )
+                amount = jnp.where(big, 257, amount)
+                shifted_l = u256.shl(b, amount)
+                shifted_r = u256.lshr(b, amount)
+                shifted_a = u256.sar(b, amount)
+                return jnp.where(
+                    (op == 0x1B)[:, None], shifted_l,
+                    jnp.where((op == 0x1C)[:, None], shifted_r, shifted_a),
+                )
+
+            return _binop(s, mask, shift_fn)
+
+        # --- ISZERO / NOT (unary) ---
+        def h_unary(s, mask):
+            a = _peek(s, 0)
+            not_result = u256.bit_not(a)
+            isz = _cmp_to_word(u256.is_zero(a))
+            result = jnp.where((op == 0x15)[:, None], isz, not_result)
+            stack = _set_at(s.stack, s.sp - 1, result, mask)
+            return s._replace(
+                stack=stack, pc=jnp.where(mask, s.pc + 1, s.pc)
+            )
+
+        # --- PUSH1..PUSH32 / PUSH0 ---
+        def h_push(s, mask):
+            n = jnp.clip(op - 0x5F, 0, 32)
+            window = jax.vmap(
+                lambda p: lax.dynamic_slice(code, (p,), (32,))
+            )(jnp.clip(s.pc + 1, 0, code.shape[0] - 32))
+            word = _bytes_to_word(window)
+            value = u256.lshr(word, ((32 - n) * 8).astype(jnp.uint32))
+            overflow = s.sp >= STACK_SLOTS
+            stack = _set_at(s.stack, s.sp, value, mask & ~overflow)
+            return s._replace(
+                stack=stack,
+                sp=jnp.where(mask & ~overflow, s.sp + 1, s.sp),
+                pc=jnp.where(mask, s.pc + 1 + n, s.pc),
+                halt=jnp.where(mask & overflow, ERROR, s.halt),
+            )
+
+        # --- DUP1..16 / SWAP1..16 / POP ---
+        def h_dup(s, mask):
+            k = jnp.clip(op - 0x80, 0, 15)
+            value = _peek(s, k)
+            overflow = s.sp >= STACK_SLOTS
+            stack = _set_at(s.stack, s.sp, value, mask & ~overflow)
+            return s._replace(
+                stack=stack,
+                sp=jnp.where(mask & ~overflow, s.sp + 1, s.sp),
+                pc=jnp.where(mask, s.pc + 1, s.pc),
+                halt=jnp.where(mask & overflow, ERROR, s.halt),
+            )
+
+        def h_swap(s, mask):
+            k = jnp.clip(op - 0x8F, 1, 16)
+            top = _peek(s, 0)
+            deep = _peek(s, k)
+            stack = _set_at(s.stack, s.sp - 1, deep, mask)
+            stack = _set_at(stack, s.sp - 1 - k, top, mask)
+            return s._replace(
+                stack=stack, pc=jnp.where(mask, s.pc + 1, s.pc)
+            )
+
+        def h_pop(s, mask):
+            return s._replace(
+                sp=jnp.where(mask, s.sp - 1, s.sp),
+                pc=jnp.where(mask, s.pc + 1, s.pc),
+            )
+
+        # --- control flow ---
+        def h_jump(s, mask):
+            dest_word = _peek(s, 0)
+            dest = dest_word[..., 0].astype(jnp.int32)
+            high = jnp.zeros_like(mask)
+            for limb in range(1, 8):
+                high = high | (dest_word[..., limb] != 0)
+            valid = (
+                ~high
+                & (dest >= 0)
+                & (dest < code_len)
+                & jumpdest[jnp.clip(dest, 0, code.shape[0] - 1)]
+            )
+            return s._replace(
+                sp=jnp.where(mask, s.sp - 1, s.sp),
+                pc=jnp.where(mask & valid, dest, s.pc),
+                halt=jnp.where(mask & ~valid, ERROR, s.halt),
+            )
+
+        def h_jumpi(s, mask):
+            dest_word = _peek(s, 0)
+            cond_word = _peek(s, 1)
+            dest = dest_word[..., 0].astype(jnp.int32)
+            high = jnp.zeros_like(mask)
+            for limb in range(1, 8):
+                high = high | (dest_word[..., limb] != 0)
+            taken = ~u256.is_zero(cond_word)
+            valid = (
+                ~high
+                & (dest >= 0)
+                & (dest < code_len)
+                & jumpdest[jnp.clip(dest, 0, code.shape[0] - 1)]
+            )
+            bad = mask & taken & ~valid
+            return s._replace(
+                sp=jnp.where(mask, s.sp - 2, s.sp),
+                pc=jnp.where(
+                    mask & taken & valid, dest,
+                    jnp.where(mask, s.pc + 1, s.pc),
+                ),
+                halt=jnp.where(bad, ERROR, s.halt),
+            )
+
+        def h_jumpdest(s, mask):
+            return s._replace(pc=jnp.where(mask, s.pc + 1, s.pc))
+
+        def h_pc_op(s, mask):
+            value = _cmp_to_word(s.pc)  # pc fits 32 bits
+            value = value.at[..., 0].set(s.pc.astype(jnp.uint32))
+            overflow = s.sp >= STACK_SLOTS
+            stack = _set_at(s.stack, s.sp, value, mask & ~overflow)
+            return s._replace(
+                stack=stack,
+                sp=jnp.where(mask & ~overflow, s.sp + 1, s.sp),
+                pc=jnp.where(mask, s.pc + 1, s.pc),
+                halt=jnp.where(mask & overflow, ERROR, s.halt),
+            )
+
+        # --- memory ---
+        def h_mload(s, mask):
+            off = _peek(s, 0)[..., 0].astype(jnp.int32)
+            data = _gather32(s.memory, off)
+            value = _bytes_to_word(data)
+            stack = _set_at(s.stack, s.sp - 1, value, mask)
+            return s._replace(
+                stack=stack, pc=jnp.where(mask, s.pc + 1, s.pc)
+            )
+
+        def h_mstore(s, mask):
+            off = _peek(s, 0)[..., 0].astype(jnp.int32)
+            value = _peek(s, 1)
+            data = _word_to_bytes(value)
+            memory = _scatter32(s.memory, off, data, mask)
+            return s._replace(
+                memory=memory,
+                sp=jnp.where(mask, s.sp - 2, s.sp),
+                pc=jnp.where(mask, s.pc + 1, s.pc),
+            )
+
+        def h_mstore8(s, mask):
+            off = jnp.clip(
+                _peek(s, 0)[..., 0].astype(jnp.int32), 0, MEMORY_BYTES - 1
+            )
+            value = (_peek(s, 1)[..., 0] & 0xFF).astype(jnp.uint8)
+            B = s.sp.shape[0]
+            memory = s.memory.at[jnp.arange(B), off].set(value)
+            memory = jnp.where(mask[:, None], memory, s.memory)
+            return s._replace(
+                memory=memory,
+                sp=jnp.where(mask, s.sp - 2, s.sp),
+                pc=jnp.where(mask, s.pc + 1, s.pc),
+            )
+
+        # --- storage (associative linear scan over K slots) ---
+        def h_sload(s, mask):
+            key = _peek(s, 0)
+            hits = jnp.all(s.skeys == key[:, None, :], axis=-1) & s.sused
+            found = jnp.any(hits, axis=-1)
+            idx = jnp.argmax(hits, axis=-1)
+            B = s.sp.shape[0]
+            value = jnp.where(
+                found[:, None], s.svals[jnp.arange(B), idx], 0
+            ).astype(jnp.uint32)
+            stack = _set_at(s.stack, s.sp - 1, value, mask)
+            return s._replace(
+                stack=stack, pc=jnp.where(mask, s.pc + 1, s.pc)
+            )
+
+        def h_sstore(s, mask):
+            key = _peek(s, 0)
+            value = _peek(s, 1)
+            hits = jnp.all(s.skeys == key[:, None, :], axis=-1) & s.sused
+            found = jnp.any(hits, axis=-1)
+            free = jnp.argmax(~s.sused, axis=-1)
+            full = jnp.all(s.sused, axis=-1) & ~found
+            idx = jnp.where(found, jnp.argmax(hits, axis=-1), free)
+            B = s.sp.shape[0]
+            write = mask & ~full
+            skeys = s.skeys.at[jnp.arange(B), idx].set(
+                jnp.where(write[:, None], key, s.skeys[jnp.arange(B), idx])
+            )
+            svals = s.svals.at[jnp.arange(B), idx].set(
+                jnp.where(write[:, None], value, s.svals[jnp.arange(B), idx])
+            )
+            sused = s.sused.at[jnp.arange(B), idx].set(
+                jnp.where(write, True, s.sused[jnp.arange(B), idx])
+            )
+            return s._replace(
+                skeys=skeys, svals=svals, sused=sused,
+                sp=jnp.where(mask, s.sp - 2, s.sp),
+                pc=jnp.where(mask, s.pc + 1, s.pc),
+                halt=jnp.where(mask & full, NEEDS_HOST, s.halt),
+            )
+
+        # --- environment / calldata ---
+        def h_env(s, mask):
+            is_caller = op == 0x33
+            is_value = op == 0x34
+            is_size = op == 0x36
+            value = jnp.where(
+                is_caller[:, None], s.caller,
+                jnp.where(is_value[:, None], s.callvalue, 0),
+            ).astype(jnp.uint32)
+            size_word = jnp.zeros_like(value).at[..., 0].set(
+                s.calldatasize.astype(jnp.uint32)
+            )
+            value = jnp.where(is_size[:, None], size_word, value)
+            overflow = s.sp >= STACK_SLOTS
+            stack = _set_at(s.stack, s.sp, value, mask & ~overflow)
+            return s._replace(
+                stack=stack,
+                sp=jnp.where(mask & ~overflow, s.sp + 1, s.sp),
+                pc=jnp.where(mask, s.pc + 1, s.pc),
+                halt=jnp.where(mask & overflow, ERROR, s.halt),
+            )
+
+        def h_calldataload(s, mask):
+            off = _peek(s, 0)[..., 0].astype(jnp.int32)
+            window = _gather32(s.calldata, off)
+            # out-of-size bytes read as zero
+            B = s.sp.shape[0]
+            positions = jnp.clip(off, 0, s.calldata.shape[1] - 32)[:, None] \
+                + jnp.arange(32)[None, :]
+            in_range = positions < s.calldatasize[:, None]
+            window = jnp.where(in_range, window, 0)
+            value = _bytes_to_word(window)
+            stack = _set_at(s.stack, s.sp - 1, value, mask)
+            return s._replace(
+                stack=stack, pc=jnp.where(mask, s.pc + 1, s.pc)
+            )
+
+        # --- RETURN / REVERT ---
+        def h_return(s, mask):
+            off = _peek(s, 0)[..., 0].astype(jnp.int32)
+            length = _peek(s, 1)[..., 0].astype(jnp.int32)
+            code_ = jnp.where(op == 0xF3, RETURNED, REVERTED)
+            return s._replace(
+                halt=jnp.where(mask, code_, s.halt),
+                ret_off=jnp.where(mask, off, s.ret_off),
+                ret_len=jnp.where(mask, length, s.ret_len),
+            )
+
+        # --- anything else -> needs host (calls, sha3, logs, ...) ---
+        handled = jnp.zeros_like(live)
+        groups = [
+            (m(0x00), h_stop),
+            (m(0x01, 0x03, 0x10, 0x11, 0x12, 0x13, 0x14, 0x16, 0x17, 0x18),
+             h_arith),
+            (m(0x02), h_mul),
+            (m(0x04, 0x05, 0x06, 0x07), h_div),
+            (m(0x0A), h_exp),
+            (m(0x1B, 0x1C, 0x1D), h_shift),
+            (m(0x15, 0x19), h_unary),
+            (m(*range(0x5F, 0x80)), h_push),
+            (m(*range(0x80, 0x90)), h_dup),
+            (m(*range(0x90, 0xA0)), h_swap),
+            (m(0x50), h_pop),
+            (m(0x56), h_jump),
+            (m(0x57), h_jumpi),
+            (m(0x5B), h_jumpdest),
+            (m(0x58), h_pc_op),
+            (m(0x51), h_mload),
+            (m(0x52), h_mstore),
+            (m(0x53), h_mstore8),
+            (m(0x54), h_sload),
+            (m(0x55), h_sstore),
+            (m(0x33, 0x34, 0x36), h_env),
+            (m(0x35), h_calldataload),
+            (m(0xF3, 0xFD), h_return),
+        ]
+        for mask, handler in groups:
+            handled = handled | mask
+            state = guarded(mask, handler)(state)
+        unknown = live & ~handled
+        state = state._replace(
+            halt=jnp.where(unknown, NEEDS_HOST, state.halt)
+        )
+        return state
+
+    return step
+
+
+# stack items popped per opcode (0 where not meaningful) — underflow guard
+_POPS_TABLE = np.zeros(256, dtype=np.int32)
+for _oc, _n in {
+    0x01: 2, 0x02: 2, 0x03: 2, 0x04: 2, 0x05: 2, 0x06: 2, 0x07: 2,
+    0x0A: 2, 0x10: 2, 0x11: 2, 0x12: 2, 0x13: 2, 0x14: 2, 0x15: 1,
+    0x16: 2, 0x17: 2, 0x18: 2, 0x19: 1, 0x1B: 2, 0x1C: 2, 0x1D: 2,
+    0x35: 1, 0x50: 1, 0x51: 1, 0x52: 2, 0x53: 2, 0x54: 1, 0x55: 2,
+    0x56: 1, 0x57: 2, 0xF3: 2, 0xFD: 2,
+}.items():
+    _POPS_TABLE[_oc] = _n
+for _k in range(16):
+    _POPS_TABLE[0x80 + _k] = _k + 1   # DUPn needs n items
+    _POPS_TABLE[0x90 + _k] = _k + 2   # SWAPn needs n+1 items
+
+
+@functools.lru_cache(maxsize=32)
+def _jit_run(code_bytes: bytes, max_steps: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    program = prepare_program(code_bytes)
+    step = make_step(program)
+
+    def run(state):
+        def cond(carry):
+            state, i = carry
+            return jnp.any(state.halt == RUNNING) & (i < max_steps)
+
+        def body(carry):
+            state, i = carry
+            return step(state), i + 1
+
+        state, steps = lax.while_loop(cond, body, (state, 0))
+        return state, steps
+
+    return jax.jit(run), program
+
+
+def run_batch(code: bytes, state, max_steps: int = 4096):
+    """Run all lanes to halt (or the step cap) and return the final
+    state + step count."""
+    run, _ = _jit_run(bytes(code), max_steps)
+    return run(state)

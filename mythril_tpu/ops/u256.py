@@ -235,6 +235,97 @@ def sar(a, amount):
 
 
 # ---------------------------------------------------------------------------
+# division / modulo / exponentiation
+# ---------------------------------------------------------------------------
+
+
+def _shl1_with_bit(r, bit):
+    """(r << 1) | bit for uint32[...,8] with a scalar-per-lane bit."""
+    jnp = _jnp()
+    out = []
+    carry = bit.astype(jnp.uint32)
+    for i in range(NUM_LIMBS):
+        limb = r[..., i]
+        out.append(((limb << 1) | carry).astype(jnp.uint32))
+        carry = limb >> 31
+    return jnp.stack(out, axis=-1)
+
+
+def _bit_at(a, index):
+    """bit `index` (0 = LSB) of each word; index is a traced scalar."""
+    jnp = _jnp()
+    word = index // 32
+    limb = jnp.take(a, word, axis=-1)
+    return (limb >> (index % 32).astype(jnp.uint32)) & 1
+
+
+def udivmod(a, b):
+    """(a // b, a % b) with EVM semantics for b == 0: (0, 0)... note —
+    SMT-LIB differs; the EVM DIV/MOD define x/0 = 0 and x%0 = 0, which
+    is what the lockstep stepper needs.  Restoring long division,
+    256 iterations under lax.fori_loop."""
+    import jax
+
+    jnp = _jnp()
+    zero = jnp.zeros_like(a)
+
+    def body(i, carry):
+        q, r = carry
+        bit = _bit_at(a, 255 - i)
+        r2 = _shl1_with_bit(r, bit)
+        ge = ~ult(r2, b)  # r2 >= b
+        r3 = jnp.where(ge[..., None], sub(r2, b), r2)
+        q2 = _shl1_with_bit(q, ge)
+        return q2, r3
+
+    q, r = jax.lax.fori_loop(0, 256, body, (zero, zero))
+    div_zero = is_zero(b)[..., None]
+    return jnp.where(div_zero, 0, q), jnp.where(div_zero, 0, r)
+
+
+def _abs_signed(a):
+    jnp = _jnp()
+    negative = (a[..., -1] >> 31) == 1
+    return jnp.where(negative[..., None], neg(a), a), negative
+
+
+def sdiv(a, b):
+    """EVM SDIV: truncated signed division, x/0 = 0."""
+    jnp = _jnp()
+    aa, na = _abs_signed(a)
+    ab, nb = _abs_signed(b)
+    q, _ = udivmod(aa, ab)
+    flip = na ^ nb
+    return jnp.where(flip[..., None], neg(q), q)
+
+
+def smod(a, b):
+    """EVM SMOD: result takes the dividend's sign, x%0 = 0."""
+    jnp = _jnp()
+    aa, na = _abs_signed(a)
+    ab, _ = _abs_signed(b)
+    _, r = udivmod(aa, ab)
+    return jnp.where(na[..., None], neg(r), r)
+
+
+def exp(a, e):
+    """a ** e mod 2^256 by square-and-multiply (256 fixed rounds)."""
+    import jax
+
+    jnp = _jnp()
+
+    def body(i, carry):
+        result, base = carry
+        bit = _bit_at(e, i)
+        result = jnp.where((bit == 1)[..., None], mul(result, base), result)
+        return result, mul(base, base)
+
+    one = from_int(1, a.shape[:-1])
+    result, _ = jax.lax.fori_loop(0, 256, body, (jnp.asarray(one), a))
+    return result
+
+
+# ---------------------------------------------------------------------------
 # multiplication (16-bit half-limb schoolbook)
 # ---------------------------------------------------------------------------
 
