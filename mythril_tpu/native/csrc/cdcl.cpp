@@ -473,7 +473,17 @@ class Solver {
           // assumption set is jointly UNSAT with the clause DB.  (Core
           // extraction intentionally omitted — no consumer yet; see
           // analyzeFinal for the per-literal path.)
+          //
+          // Backtrack below the conflicting level before returning.
+          // The conflict clause always has >=1 literal assigned at the
+          // current level (each level is fully propagated before the
+          // next assumption is decided), so undoing one level leaves no
+          // falsified clause fully assigned on the kept trail.  Without
+          // this, a later solve() reusing the assumption prefix would
+          // inherit the conflicting assignments with qhead_ already
+          // past them and could answer SAT against a falsified clause.
           conflict_core_.clear();
+          cancelUntil(decision_level() - 1);
           return -1;
         }
         int back_level = analyze(confl, learnt);
@@ -481,7 +491,13 @@ class Solver {
         if (learnt.size() == 1) {
           if (value(learnt[0]) == 0) uncheckedEnqueue(learnt[0], -1);
           else if (value(learnt[0]) == -1) {
+            // analyze() returns back_level 0 for unit learnts, so after
+            // cancelUntil above we are at level 0 and a false unit means
+            // the DB itself is UNSAT.  (The >0 return is defensive and
+            // unreachable; it still honors the trail-hygiene contract of
+            // the assumption-conflict path above.)
             if (decision_level() == 0) { ok_ = false; return -1; }
+            cancelUntil(decision_level() - 1);
             return -1;
           }
         } else {

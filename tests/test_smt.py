@@ -218,3 +218,97 @@ def test_function_wrapper():
     fx = f(x)
     assert fx.func_name == "keccak_test_fn"
     assert fx.size == 256
+
+
+def test_unsat_assumption_prefix_not_poisoned():
+    """Regression: an UNSAT answer under assumptions must not leave the
+    conflicting trail behind.  Before the fix, search() returned -1 from
+    an assumption-level conflict without backtracking; a later solve()
+    sharing the assumption prefix inherited the falsified clause fully
+    assigned (qhead_ already past it) and could answer SAT with a model
+    violating the clause DB (ADVICE.md r1, high)."""
+    s = SatSolver()
+    a, b, d = s.new_var(), s.new_var(), s.new_var()
+    assert s.add_clause([-a, b])
+    assert s.add_clause([-a, -b])
+    assert s.solve([a]) == SatSolver.UNSAT
+    # Same prefix, one more assumption: still UNSAT, not a bogus SAT.
+    assert s.solve([a, d]) == SatSolver.UNSAT
+    # Dropping the poisoned assumption must be SAT with a real model.
+    assert s.solve([-a, d]) == SatSolver.SAT
+    assert s.model_value(a) is False
+    assert s.model_value(d) is True
+
+
+def test_unsat_deep_assumption_prefix_reuse():
+    """Conflict at the second assumption level; repeated prefix-sharing
+    queries keep rediscovering UNSAT, and a compatible query's model
+    satisfies every clause."""
+    s = SatSolver()
+    x, y, z, w = (s.new_var() for _ in range(4))
+    clauses = [[-x, -y, z], [-x, -y, -z], [x, w]]
+    for c in clauses:
+        assert s.add_clause(list(c))
+    assert s.solve([x, y]) == SatSolver.UNSAT
+    assert s.solve([x, y, w]) == SatSolver.UNSAT
+    assert s.solve([x, y, -w]) == SatSolver.UNSAT
+    assert s.solve([x, -y]) == SatSolver.SAT
+    model = {v: s.model_value(v) for v in (x, y, z, w)}
+    for c in clauses:
+        assert any(model[abs(l)] == (l > 0) for l in c)
+
+
+def test_unsat_then_sat_randomized_differential():
+    """Randomized incremental-assumption soundness: every SAT model must
+    satisfy the whole clause DB, every UNSAT verdict must match brute
+    force over the assumption cube."""
+    rng = random.Random(1234)
+    for trial in range(30):
+        s = SatSolver()
+        n = 6
+        vars_ = [s.new_var() for _ in range(n)]
+        clauses = []
+        for _ in range(rng.randint(4, 14)):
+            width = rng.randint(1, 3)
+            c = [rng.choice(vars_) * rng.choice((1, -1)) for _ in range(width)]
+            clauses.append(c)
+            s.add_clause(list(c))
+
+        def brute(assumps):
+            fixed = {}
+            for l in assumps:
+                if fixed.get(abs(l), l > 0) != (l > 0):
+                    return False  # contradictory assumption cube
+                fixed[abs(l)] = l > 0
+            free = [v for v in vars_ if v not in fixed]
+            for bits in range(1 << len(free)):
+                m = dict(fixed)
+                for i, v in enumerate(free):
+                    m[v] = bool((bits >> i) & 1)
+                m[1] = True  # constant-true anchor
+                if all(
+                    any(m.get(abs(l), False) == (l > 0) for l in c)
+                    for c in clauses
+                ):
+                    return True
+            return False
+
+        prefix = []
+        for _ in range(5):
+            prefix = prefix + [rng.choice(vars_) * rng.choice((1, -1))]
+            res = s.solve(list(prefix))
+            expect = brute(prefix)
+            if res == SatSolver.SAT:
+                assert expect, f"trial {trial}: SAT but brute says UNSAT"
+                m = {v: s.model_value(v) for v in vars_}
+                for c in clauses:
+                    assert any(m[abs(l)] == (l > 0) for l in c), (
+                        f"trial {trial}: model violates clause {c}"
+                    )
+                for l in prefix:
+                    assert m[abs(l)] == (l > 0)
+            elif res == SatSolver.UNSAT:
+                assert not expect, f"trial {trial}: UNSAT but brute says SAT"
+                # occasionally rewind to a sat prefix and keep going
+                if rng.random() < 0.5:
+                    prefix = prefix[: rng.randint(0, len(prefix) - 1)]
