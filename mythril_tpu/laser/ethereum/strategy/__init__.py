@@ -36,3 +36,21 @@ class BasicSearchStrategy(ABC):
             if global_state.mstate.depth < self.max_depth:
                 return global_state
             # beyond max depth: drop and pick another
+
+    def pop_batch(self, max_lanes: int) -> List[GlobalState]:
+        """Draw up to ``max_lanes`` states for one lockstep VM round.
+
+        This is the batch-selection policy surface (SURVEY §7.2.4): the
+        VM steps a whole wavefront per round and feasibility-checks the
+        union of its successors in one device pass.  The default draws
+        repeatedly through ``__next__`` so every strategy's ordering
+        (and any decorator's filtering) applies unchanged; a strategy
+        may override it to pick lanes jointly instead of sequentially.
+        """
+        batch: List[GlobalState] = []
+        while len(batch) < max_lanes:
+            try:
+                batch.append(next(self))
+            except (StopIteration, IndexError):
+                break
+        return batch

@@ -208,42 +208,67 @@ class LaserEVM:
     # ------------------------------------------------------------------
 
     def exec(self, create: bool = False, track_gas: bool = False):
+        """Wavefront worklist loop.
+
+        Unlike the reference's pop-one-state loop (reference
+        svm.py:221-265, one ``is_possible`` solver call per successor),
+        each round draws up to ``args.batch_width`` states from the
+        strategy, executes them, and feasibility-checks the *union* of
+        their successors in a single ``prune_infeasible`` pass — wide
+        enough for the TPU lockstep solver to engage mid-transaction.
+        """
         final_states: List[GlobalState] = []
         if self.time is None:
             self.time = datetime.now()
-        for global_state in self.strategy:
-            if (
-                self.create_timeout
-                and create
-                and self.time + timedelta(seconds=self.create_timeout)
-                <= datetime.now()
-            ):
-                log.debug("Hit create timeout, returning.")
-                return final_states + [global_state] if track_gas else None
-            if (
-                self.execution_timeout
-                and not create
-                and self.time + timedelta(seconds=self.execution_timeout)
-                <= datetime.now()
-            ):
-                log.debug("Hit execution timeout, returning.")
-                return final_states + [global_state] if track_gas else None
+        batch_width = max(1, getattr(args, "batch_width", 1))
+        while True:
+            batch = self.strategy.pop_batch(batch_width)
+            if not batch:
+                break
 
-            try:
-                new_states, op_code = self.execute_state(global_state)
-            except NotImplementedError:
-                log.debug("Encountered unimplemented instruction")
-                continue
+            # (executed state, op_code, successor states) per lane
+            rounds: List[Tuple[GlobalState, Optional[str], List[GlobalState]]] = []
+            for lane, global_state in enumerate(batch):
+                if (
+                    self.create_timeout
+                    and create
+                    and self.time + timedelta(seconds=self.create_timeout)
+                    <= datetime.now()
+                ):
+                    log.debug("Hit create timeout, returning.")
+                    self.work_list += batch[lane + 1 :]  # unexecuted lanes
+                    return final_states + [global_state] if track_gas else None
+                if (
+                    self.execution_timeout
+                    and not create
+                    and self.time + timedelta(seconds=self.execution_timeout)
+                    <= datetime.now()
+                ):
+                    log.debug("Hit execution timeout, returning.")
+                    self.work_list += batch[lane + 1 :]
+                    return final_states + [global_state] if track_gas else None
 
-            if not args.sparse_pruning and len(new_states) > 0:
-                new_states = prune_infeasible(new_states)
+                try:
+                    new_states, op_code = self.execute_state(global_state)
+                except NotImplementedError:
+                    log.debug("Encountered unimplemented instruction")
+                    continue
+                rounds.append((global_state, op_code, new_states))
 
-            self.manage_cfg(op_code, new_states)
-            if new_states:
-                self.work_list += new_states
-            elif track_gas:
-                final_states.append(global_state)
-            self.total_states += len(new_states)
+            all_new = [s for _, _, succ in rounds for s in succ]
+            if not args.sparse_pruning and all_new:
+                kept = {id(s) for s in prune_infeasible(all_new)}
+            else:
+                kept = {id(s) for s in all_new}
+
+            for global_state, op_code, new_states in rounds:
+                surviving = [s for s in new_states if id(s) in kept]
+                self.manage_cfg(op_code, surviving)
+                if surviving:
+                    self.work_list += surviving
+                elif track_gas:
+                    final_states.append(global_state)
+                self.total_states += len(surviving)
         return final_states if track_gas else None
 
     def execute_state(

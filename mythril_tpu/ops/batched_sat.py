@@ -36,6 +36,31 @@ log = logging.getLogger(__name__)
 MAX_CLAUSE_WIDTH = 8  # wider clauses stay CPU-only (soundness preserved)
 PROPAGATE_ITERS = 256  # BCP fixpoint cap per decision round
 DECISION_ROUNDS = 24  # probing depth before handing the lane to CDCL
+MAX_GATHER_CLAUSES = 8192  # beyond this the full-pool gather probe loses
+MAX_GATHER_VARS = 8192     # to the CDCL tail outright (see check_assumption_sets)
+
+
+class DispatchStats:
+    """Device-dispatch telemetry (read by bench.py ablations and the
+    solver-statistics report so speedup claims stay attributable)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.dispatches = 0        # device solve invocations
+        self.lanes = 0             # total lanes sent to device
+        self.unsat = 0             # lanes decided UNSAT on device
+        self.sat_verified = 0      # lanes whose device model verified on host
+        self.undecided = 0         # lanes handed to the CDCL tail
+        self.host_probe_sat = 0    # lanes decided by host word-level probing
+        self.mesh_dispatches = 0   # invocations through the sharded mesh path
+
+    def as_dict(self):
+        return dict(self.__dict__)
+
+
+dispatch_stats = DispatchStats()
 
 
 def _require_jax():
@@ -245,14 +270,23 @@ class BatchedSatBackend:
 
         from mythril_tpu.ops.device_health import device_ok
 
-        if not device_ok():
+        num_vars = ctx.solver.num_vars
+        # The gather probe scans the WHOLE pool per BCP iteration; past a
+        # few thousand clauses it costs orders of magnitude more than the
+        # incremental CDCL it is trying to save (measured: ~45 s/dispatch
+        # at 76k clauses vs ~ms per CDCL query).  Big-cone lanes go
+        # straight to the CDCL tail.
+        if (
+            len(ctx.clauses_py) > MAX_GATHER_CLAUSES
+            or num_vars > MAX_GATHER_VARS
+            or not device_ok()
+        ):
             self.last_assignments = np.zeros(
-                (len(assumption_sets), ctx.solver.num_vars + 1), np.int8
+                (len(assumption_sets), num_vars + 1), np.int8
             )
             return [None] * len(assumption_sets)
 
         jax, jnp = _require_jax()
-        num_vars = ctx.solver.num_vars
         if self.pool.version != ctx.pool_version or (
             self.pool.num_vars < num_vars
         ):
@@ -308,16 +342,28 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
 
     True = SAT (model verified against the term constraints),
     False = UNSAT (sound), None = undecided (caller uses CDCL).
+
+    Phases (cheapest decision procedure first):
+
+    1. structural: constraints folded to literal False;
+    2. host word-level probing per lane (shared ``recent_models``, so a
+       model found for one lane immediately serves its siblings) — this
+       decides the easy-SAT majority in microseconds per lane and keeps
+       them off the device entirely;
+    3. device dense-cone BCP over the probe-resistant residue — where
+       the prunable (UNSAT) lanes live;
+    4. anything still open returns None for the caller's CDCL tail.
     """
     from mythril_tpu.smt import terms as T
     from mythril_tpu.smt.solver import get_blast_context
+    from mythril_tpu.support.support_args import args
 
     ctx = get_blast_context()
-    assumption_sets: List[Optional[List[int]]] = []
+    node_sets: List[Optional[List]] = []
     decided: List[Optional[bool]] = [None] * len(constraint_sets)
 
     for i, constraints in enumerate(constraint_sets):
-        lits = []
+        nodes = []
         falsy = False
         for c in constraints:
             if isinstance(c, bool):
@@ -331,16 +377,38 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
                 break
             if node is T.TRUE:
                 continue
-            lits.append(ctx.blast_lit(node))
+            nodes.append(node)
         if falsy:
             decided[i] = False
-            assumption_sets.append(None)
+            node_sets.append(None)
         else:
-            assumption_sets.append(lits)
+            node_sets.append(nodes)
+
+    # host word-level probe: evaluation against candidate models is a
+    # full verification, so a hit is a sound SAT verdict
+    probe_cache: Dict[Tuple[int, ...], bool] = {}
+    for i, nodes in enumerate(node_sets):
+        if nodes is None:
+            continue
+        key = tuple(sorted(n.id for n in nodes))
+        hit = probe_cache.get(key)
+        if hit is None:
+            hit = ctx._probe_candidates(nodes) is not None
+            probe_cache[key] = hit
+        if hit:
+            decided[i] = True
+            dispatch_stats.host_probe_sat += 1
 
     open_indices = [i for i, d in enumerate(decided) if d is None]
-    if not open_indices:
+    # below this many probe-resistant lanes the device dispatch's fixed
+    # costs exceed the CDCL tail it would save
+    if len(open_indices) < max(2, getattr(args, "device_min_lanes", 8)):
         return decided
+
+    assumption_sets: List[Optional[List[int]]] = [
+        [ctx.blast_lit(n) for n in nodes] if nodes is not None else None
+        for nodes in node_sets
+    ]
 
     # dedupe identical assumption sets: sibling states forked in the
     # same VM step often share most (sometimes all) constraints
@@ -360,12 +428,15 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     verdicts = backend.check_assumption_sets(
         ctx, [assumption_sets[i] for i in rep_indices]
     )
+    dispatch_stats.dispatches += 1
+    dispatch_stats.lanes += len(rep_indices)
 
     for pos, i in enumerate(open_indices):
         lane = lane_of[pos]
         verdict = verdicts[lane]
         if verdict is False:
             decided[i] = False
+            dispatch_stats.unsat += 1
             continue
         # candidate lane: verify the (possibly partial) assignment by
         # evaluating the original terms; unassigned leaves default 0
@@ -379,6 +450,10 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
                 ok = False
                 break
         decided[i] = True if ok else None
+        if ok:
+            dispatch_stats.sat_verified += 1
+        else:
+            dispatch_stats.undecided += 1
     return decided
 
 

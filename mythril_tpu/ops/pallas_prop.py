@@ -525,7 +525,12 @@ class PallasSatBackend:
                     A0[lane, remap[abs(lit)]] = 1.0 if lit > 0 else -1.0
             self._seed += 1
             key = jax.random.PRNGKey(self._seed)
-            step = make_dense_solve(pool.C, V, B, WALK_ROUNDS, interpret)
+            # WalkSAT only pays on small cones (it must satisfy every
+            # cone clause to produce a candidate; past ~1k vars the hit
+            # rate is ~0) — larger cones run BCP-only for sound UNSAT,
+            # the host probe having already harvested the easy SAT lanes
+            rounds = WALK_ROUNDS if V <= 1024 else 0
+            step = make_dense_solve(pool.C, V, B, rounds, interpret)
             A, st = step(
                 pool.P, pool.N, pool.Pt, pool.Nt, pool.width,
                 jnp.asarray(A0), key,

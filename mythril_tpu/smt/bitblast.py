@@ -55,6 +55,7 @@ class BlastContext:
         # walk, orders of magnitude cheaper than a CDCL search
         self.recent_models: List[T.EvalEnv] = []
         self._freevar_cache: Dict[int, frozenset] = {}
+        self._cone_cache: Dict[int, Tuple[frozenset, frozenset]] = {}
         # defining-cone index: var -> indices of the clauses that define
         # it.  By construction (Tseitin), the defined gate is the
         # youngest variable in its defining clauses, so the default
@@ -90,27 +91,52 @@ class BlastContext:
         unrelated constraint are not.  Propagation restricted to the
         cone is sound for UNSAT (every pool clause holds globally) and
         complete enough for model probing (free inputs are in the cone).
+
+        Per-root cones are memoized: a stale cached cone (late congruence
+        clauses can attach to already-walked vars) is a clause *subset* —
+        still sound for UNSAT, at worst weaker at propagation.  This
+        turns the per-dispatch cost from a full pool walk into a union of
+        cached frozensets.
         """
+        clause_set = set()
+        var_set = set()
+        for root in root_lits:
+            var = abs(root)
+            if var <= 1:
+                continue
+            cached = self._cone_cache.get(var)
+            if cached is None:
+                cached = self._cone_of_var(var)
+                self._cone_cache[var] = cached
+            clause_set |= cached[0]
+            var_set |= cached[1]
+        return sorted(clause_set), var_set
+
+    def _cone_of_var(self, root_var: int):
+        """Uncached single-root cone walk; returns (frozenset of clause
+        indices, frozenset of vars).  Reuses memoized sub-cones."""
         seen_vars = set()
         seen_clauses = set()
-        clause_indices: List[int] = []
-        stack = [abs(l) for l in root_lits if abs(l) > 1]
+        stack = [root_var]
         while stack:
             var = stack.pop()
             if var in seen_vars:
                 continue
             seen_vars.add(var)
+            hit = self._cone_cache.get(var)
+            if hit is not None:
+                seen_clauses |= hit[0]
+                seen_vars |= hit[1]
+                continue
             for ci in self.def_clauses.get(var, ()):
                 if ci in seen_clauses:
                     continue
                 seen_clauses.add(ci)
-                clause_indices.append(ci)
                 for lit in self.clauses_py[ci]:
                     w = abs(lit)
                     if w > 1 and w not in seen_vars:
                         stack.append(w)
-        clause_indices.sort()
-        return clause_indices, seen_vars
+        return frozenset(seen_clauses), frozenset(seen_vars)
 
     def new_lit(self) -> int:
         return self.solver.new_var()

@@ -19,6 +19,7 @@ class Args(object, metaclass=Singleton):
         self.solver_log = None
         # TPU-build extras
         self.batched_solving = True          # batch frontier feasibility checks
+        self.batch_width = 16                # VM states stepped per scheduler round
         self.batch_lanes = 64                # target lanes per TPU solver batch
         # below this many undecided lanes the native CDCL wins outright
         # (device dispatch + sweep latency exceeds the whole CPU solve);

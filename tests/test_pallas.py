@@ -80,10 +80,14 @@ def test_unsat_lanes_conflict_in_kernel():
             assert T.evaluate(c.raw, env) is True, f"lane {i} model bad"
 
 
-def test_batch_check_states_uses_pallas():
+def test_batch_check_states_uses_pallas(monkeypatch):
     from mythril_tpu.laser.ethereum.state.constraints import Constraints
     from mythril_tpu.ops.batched_sat import batch_check_states
+    from mythril_tpu.support.support_args import args
 
+    # the host word-level probe decides the SAT lanes before dispatch;
+    # drop the residue gate so the 3 UNSAT lanes still reach the kernel
+    monkeypatch.setattr(args, "device_min_lanes", 2)
     lanes = _lane_constraints(6)
     verdicts = batch_check_states([Constraints(lane) for lane in lanes])
     for i, v in enumerate(verdicts):
