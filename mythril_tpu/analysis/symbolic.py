@@ -1,9 +1,17 @@
-"""SymExecWrapper: the single assembly point of VM + strategy + plugins +
-detection hooks (reference: mythril/analysis/symbolic.py)."""
+"""SymExecWrapper: assembles the analysis pipeline around the batched
+VM — scheduler policy, actor world state, pruning plugins, detection
+hooks — then runs it and harvests the statespace.
 
-import copy
+Capability parity target: reference mythril/analysis/symbolic.py
+(same constructor surface and post-pass Call extraction for
+POST-entry-point modules).  The assembly itself is decomposed into
+policy tables + builder steps rather than one monolithic constructor
+body, so alternative schedulers/plugins slot in without touching the
+pipeline order.
+"""
+
 import logging
-from typing import List, Optional, Type, Union
+from typing import List, Optional, Union
 
 from mythril_tpu.analysis.module import (
     EntryPoint,
@@ -16,7 +24,6 @@ from mythril_tpu.laser.ethereum.natives import PRECOMPILE_COUNT
 from mythril_tpu.laser.ethereum.state.account import Account
 from mythril_tpu.laser.ethereum.state.world_state import WorldState
 from mythril_tpu.laser.ethereum.strategy.basic import (
-    BasicSearchStrategy,
     BreadthFirstSearchStrategy,
     DepthFirstSearchStrategy,
     ReturnRandomNaivelyStrategy,
@@ -39,6 +46,24 @@ from mythril_tpu.support.support_args import args
 
 log = logging.getLogger(__name__)
 
+# scheduler policies: how the batched worklist draws its wavefront
+STRATEGIES = {
+    "dfs": DepthFirstSearchStrategy,
+    "bfs": BreadthFirstSearchStrategy,
+    "naive-random": ReturnRandomNaivelyStrategy,
+    "weighted-random": ReturnWeightedRandomStrategy,
+}
+
+_CALL_OPS = ("CALL", "CALLCODE", "DELEGATECALL", "STATICCALL")
+
+
+def _as_address(value: Union[int, str, BitVec]) -> BitVec:
+    if isinstance(value, str):
+        value = int(value, 16)
+    if isinstance(value, int):
+        value = symbol_factory.BitVecVal(value, 256)
+    return value
+
 
 class SymExecWrapper:
     def __init__(
@@ -59,188 +84,150 @@ class SymExecWrapper:
         enable_coverage_strategy: bool = False,
         custom_modules_directory: str = "",
     ):
-        if isinstance(address, str):
-            address = symbol_factory.BitVecVal(int(address, 16), 256)
-        if isinstance(address, int):
-            address = symbol_factory.BitVecVal(address, 256)
-
-        strategies = {
-            "dfs": DepthFirstSearchStrategy,
-            "bfs": BreadthFirstSearchStrategy,
-            "naive-random": ReturnRandomNaivelyStrategy,
-            "weighted-random": ReturnWeightedRandomStrategy,
-        }
-        try:
-            s_strategy: Type[BasicSearchStrategy] = strategies[strategy]
-        except KeyError:
+        address = _as_address(address)
+        if strategy not in STRATEGIES:
             raise ValueError("Invalid strategy argument supplied")
 
-        creator_account = Account(
-            hex(ACTORS.creator.value), "", dynamic_loader=None, contract_name=None
-        )
-        attacker_account = Account(
-            hex(ACTORS.attacker.value), "", dynamic_loader=None, contract_name=None
+        is_creation = bool(getattr(contract, "creation_code", None))
+        requires_statespace = compulsory_statespace or bool(
+            ModuleLoader().get_detection_modules(EntryPoint.POST, modules)
         )
 
-        requires_statespace = (
-            compulsory_statespace
-            or len(ModuleLoader().get_detection_modules(EntryPoint.POST, modules))
-            > 0
-        )
-        if not getattr(contract, "creation_code", None):
-            self.accounts = {hex(ACTORS.attacker.value): attacker_account}
-        else:
-            self.accounts = {
-                hex(ACTORS.creator.value): creator_account,
-                hex(ACTORS.attacker.value): attacker_account,
-            }
-
+        self.accounts = self._actor_accounts(include_creator=is_creation)
         self.laser = svm.LaserEVM(
             dynamic_loader=dynloader,
             max_depth=max_depth,
             execution_timeout=execution_timeout,
-            strategy=s_strategy,
+            strategy=STRATEGIES[strategy],
             create_timeout=create_timeout,
             transaction_count=transaction_count,
             requires_statespace=requires_statespace,
         )
-
         if loop_bound is not None:
             self.laser.extend_strategy(BoundedLoopsStrategy, loop_bound)
-
-        plugin_loader = LaserPluginLoader()
-        plugin_loader.load(CoveragePluginBuilder())
-        plugin_loader.load(MutationPrunerBuilder())
-        plugin_loader.load(CallDepthLimitBuilder())
-        if args.iprof:
-            plugin_loader.load(InstructionProfilerBuilder())
-        plugin_loader.add_args(
-            "call-depth-limit", call_depth_limit=args.call_depth_limit
-        )
-        if not disable_dependency_pruning:
-            plugin_loader.load(DependencyPrunerBuilder())
-        plugin_loader.instrument_virtual_machine(self.laser, None)
+        self._instrument(disable_dependency_pruning)
+        if run_analysis_modules:
+            self._attach_detection_hooks(modules)
 
         world_state = WorldState()
         for account in self.accounts.values():
             world_state.put_account(account)
 
-        if run_analysis_modules:
-            analysis_modules = ModuleLoader().get_detection_modules(
-                EntryPoint.CALLBACK, modules
-            )
-            self.laser.register_hooks(
-                hook_type="pre",
-                hook_dict=get_detection_module_hooks(
-                    analysis_modules, hook_type="pre"
-                ),
-            )
-            self.laser.register_hooks(
-                hook_type="post",
-                hook_dict=get_detection_module_hooks(
-                    analysis_modules, hook_type="post"
-                ),
-            )
-
-        if getattr(contract, "creation_code", None):
+        if is_creation:
             self.laser.sym_exec(
                 creation_code=contract.creation_code,
                 contract_name=contract.name,
                 world_state=world_state,
             )
         else:
-            account = Account(
-                address,
-                contract.disassembly,
-                dynamic_loader=dynloader,
-                contract_name=contract.name,
-                balances=world_state.balances,
-                concrete_storage=bool(dynloader is not None and dynloader.active),
+            world_state.put_account(
+                self._target_account(contract, address, dynloader, world_state)
             )
-            if dynloader is not None:
-                try:
-                    _balance = dynloader.read_balance(
-                        "{0:#0{1}x}".format(address.value, 42)
-                    )
-                    account.set_balance(_balance)
-                except Exception:
-                    pass  # balance stays symbolic
-            world_state.put_account(account)
             self.laser.sym_exec(
                 world_state=world_state, target_address=address.value
             )
 
-        if not requires_statespace:
-            return
+        if requires_statespace:
+            self.nodes = self.laser.nodes
+            self.edges = self.laser.edges
+            self.calls = self._harvest_calls()
 
-        self.nodes = self.laser.nodes
-        self.edges = self.laser.edges
+    # -- assembly steps -------------------------------------------------
 
-        # POST-module convenience: extract Call ops from the statespace
-        self.calls: List[Call] = []
-        for key in self.nodes:
-            state_index = 0
-            for state in self.nodes[key].states:
-                instruction = state.get_current_instruction()
-                op = instruction["opcode"]
-                if op in ("CALL", "CALLCODE", "DELEGATECALL", "STATICCALL"):
-                    stack = state.mstate.stack
-                    if op in ("CALL", "CALLCODE"):
-                        gas, to, value, meminstart, meminsz = (
-                            get_variable(stack[-1]),
-                            get_variable(stack[-2]),
-                            get_variable(stack[-3]),
-                            get_variable(stack[-4]),
-                            get_variable(stack[-5]),
-                        )
-                        if (
-                            to.type == VarType.CONCRETE
-                            and 0 < to.val <= PRECOMPILE_COUNT
-                        ):
-                            state_index += 1
-                            continue
-                        if (
-                            meminstart.type == VarType.CONCRETE
-                            and meminsz.type == VarType.CONCRETE
-                        ):
-                            self.calls.append(
-                                Call(
-                                    self.nodes[key],
-                                    state,
-                                    state_index,
-                                    op,
-                                    to,
-                                    gas,
-                                    value,
-                                    state.mstate.memory[
-                                        meminstart.val : meminsz.val
-                                        + meminstart.val
-                                    ],
-                                )
-                            )
-                        else:
-                            self.calls.append(
-                                Call(
-                                    self.nodes[key],
-                                    state,
-                                    state_index,
-                                    op,
-                                    to,
-                                    gas,
-                                    value,
-                                )
-                            )
-                    else:
-                        gas, to = (
-                            get_variable(stack[-1]),
-                            get_variable(stack[-2]),
-                        )
-                        self.calls.append(
-                            Call(
-                                self.nodes[key], state, state_index, op, to, gas
-                            )
-                        )
-                state_index += 1
+    @staticmethod
+    def _actor_accounts(include_creator: bool):
+        accounts = {}
+        actors = [ACTORS.attacker] + ([ACTORS.creator] if include_creator else [])
+        for actor in actors:
+            accounts[hex(actor.value)] = Account(
+                hex(actor.value), "", dynamic_loader=None, contract_name=None
+            )
+        return accounts
+
+    def _instrument(self, disable_dependency_pruning: bool) -> None:
+        loader = LaserPluginLoader()
+        loader.load(CoveragePluginBuilder())
+        loader.load(MutationPrunerBuilder())
+        loader.load(CallDepthLimitBuilder())
+        if args.iprof:
+            loader.load(InstructionProfilerBuilder())
+        loader.add_args("call-depth-limit", call_depth_limit=args.call_depth_limit)
+        if not disable_dependency_pruning:
+            loader.load(DependencyPrunerBuilder())
+        loader.instrument_virtual_machine(self.laser, None)
+
+    def _attach_detection_hooks(self, modules: Optional[List[str]]) -> None:
+        callback_modules = ModuleLoader().get_detection_modules(
+            EntryPoint.CALLBACK, modules
+        )
+        for phase in ("pre", "post"):
+            self.laser.register_hooks(
+                hook_type=phase,
+                hook_dict=get_detection_module_hooks(
+                    callback_modules, hook_type=phase
+                ),
+            )
+
+    @staticmethod
+    def _target_account(contract, address, dynloader, world_state) -> Account:
+        account = Account(
+            address,
+            contract.disassembly,
+            dynamic_loader=dynloader,
+            contract_name=contract.name,
+            balances=world_state.balances,
+            concrete_storage=bool(dynloader is not None and dynloader.active),
+        )
+        if dynloader is not None:
+            try:
+                account.set_balance(
+                    dynloader.read_balance("{0:#0{1}x}".format(address.value, 42))
+                )
+            except Exception:  # noqa: BLE001 — balance stays symbolic
+                pass
+        return account
+
+    # -- statespace post-pass -------------------------------------------
+
+    def _harvest_calls(self) -> List[Call]:
+        """Extract inter-contract call sites recorded in the statespace
+        (the input POST-entry-point modules iterate over)."""
+        calls: List[Call] = []
+        for node in self.nodes.values():
+            for index, state in enumerate(node.states):
+                op = state.get_current_instruction()["opcode"]
+                if op not in _CALL_OPS:
+                    continue
+                stack = state.mstate.stack
+                gas = get_variable(stack[-1])
+                to = get_variable(stack[-2])
+                if op in ("DELEGATECALL", "STATICCALL"):
+                    calls.append(Call(node, state, index, op, to, gas))
+                    continue
+                # CALL/CALLCODE carry value + memory input window
+                if (
+                    to.type == VarType.CONCRETE
+                    and 0 < to.val <= PRECOMPILE_COUNT
+                ):
+                    continue  # precompile invocations are not call sites
+                value = get_variable(stack[-3])
+                mem_start = get_variable(stack[-4])
+                mem_size = get_variable(stack[-5])
+                data = None
+                if (
+                    mem_start.type == VarType.CONCRETE
+                    and mem_size.type == VarType.CONCRETE
+                ):
+                    data = state.mstate.memory[
+                        mem_start.val : mem_start.val + mem_size.val
+                    ]
+                if data is not None:
+                    calls.append(
+                        Call(node, state, index, op, to, gas, value, data)
+                    )
+                else:
+                    calls.append(Call(node, state, index, op, to, gas, value))
+        return calls
 
     @property
     def execution_info(self):

@@ -1,9 +1,8 @@
 """The myth command-line interface (reference: mythril/interfaces/cli.py).
 
-Commands: analyze (a), disassemble (d), list-detectors, read-storage,
-function-to-hash, hash-to-address, version, help — plus stubs for the
-reference's leveldb-search/truffle/pro commands (their backends are not
-available in this environment and report so cleanly).
+Commands: analyze (a), disassemble (d), pro (p, MythX cloud submission),
+list-detectors, read-storage, leveldb-search, function-to-hash,
+hash-to-address, truffle, version, help.
 """
 
 import argparse
@@ -438,9 +437,13 @@ def main() -> None:
         formatter_class=argparse.ArgumentDefaultsHelpFormatter,
     )
     create_analyzer_parser(pro_parser)
-    subparsers.add_parser(
-        "truffle", help="(unavailable) analyze a truffle project"
+    truffle_parser = subparsers.add_parser(
+        "truffle",
+        help="Analyze a truffle project (run from the project directory)",
+        parents=[utilities_parser, output_parser],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
     )
+    create_analyzer_parser(truffle_parser)
     leveldb_search_parser = subparsers.add_parser(
         "leveldb-search", help="Searches the code fragment in local leveldb"
     )
@@ -482,6 +485,82 @@ def load_code(disassembler: MythrilDisassembler, args: argparse.Namespace):
             "-a ADDRESS, -f BYTECODE_FILE or <SOLIDITY_FILE>",
         )
     return address
+
+
+def execute_truffle(args: argparse.Namespace) -> None:
+    """Analyze every compiled artifact of a truffle project: run from
+    the project root after ``truffle compile``; each
+    ``build/contracts/*.json`` artifact's deployed bytecode is analyzed
+    like ``analyze --bin-runtime``.  (The reference registers this
+    command but ships no handler for it — cli.py:268 registers the
+    subparser, execute_command has no truffle branch.)"""
+    outform = getattr(args, "outform", "text")
+    build_dir = os.path.join(os.getcwd(), "build", "contracts")
+    if not os.path.isdir(build_dir):
+        exit_with_error(
+            outform,
+            "No build/contracts directory here. Run `truffle compile` in "
+            "the project first, then `myth truffle` from the project root.",
+        )
+
+    disassembler = MythrilDisassembler(eth=None)
+    address = None
+    for filename in sorted(os.listdir(build_dir)):
+        if not filename.endswith(".json"):
+            continue
+        with open(os.path.join(build_dir, filename)) as fh:
+            try:
+                artifact = json.load(fh)
+            except json.JSONDecodeError:
+                continue
+        runtime = (artifact.get("deployedBytecode") or "").strip()
+        if runtime in ("", "0x"):
+            continue  # interfaces / abstract contracts have no code
+        loaded_address, _ = disassembler.load_from_bytecode(
+            runtime, bin_runtime=True
+        )
+        address = address or loaded_address
+        disassembler.contracts[-1].name = artifact.get(
+            "contractName", filename[:-5]
+        )
+
+    if not disassembler.contracts:
+        exit_with_error(
+            outform, "No deployable contracts found in build/contracts."
+        )
+
+    analyzer = MythrilAnalyzer(
+        strategy=args.strategy,
+        disassembler=disassembler,
+        address=address,
+        max_depth=args.max_depth,
+        execution_timeout=args.execution_timeout,
+        loop_bound=args.loop_bound,
+        create_timeout=args.create_timeout,
+        enable_iprof=args.enable_iprof,
+        disable_dependency_pruning=args.disable_dependency_pruning,
+        use_onchain_data=False,
+        solver_timeout=args.solver_timeout,
+        parallel_solving=args.parallel_solving,
+        custom_modules_directory=args.custom_modules_directory or "",
+        sparse_pruning=args.sparse_pruning,
+        unconstrained_storage=args.unconstrained_storage,
+        call_depth_limit=args.call_depth_limit,
+        enable_coverage_strategy=args.enable_coverage_strategy,
+    )
+    report = analyzer.fire_lasers(
+        modules=[m.strip() for m in args.modules.strip().split(",")]
+        if args.modules
+        else None,
+        transaction_count=args.transaction_count,
+    )
+    outputs = {
+        "json": report.as_json(),
+        "jsonv2": report.as_swc_standard_format(),
+        "text": report.as_text(),
+        "markdown": report.as_markdown(),
+    }
+    print(outputs[outform])
 
 
 def execute_command(
@@ -591,10 +670,21 @@ def execute_command(
 
 def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Namespace) -> None:
     if args.epic:
+        import shlex
+        import subprocess
+
         path = os.path.dirname(os.path.realpath(__file__))
         sys.argv.remove("--epic")
-        os.system(" ".join(sys.argv) + " | python3 " + path + "/epic.py")
-        sys.exit()
+        # re-run ourselves piped through the rainbow pager; arguments are
+        # quoted so paths with spaces/metacharacters survive the shell
+        command = (
+            " ".join(shlex.quote(arg) for arg in sys.argv)
+            + " | "
+            + shlex.quote(sys.executable or "python3")
+            + " "
+            + shlex.quote(os.path.join(path, "epic.py"))
+        )
+        sys.exit(subprocess.call(command, shell=True))
 
     if args.command not in COMMAND_LIST or args.command is None:
         parser.print_help()
@@ -675,11 +765,8 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
             )
 
     if args.command == "truffle":
-        exit_with_error(
-            getattr(args, "outform", "text"),
-            "The 'truffle' command is not available in this build "
-            "(its external backend does not exist in this environment).",
-        )
+        execute_truffle(args)
+        sys.exit()
 
     # load mythril-level plugins (entry-point discovery)
     MythrilPluginLoader()

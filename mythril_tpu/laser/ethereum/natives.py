@@ -149,10 +149,49 @@ def ec_mul(data: List[int]) -> List[int]:
 
 
 def ec_pair(data: List[int]) -> List[int]:
-    # Full optimal-ate pairing over Fp12 is not implemented yet; treat the
-    # result as unknown so callers produce symbolic returndata.
-    # TODO(round>=2): implement BN254 pairing for full precompile parity.
-    raise NativeContractException
+    """EIP-197 pairing check (reference natives.py:164-196 behavioral
+    contract: 192-byte groups, G2 words imaginary-part first, [] on any
+    invalid point/subgroup failure, output 0/1 in 32 bytes)."""
+    from mythril_tpu.support.crypto import (
+        BN128_N,
+        BN128_P,
+        Fp2,
+        _g2_mul,
+        _g2_on_curve,
+        bn128_pairing_check,
+    )
+
+    if len(data) % 192:
+        return []
+    payload = _to_bytes(data)
+    pairs = []
+    for i in range(0, len(payload), 192):
+        words = [
+            int.from_bytes(payload[i + 32 * j : i + 32 * (j + 1)], "big")
+            for j in range(6)
+        ]
+        x1, y1, x2_i, x2_r, y2_i, y2_r = words
+        if any(v >= BN128_P for v in words):
+            return []
+        if (x1, y1) == (0, 0):
+            g1_point = None
+        else:
+            if (y1 * y1 - x1 * x1 * x1 - 3) % BN128_P:
+                return []
+            g1_point = (x1, y1)
+        g2_x = Fp2(x2_r, x2_i)
+        g2_y = Fp2(y2_r, y2_i)
+        if g2_x.is_zero() and g2_y.is_zero():
+            g2_point = None
+        else:
+            if not _g2_on_curve(g2_x, g2_y):
+                return []
+            g2_point = (g2_x, g2_y)
+            if _g2_mul(g2_point, BN128_N) is not None:
+                return []
+        pairs.append((g1_point, g2_point))
+    result = bn128_pairing_check(pairs)
+    return [0] * 31 + [1 if result else 0]
 
 
 def blake2b_fcompress(data: List[int]) -> List[int]:

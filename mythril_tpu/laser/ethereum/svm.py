@@ -228,25 +228,26 @@ class LaserEVM:
 
             # (executed state, op_code, successor states) per lane
             rounds: List[Tuple[GlobalState, Optional[str], List[GlobalState]]] = []
+            timed_out = None
             for lane, global_state in enumerate(batch):
-                if (
+                deadline = (
                     self.create_timeout
-                    and create
-                    and self.time + timedelta(seconds=self.create_timeout)
-                    <= datetime.now()
-                ):
-                    log.debug("Hit create timeout, returning.")
-                    self.work_list += batch[lane + 1 :]  # unexecuted lanes
-                    return final_states + [global_state] if track_gas else None
+                    if create
+                    else self.execution_timeout
+                )
                 if (
-                    self.execution_timeout
-                    and not create
-                    and self.time + timedelta(seconds=self.execution_timeout)
+                    deadline
+                    and self.time + timedelta(seconds=deadline)
                     <= datetime.now()
                 ):
-                    log.debug("Hit execution timeout, returning.")
+                    log.debug("Hit %s timeout, returning.",
+                              "create" if create else "execution")
+                    # already-executed lanes still get their successors
+                    # pruned and recorded below; unexecuted lanes return
+                    # to the work list
                     self.work_list += batch[lane + 1 :]
-                    return final_states + [global_state] if track_gas else None
+                    timed_out = global_state
+                    break
 
                 try:
                     new_states, op_code = self.execute_state(global_state)
@@ -269,6 +270,9 @@ class LaserEVM:
                 elif track_gas:
                     final_states.append(global_state)
                 self.total_states += len(surviving)
+
+            if timed_out is not None:
+                return final_states + [timed_out] if track_gas else None
         return final_states if track_gas else None
 
     def execute_state(

@@ -1,18 +1,27 @@
-"""Transaction models and control signals (reference:
-laser/ethereum/transaction/transaction_models.py)."""
+"""Transaction models and VM control signals.
 
+Capability parity target: reference
+laser/ethereum/transaction/transaction_models.py (tx objects carrying
+caller/calldata/value, signals for frame start/end, creation-code
+assignment on RETURN).  The design here is spec-table driven rather
+than a field-by-field port: every per-transaction symbol a transaction
+may need is declared once in ``_SYMBOLIC_FIELDS`` and materialized
+lazily per transaction id, which keeps symbol naming uniform with the
+batched solver's term interning (one ``{name}{txid}`` variable per
+lane, shared across forked states).
+"""
+
+import itertools
 import logging
 from copy import deepcopy
-from typing import Any, Optional, Union
+from typing import Optional
 
-from mythril_tpu.disassembler.disassembly import Disassembly
 from mythril_tpu.laser.ethereum.state.account import Account
 from mythril_tpu.laser.ethereum.state.calldata import (
     BaseCalldata,
     ConcreteCalldata,
     SymbolicCalldata,
 )
-from mythril_tpu.laser.ethereum.state.constraints import Constraints
 from mythril_tpu.laser.ethereum.state.environment import Environment
 from mythril_tpu.laser.ethereum.state.global_state import GlobalState
 from mythril_tpu.laser.ethereum.state.world_state import WorldState
@@ -20,22 +29,29 @@ from mythril_tpu.smt import UGE, BitVec, symbol_factory
 
 log = logging.getLogger(__name__)
 
-_next_transaction_id = 0
+_tx_counter = itertools.count(1)
 
 
 def get_next_transaction_id() -> str:
-    global _next_transaction_id
-    _next_transaction_id += 1
-    return str(_next_transaction_id)
+    return str(next(_tx_counter))
 
 
 def reset_transaction_ids() -> None:
-    global _next_transaction_id
-    _next_transaction_id = 0
+    global _tx_counter
+    _tx_counter = itertools.count(1)
+
+
+# per-transaction symbols, created only when the caller didn't pin one
+_SYMBOLIC_FIELDS = {
+    "gas_price": "gasprice",
+    "origin": "origin",
+    "call_value": "call_value",
+}
 
 
 class TransactionStartSignal(Exception):
-    """Raised when a CALL/CREATE opcode starts a nested transaction."""
+    """A CALL/CREATE family opcode opened a nested frame; the VM driver
+    (svm.execute_state) pushes the callee onto the transaction stack."""
 
     def __init__(self, transaction, op_code: str, global_state: GlobalState):
         self.transaction = transaction
@@ -44,7 +60,8 @@ class TransactionStartSignal(Exception):
 
 
 class TransactionEndSignal(Exception):
-    """Raised when a transaction ends (STOP/RETURN/REVERT/exception)."""
+    """The active frame halted (STOP/RETURN/REVERT/fault); ``revert``
+    tells the driver whether world-state effects roll back."""
 
     def __init__(self, global_state: GlobalState, revert: bool = False):
         self.global_state = global_state
@@ -52,6 +69,13 @@ class TransactionEndSignal(Exception):
 
 
 class BaseTransaction:
+    """Shared shape of message calls and creations.
+
+    Fields left as ``None`` default to fresh per-tx symbols (see
+    ``_SYMBOLIC_FIELDS``); calldata defaults to fully symbolic unless
+    ``init_call_data`` is disabled (CREATE-family frames pass the
+    in-memory bytes instead)."""
+
     def __init__(
         self,
         world_state: WorldState,
@@ -71,74 +95,70 @@ class BaseTransaction:
         assert isinstance(world_state, WorldState)
         self.world_state = world_state
         self.id = identifier or get_next_transaction_id()
-
-        self.gas_price = (
-            gas_price
-            if gas_price is not None
-            else symbol_factory.BitVecSym(f"gasprice{self.id}", 256)
-        )
-        self.gas_limit = gas_limit
-        self.origin = (
-            origin
-            if origin is not None
-            else symbol_factory.BitVecSym(f"origin{self.id}", 256)
-        )
-        self.code = code
         self.caller = caller
         self.callee_account = callee_account
-        if call_data is None and init_call_data:
-            self.call_data: BaseCalldata = SymbolicCalldata(self.id)
-        else:
-            self.call_data = (
-                call_data
-                if isinstance(call_data, BaseCalldata)
-                else ConcreteCalldata(self.id, [])
-            )
-        self.call_value = (
-            call_value
-            if call_value is not None
-            else symbol_factory.BitVecSym(f"call_value{self.id}", 256)
-        )
+        self.gas_limit = gas_limit
+        self.code = code
         self.static = static
         self.return_data: Optional[str] = None
 
-    def initial_global_state_from_environment(
-        self, environment: Environment, active_function: str
-    ) -> GlobalState:
-        global_state = GlobalState(
-            self.world_state, environment, None, transaction_stack=[]
-        )
-        global_state.environment.active_function_name = active_function
+        pinned = {
+            "gas_price": gas_price,
+            "origin": origin,
+            "call_value": call_value,
+        }
+        for field, stem in _SYMBOLIC_FIELDS.items():
+            value = pinned[field]
+            if value is None:
+                value = symbol_factory.BitVecSym(f"{stem}{self.id}", 256)
+            setattr(self, field, value)
 
-        sender = environment.sender
-        receiver = environment.active_account.address
-        value = (
-            environment.callvalue
-            if isinstance(environment.callvalue, BitVec)
-            else symbol_factory.BitVecVal(environment.callvalue, 256)
-        )
-        global_state.world_state.constraints.append(
-            UGE(global_state.world_state.balances[sender], value)
-        )
-        global_state.world_state.balances[receiver] += value
-        global_state.world_state.balances[sender] -= value
-        return global_state
+        if isinstance(call_data, BaseCalldata):
+            self.call_data: BaseCalldata = call_data
+        elif init_call_data and call_data is None:
+            self.call_data = SymbolicCalldata(self.id)
+        else:
+            self.call_data = ConcreteCalldata(self.id, [])
+
+    # -- frame setup ----------------------------------------------------
+
+    def _frame_environment(self) -> Environment:
+        raise NotImplementedError
+
+    def _entry_function(self) -> str:
+        raise NotImplementedError
 
     def initial_global_state(self) -> GlobalState:
-        raise NotImplementedError
+        """Build the frame's entry state and settle the value transfer
+        against the shared balances array (UGE guard on the sender, the
+        same shape the batched prune sees for every lane)."""
+        env = self._frame_environment()
+        state = GlobalState(self.world_state, env, None, transaction_stack=[])
+        state.environment.active_function_name = self._entry_function()
+
+        value = env.callvalue
+        if not isinstance(value, BitVec):
+            value = symbol_factory.BitVecVal(value, 256)
+        balances = state.world_state.balances
+        state.world_state.constraints.append(
+            UGE(balances[env.sender], value)
+        )
+        balances[env.active_account.address] += value
+        balances[env.sender] -= value
+        return state
 
     def __str__(self) -> str:
         return (
-            f"{self.__class__.__name__} {self.id} from "
-            f"{self.caller} to {self.callee_account}"
+            f"{type(self).__name__}(id={self.id}, caller={self.caller}, "
+            f"callee={self.callee_account})"
         )
 
 
 class MessageCallTransaction(BaseTransaction):
-    """A message call to an existing account."""
+    """A call into an existing account's runtime code."""
 
-    def initial_global_state(self) -> GlobalState:
-        environment = Environment(
+    def _frame_environment(self) -> Environment:
+        return Environment(
             self.callee_account,
             self.caller,
             self.call_data,
@@ -148,9 +168,9 @@ class MessageCallTransaction(BaseTransaction):
             code=self.code or self.callee_account.code,
             static=self.static,
         )
-        return super().initial_global_state_from_environment(
-            environment, active_function="fallback"
-        )
+
+    def _entry_function(self) -> str:
+        return "fallback"
 
     def end(self, global_state: GlobalState, return_data=None, revert=False) -> None:
         self.return_data = return_data
@@ -158,8 +178,8 @@ class MessageCallTransaction(BaseTransaction):
 
 
 class ContractCreationTransaction(BaseTransaction):
-    """Deploys a new contract: code is the creation bytecode; a RETURN
-    assigns the runtime bytecode to the new account."""
+    """Runs creation bytecode; RETURN's payload becomes the runtime
+    code of the account created in the (snapshotted) world state."""
 
     def __init__(
         self,
@@ -175,21 +195,25 @@ class ContractCreationTransaction(BaseTransaction):
         contract_name=None,
         contract_address=None,
     ):
+        # snapshot for post-pass inspection (what the world looked like
+        # before this deployment)
         self.prev_world_state = deepcopy(world_state)
-        contract_address = (
-            contract_address if isinstance(contract_address, int) else None
+        new_account = world_state.create_account(
+            0,
+            concrete_storage=True,
+            creator=caller.value,
+            address=contract_address
+            if isinstance(contract_address, int)
+            else None,
         )
-        callee_account = world_state.create_account(
-            0, concrete_storage=True, creator=caller.value, address=contract_address
-        )
-        callee_account.contract_name = contract_name or callee_account.contract_name
-        # Constructor arguments are modeled as symbolic calldata; the
-        # codecopy/codesize/calldatasize mutators splice them onto the
-        # end of the init code (same trick as the reference,
-        # transaction_models.py:208).
+        if contract_name:
+            new_account.contract_name = contract_name
+        # Constructor arguments ride as symbolic calldata spliced past
+        # the end of the init code by codecopy/codesize/calldatasize
+        # (same modeling as the reference, transaction_models.py:208).
         super().__init__(
             world_state=world_state,
-            callee_account=callee_account,
+            callee_account=new_account,
             caller=caller,
             call_data=call_data,
             identifier=identifier,
@@ -201,8 +225,8 @@ class ContractCreationTransaction(BaseTransaction):
             init_call_data=True,
         )
 
-    def initial_global_state(self) -> GlobalState:
-        environment = Environment(
+    def _frame_environment(self) -> Environment:
+        return Environment(
             self.callee_account,
             self.caller,
             self.call_data,
@@ -211,21 +235,23 @@ class ContractCreationTransaction(BaseTransaction):
             self.origin,
             self.code,
         )
-        return super().initial_global_state_from_environment(
-            environment, active_function="constructor"
-        )
+
+    def _entry_function(self) -> str:
+        return "constructor"
 
     def end(self, global_state: GlobalState, return_data=None, revert=False):
-        if (
-            not all([isinstance(element, int) for element in return_data or []])
-            or len(return_data or []) == 0
-        ):
+        deployed = None
+        if return_data:
+            try:
+                deployed = bytes(return_data)
+            except (TypeError, ValueError):
+                deployed = None
+        if deployed is None:
             self.return_data = None
             raise TransactionEndSignal(global_state, revert)
-        contract_code = bytes.fromhex("".join(f"{b:02x}" for b in return_data))
-        global_state.environment.active_account.code.assign_bytecode(contract_code)
-        self.return_data = str(
-            global_state.environment.active_account.address
-        )
-        assert global_state.environment.active_account.code.instruction_list != []
+
+        account = global_state.environment.active_account
+        account.code.assign_bytecode(deployed)
+        assert account.code.instruction_list != []
+        self.return_data = str(account.address)
         raise TransactionEndSignal(global_state, revert)

@@ -148,6 +148,20 @@ class MythrilAnalyzer:
                 exceptions.append(traceback.format_exc())
             for issue in issues:
                 issue.add_code_info(contract)
+            if issues and getattr(args, "concrete_replay", True):
+                # independent on-device confirmation of exploit sequences
+                # (lockstep batched VM); annotation only — report formats
+                # and findings are unaffected
+                try:
+                    from mythril_tpu.analysis.concrete_replay import (
+                        replay_issues,
+                    )
+
+                    replay_issues(issues, contract.code)
+                except Exception:  # noqa: BLE001 — validation is best-effort
+                    log.debug(
+                        "concrete replay skipped:\n" + traceback.format_exc()
+                    )
             all_issues += issues
             log.info("Solver statistics: \n%s", SolverStatistics())
 

@@ -67,6 +67,12 @@ def load() -> ctypes.CDLL:
         lib.cdcl_conflicts.restype = ctypes.c_int64
         lib.cdcl_num_clauses.argtypes = [ctypes.c_void_p]
         lib.cdcl_num_clauses.restype = ctypes.c_int64
+        lib.cdcl_learnt_clauses.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.cdcl_learnt_clauses.restype = ctypes.c_int64
         lib.keccak256_native.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
         ]
@@ -131,6 +137,29 @@ class SatSolver:
 
     def model(self, variables: Sequence[int]) -> List[bool]:
         return [self.model_value(v) for v in variables]
+
+    def learnt_clauses(
+        self, max_width: int = 8, from_index: int = 0, cap: int = 1 << 18
+    ):
+        """(clauses, next_index): short learned clauses added since
+        ``from_index`` — the device pool absorbs these so CDCL-derived
+        pruning power transfers to the batched BCP kernels."""
+        out = (ctypes.c_int32 * cap)()
+        next_index = ctypes.c_int64(from_index)
+        written = self._lib.cdcl_learnt_clauses(
+            self._handle, max_width, from_index, out,
+            cap, ctypes.byref(next_index),
+        )
+        clauses = []
+        clause: List[int] = []
+        for i in range(written):
+            lit = out[i]
+            if lit == 0:
+                clauses.append(tuple(clause))
+                clause = []
+            else:
+                clause.append(lit)
+        return clauses, int(next_index.value)
 
     @property
     def conflicts(self) -> int:

@@ -163,6 +163,28 @@ class Solver {
   int core_size() const { return (int)conflict_core_.size(); }
   const Lit* core() const { return conflict_core_.data(); }
 
+  // Export live learned clauses of width <= max_width, flattened with a
+  // 0 terminator per clause, starting at clause index `from` (so callers
+  // pull only clauses learned since their last sync).  Returns the
+  // number of int32 slots written; *next is the clause index to resume
+  // from on the next call.
+  int64_t collect_learnts(int32_t max_width, int64_t from, Lit* out,
+                          int64_t cap, int64_t* next) const {
+    int64_t written = 0;
+    int64_t idx = from < 0 ? 0 : from;
+    for (; idx < (int64_t)clauses_.size(); ++idx) {
+      const Clause& c = clauses_[idx];
+      if (!c.learned || c.deleted) continue;
+      int32_t n = (int32_t)c.lits.size();
+      if (n == 0 || n > max_width) continue;
+      if (written + n + 1 > cap) break;
+      for (Lit l : c.lits) out[written++] = l;
+      out[written++] = 0;
+    }
+    if (next) *next = idx;
+    return written;
+  }
+
  private:
   // ---- state ----
   bool ok_ = true;
@@ -571,6 +593,10 @@ int32_t cdcl_model_value(void* s, int32_t var) {
 }
 int64_t cdcl_conflicts(void* s) { return ((Solver*)s)->conflicts(); }
 int64_t cdcl_num_clauses(void* s) { return ((Solver*)s)->num_clauses(); }
+int64_t cdcl_learnt_clauses(void* s, int32_t max_width, int64_t from,
+                            int32_t* out, int64_t cap, int64_t* next) {
+  return ((Solver*)s)->collect_learnts(max_width, from, out, cap, next);
+}
 
 // ---------------------------------------------------------------------------
 // keccak-256 (Ethereum variant: original Keccak padding 0x01)

@@ -108,7 +108,9 @@ class DevicePool:
         # pad clause count to the bucket with inert all-zero rows
         target_c = self._bucket(len(rows))
         rows.extend([[0] * MAX_CLAUSE_WIDTH] * (target_c - len(rows)))
-        self.lits = jnp.asarray(np.asarray(rows, dtype=np.int32))
+        self.lits_np = np.asarray(rows, dtype=np.int32)  # host mirror
+        # (the mesh path shards from here without a device round-trip)
+        self.lits = jnp.asarray(self.lits_np)
         self.num_vars = self._bucket(num_vars)
         self.num_clauses = target_c
         self.dropped = dropped
@@ -243,6 +245,10 @@ class BatchedSatBackend:
         self.pool = DevicePool()
         self._step_cache: Dict[int, object] = {}
         self._seed = 0
+        # True iff the last check_assumption_sets actually ran a device
+        # (or interpret-mode kernel) pass — telemetry keys off this so
+        # bail-outs don't inflate the attribution counters
+        self.device_engaged = False
 
     def check_assumption_sets(
         self, ctx, assumption_sets: List[List[int]]
@@ -257,6 +263,7 @@ class BatchedSatBackend:
         """
         from mythril_tpu.ops.pallas_prop import get_pallas_backend
 
+        self.device_engaged = False
         pallas = get_pallas_backend()
         if pallas.available_for(ctx):
             # fused MXU kernels over the per-call cone: dense incidence
@@ -266,6 +273,7 @@ class BatchedSatBackend:
             if dense is not None:
                 results, assignments = dense
                 self.last_assignments = assignments
+                self.device_engaged = True
                 return results
 
         from mythril_tpu.ops.device_health import device_ok
@@ -287,6 +295,9 @@ class BatchedSatBackend:
             return [None] * len(assumption_sets)
 
         jax, jnp = _require_jax()
+        # fold clauses the CDCL tail learned since the last refresh into
+        # the pool mirror before shipping it to the device
+        ctx.absorb_learnts(max_width=MAX_CLAUSE_WIDTH)
         if self.pool.version != ctx.pool_version or (
             self.pool.num_vars < num_vars
         ):
@@ -303,18 +314,33 @@ class BatchedSatBackend:
                 if var < V1:
                     assign[lane, var] = 1 if lit > 0 else -1
 
-        step = self._step_cache.get(self.pool.num_vars)
-        if step is None:
-            step = make_solve_step(self.pool.num_vars)
-            self._step_cache = {self.pool.num_vars: step}
-
         self._seed += 1
-        keys = jax.random.split(
-            jax.random.PRNGKey(self._seed), batch
-        )
-        final_assign, status = step(
-            self.pool.lits, jnp.asarray(assign), keys
-        )
+        self.device_engaged = True
+        if len(jax.devices()) > 1:
+            # multi-chip: lanes ride the dp axis, the clause pool is
+            # sharded over cp with psum-merged BCP (parallel/mesh.py);
+            # lits come from the pool's host mirror (no device->host
+            # round trip for an unchanged pool)
+            from mythril_tpu.parallel.mesh import (
+                get_mesh, sharded_frontier_solve,
+            )
+
+            final_assign, status = sharded_frontier_solve(
+                get_mesh(), self.pool.lits_np, assign,
+                seed=self._seed,
+            )
+            dispatch_stats.mesh_dispatches += 1
+        else:
+            step = self._step_cache.get(self.pool.num_vars)
+            if step is None:
+                step = make_solve_step(self.pool.num_vars)
+                self._step_cache = {self.pool.num_vars: step}
+            keys = jax.random.split(
+                jax.random.PRNGKey(self._seed), batch
+            )
+            final_assign, status = step(
+                self.pool.lits, jnp.asarray(assign), keys
+            )
         status = np.asarray(status)
         final_assign = np.asarray(final_assign)
 
@@ -388,7 +414,7 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     # full verification, so a hit is a sound SAT verdict
     probe_cache: Dict[Tuple[int, ...], bool] = {}
     for i, nodes in enumerate(node_sets):
-        if nodes is None:
+        if nodes is None or not getattr(args, "word_probing", True):
             continue
         key = tuple(sorted(n.id for n in nodes))
         hit = probe_cache.get(key)
@@ -405,10 +431,18 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     if len(open_indices) < max(2, getattr(args, "device_min_lanes", 8)):
         return decided
 
-    assumption_sets: List[Optional[List[int]]] = [
-        [ctx.blast_lit(n) for n in nodes] if nodes is not None else None
-        for nodes in node_sets
-    ]
+    # blast only the still-open lanes (probe-decided lanes must not grow
+    # the clause pool, and an op outside the blaster's fragment should
+    # just leave its lane to the CDCL tail, not fail the batch)
+    assumption_sets: List[Optional[List[int]]] = [None] * len(node_sets)
+    for i in list(open_indices):
+        try:
+            assumption_sets[i] = [ctx.blast_lit(n) for n in node_sets[i]]
+        except NotImplementedError:
+            decided[i] = None
+            open_indices.remove(i)
+    if len(open_indices) < 2:
+        return decided
 
     # dedupe identical assumption sets: sibling states forked in the
     # same VM step often share most (sometimes all) constraints
@@ -428,15 +462,24 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     verdicts = backend.check_assumption_sets(
         ctx, [assumption_sets[i] for i in rep_indices]
     )
-    dispatch_stats.dispatches += 1
-    dispatch_stats.lanes += len(rep_indices)
+    # attribution counters tally only real device (or interpret-mode
+    # kernel) passes — a bail-out to the CDCL tail is not a dispatch
+    engaged = getattr(backend, "device_engaged", False)
+    if engaged:
+        dispatch_stats.dispatches += 1
+        dispatch_stats.lanes += len(rep_indices)
 
+    counted_lanes = set()  # per-verdict counters tally device lanes,
+    # not original states (several states can share one deduped lane)
     for pos, i in enumerate(open_indices):
         lane = lane_of[pos]
+        first_for_lane = engaged and lane not in counted_lanes
+        counted_lanes.add(lane)
         verdict = verdicts[lane]
         if verdict is False:
             decided[i] = False
-            dispatch_stats.unsat += 1
+            if first_for_lane:
+                dispatch_stats.unsat += 1
             continue
         # candidate lane: verify the (possibly partial) assignment by
         # evaluating the original terms; unassigned leaves default 0
@@ -450,10 +493,11 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
                 ok = False
                 break
         decided[i] = True if ok else None
-        if ok:
-            dispatch_stats.sat_verified += 1
-        else:
-            dispatch_stats.undecided += 1
+        if first_for_lane:
+            if ok:
+                dispatch_stats.sat_verified += 1
+            else:
+                dispatch_stats.undecided += 1
     return decided
 
 

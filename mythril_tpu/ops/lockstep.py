@@ -44,23 +44,38 @@ RUNNING, STOPPED, RETURNED, REVERTED, ERROR, NEEDS_HOST = 0, 1, 2, 3, 4, 5
 
 
 class Program(NamedTuple):
-    """Host-prepared shared bytecode: padded code + jumpdest validity."""
+    """Host-prepared shared bytecode: padded code + jumpdest validity.
 
-    code: np.ndarray        # uint8[L + 33] (zero padded)
-    jumpdest: np.ndarray    # bool[L + 33]
+    Code is padded to a power-of-two bucket so the jitted step function
+    is shared by every program of the same bucket (code/jumpdest enter
+    the XLA program as *arguments*, not baked-in constants — one compile
+    serves a whole corpus)."""
+
+    code: np.ndarray        # uint8[bucket] (zero padded)
+    jumpdest: np.ndarray    # bool[bucket]
     length: int
 
 
+def _bucket_len(n: int) -> int:
+    size = 256
+    while size < n:
+        size *= 2
+    return size
+
+
+@functools.lru_cache(maxsize=64)
 def prepare_program(code: bytes) -> Program:
     arr = np.frombuffer(code, dtype=np.uint8)
-    valid = np.zeros(len(arr) + 33, dtype=bool)
+    bucket = _bucket_len(len(arr) + 33)
+    valid = np.zeros(bucket, dtype=bool)
     i = 0
     while i < len(arr):
-        op = arr[i]
+        op = int(arr[i])  # plain int: np.uint8 would wrap `i` at 255
         if op == 0x5B:
             valid[i] = True
         i += 33 - 32 + (op - 0x5F) if 0x60 <= op <= 0x7F else 1
-    padded = np.concatenate([arr, np.zeros(33, dtype=np.uint8)])
+    padded = np.zeros(bucket, dtype=np.uint8)
+    padded[: len(arr)] = arr
     return Program(padded, valid, len(arr))
 
 
@@ -84,12 +99,17 @@ class EVMState(NamedTuple):
 def init_state(batch: int, calldata: np.ndarray, calldatasize, callvalue=None,
                caller=None, storage_keys=None, storage_vals=None):
     """Fresh SoA state; calldata uint8[B, C] (padded so windowed reads
-    at any in-size offset stay inside the arena)."""
+    at any in-size offset stay inside the arena, and bucketed so
+    differing calldata lengths share one compiled runner)."""
     import jax.numpy as jnp
 
     B = batch
+    calldata = np.asarray(calldata, np.uint8)
+    arena = 64
+    while arena < calldata.shape[1] + 32:
+        arena *= 2
     calldata = np.concatenate(
-        [np.asarray(calldata, np.uint8), np.zeros((batch, 32), np.uint8)],
+        [calldata, np.zeros((batch, arena - calldata.shape[1]), np.uint8)],
         axis=1,
     )
     if callvalue is None:
@@ -204,6 +224,19 @@ def _gather32(arena, offset):
     )(arena, offset)
 
 
+def _word_exceeds(word, limit):
+    """True per lane where the 256-bit word (u32[B, 8] limbs) exceeds
+    ``limit`` (a host int < 2**32), compared in uint32 — offsets past a
+    fixed arena must NOT silently clamp/alias (they halt NEEDS_HOST so
+    the host VM takes over with real quadratic-gas memory semantics)."""
+    import jax.numpy as jnp
+
+    high = jnp.zeros(word.shape[:-1], bool)
+    for limb in range(1, 8):
+        high = high | (word[..., limb] != 0)
+    return high | (word[..., 0] > jnp.uint32(limit))
+
+
 def _scatter32(arena, offset, data, mask):
     import jax
     import jax.numpy as jnp
@@ -220,15 +253,14 @@ def _scatter32(arena, offset, data, mask):
 # ---------------------------------------------------------------------------
 
 
-def make_step(program: Program):
-    """Build step(state) -> state for one shared program."""
+def make_step():
+    """Build step(state, code, jumpdest, code_len) -> state.
+
+    The program enters as traced arguments so the compiled step is
+    polymorphic over every program of one length bucket."""
     import jax
     import jax.numpy as jnp
     from jax import lax
-
-    code = jnp.asarray(program.code)
-    jumpdest = jnp.asarray(program.jumpdest)
-    code_len = program.length
 
     def guarded(mask, fn):
         """Run a batched handler only when some lane selects it."""
@@ -245,7 +277,7 @@ def make_step(program: Program):
             halt=jnp.where(bad, ERROR, state.halt)
         )
 
-    def step(state):
+    def step(state, code, jumpdest, code_len):
         B = state.sp.shape[0]
         pc = jnp.clip(state.pc, 0, code.shape[0] - 1)
         op = code[pc].astype(jnp.int32)
@@ -456,39 +488,54 @@ def make_step(program: Program):
                 halt=jnp.where(mask & overflow, ERROR, s.halt),
             )
 
-        # --- memory ---
+        # --- memory (offsets past the fixed arena halt NEEDS_HOST — the
+        # host VM owns real memory-expansion semantics; silent clamping
+        # would alias the arena edge and produce wrong concrete values) ---
         def h_mload(s, mask):
-            off = _peek(s, 0)[..., 0].astype(jnp.int32)
+            word = _peek(s, 0)
+            oob = _word_exceeds(word, MEMORY_BYTES - 32)
+            ok = mask & ~oob
+            off = word[..., 0].astype(jnp.int32)
             data = _gather32(s.memory, off)
             value = _bytes_to_word(data)
-            stack = _set_at(s.stack, s.sp - 1, value, mask)
+            stack = _set_at(s.stack, s.sp - 1, value, ok)
             return s._replace(
-                stack=stack, pc=jnp.where(mask, s.pc + 1, s.pc)
+                stack=stack,
+                pc=jnp.where(ok, s.pc + 1, s.pc),
+                halt=jnp.where(mask & oob, NEEDS_HOST, s.halt),
             )
 
         def h_mstore(s, mask):
-            off = _peek(s, 0)[..., 0].astype(jnp.int32)
+            word = _peek(s, 0)
+            oob = _word_exceeds(word, MEMORY_BYTES - 32)
+            ok = mask & ~oob
+            off = word[..., 0].astype(jnp.int32)
             value = _peek(s, 1)
             data = _word_to_bytes(value)
-            memory = _scatter32(s.memory, off, data, mask)
+            memory = _scatter32(s.memory, off, data, ok)
             return s._replace(
                 memory=memory,
-                sp=jnp.where(mask, s.sp - 2, s.sp),
-                pc=jnp.where(mask, s.pc + 1, s.pc),
+                sp=jnp.where(ok, s.sp - 2, s.sp),
+                pc=jnp.where(ok, s.pc + 1, s.pc),
+                halt=jnp.where(mask & oob, NEEDS_HOST, s.halt),
             )
 
         def h_mstore8(s, mask):
+            word = _peek(s, 0)
+            oob = _word_exceeds(word, MEMORY_BYTES - 1)
+            ok = mask & ~oob
             off = jnp.clip(
-                _peek(s, 0)[..., 0].astype(jnp.int32), 0, MEMORY_BYTES - 1
+                word[..., 0].astype(jnp.int32), 0, MEMORY_BYTES - 1
             )
             value = (_peek(s, 1)[..., 0] & 0xFF).astype(jnp.uint8)
             B = s.sp.shape[0]
             memory = s.memory.at[jnp.arange(B), off].set(value)
-            memory = jnp.where(mask[:, None], memory, s.memory)
+            memory = jnp.where(ok[:, None], memory, s.memory)
             return s._replace(
                 memory=memory,
-                sp=jnp.where(mask, s.sp - 2, s.sp),
-                pc=jnp.where(mask, s.pc + 1, s.pc),
+                sp=jnp.where(ok, s.sp - 2, s.sp),
+                pc=jnp.where(ok, s.pc + 1, s.pc),
+                halt=jnp.where(mask & oob, NEEDS_HOST, s.halt),
             )
 
         # --- storage (associative linear scan over K slots) ---
@@ -555,13 +602,20 @@ def make_step(program: Program):
             )
 
         def h_calldataload(s, mask):
-            off = _peek(s, 0)[..., 0].astype(jnp.int32)
+            word = _peek(s, 0)
+            # EVM semantics: any read at/past calldatasize yields zero —
+            # including offsets whose high limbs are set (which would
+            # otherwise alias through the uint32->int32 truncation)
+            high = _word_exceeds(word, 0xFFFFFFFF)  # any high limb set
+            beyond = high | (
+                word[..., 0] >= s.calldatasize.astype(jnp.uint32)
+            )
+            off = word[..., 0].astype(jnp.int32)
             window = _gather32(s.calldata, off)
             # out-of-size bytes read as zero
-            B = s.sp.shape[0]
             positions = jnp.clip(off, 0, s.calldata.shape[1] - 32)[:, None] \
                 + jnp.arange(32)[None, :]
-            in_range = positions < s.calldatasize[:, None]
+            in_range = (positions < s.calldatasize[:, None]) & ~beyond[:, None]
             window = jnp.where(in_range, window, 0)
             value = _bytes_to_word(window)
             stack = _set_at(s.stack, s.sp - 1, value, mask)
@@ -635,32 +689,41 @@ for _k in range(16):
     _POPS_TABLE[0x90 + _k] = _k + 2   # SWAPn needs n+1 items
 
 
-@functools.lru_cache(maxsize=32)
-def _jit_run(code_bytes: bytes, max_steps: int):
+@functools.lru_cache(maxsize=8)
+def _jit_run(bucket: int, max_steps: int):
+    """One compiled runner per (code-length bucket, step cap) — shared
+    by every program in the bucket (code/jumpdest are arguments)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    program = prepare_program(code_bytes)
-    step = make_step(program)
+    step = make_step()
 
-    def run(state):
+    def run(state, code, jumpdest, code_len):
         def cond(carry):
             state, i = carry
             return jnp.any(state.halt == RUNNING) & (i < max_steps)
 
         def body(carry):
             state, i = carry
-            return step(state), i + 1
+            return step(state, code, jumpdest, code_len), i + 1
 
         state, steps = lax.while_loop(cond, body, (state, 0))
         return state, steps
 
-    return jax.jit(run), program
+    return jax.jit(run)
 
 
 def run_batch(code: bytes, state, max_steps: int = 4096):
     """Run all lanes to halt (or the step cap) and return the final
     state + step count."""
-    run, _ = _jit_run(bytes(code), max_steps)
-    return run(state)
+    import jax.numpy as jnp
+
+    program = prepare_program(bytes(code))
+    run = _jit_run(len(program.code), max_steps)
+    return run(
+        state,
+        jnp.asarray(program.code),
+        jnp.asarray(program.jumpdest),
+        jnp.int32(program.length),
+    )

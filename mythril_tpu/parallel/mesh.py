@@ -29,6 +29,18 @@ PROPAGATE_ITERS = 64
 DECISION_ROUNDS = 8
 
 
+_mesh_cache = None
+_solve_cache = {}
+
+
+def get_mesh():
+    """Process-wide default mesh over all visible devices (cached)."""
+    global _mesh_cache
+    if _mesh_cache is None:
+        _mesh_cache = build_mesh()
+    return _mesh_cache
+
+
 def build_mesh(n_devices: int = None, dp: int = None, cp: int = None):
     """Build a dp x cp mesh over the available (or first n) devices."""
     import jax
@@ -58,7 +70,11 @@ def make_sharded_solve(mesh, num_vars: int):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
 
     from mythril_tpu.ops.batched_sat import build_solve_lane
 
@@ -81,13 +97,15 @@ def make_sharded_solve(mesh, num_vars: int):
             lits_shard, assign_shard, keys_shard
         )
 
-    sharded = shard_map(
-        solve_shard,
+    specs = dict(
         mesh=mesh,
         in_specs=(P("cp", None), P("dp", None), P("dp")),
         out_specs=(P("dp", None), P("dp")),
-        check_rep=False,
     )
+    try:  # jax >= 0.8 renamed the replication-check toggle
+        sharded = shard_map(solve_shard, check_vma=False, **specs)
+    except TypeError:  # pragma: no cover — older jax
+        sharded = shard_map(solve_shard, check_rep=False, **specs)
     return jax.jit(sharded)
 
 
@@ -113,7 +131,12 @@ def sharded_frontier_solve(
             [lits, np.zeros((pad_rows, lits.shape[1]), np.int32)]
         )
     keys = jax.random.split(jax.random.PRNGKey(seed), assign.shape[0])
-    solve = make_sharded_solve(mesh, assign.shape[1] - 1)
+    cache_key = (id(mesh), assign.shape[1] - 1)
+    solve = _solve_cache.get(cache_key)
+    if solve is None:
+        solve = make_sharded_solve(mesh, assign.shape[1] - 1)
+        _solve_cache.clear()  # one live shape per mesh is enough
+        _solve_cache[cache_key] = solve
     final_assign, status = solve(
         jnp.asarray(lits), jnp.asarray(assign), keys
     )

@@ -56,6 +56,7 @@ class BlastContext:
         self.recent_models: List[T.EvalEnv] = []
         self._freevar_cache: Dict[int, frozenset] = {}
         self._cone_cache: Dict[int, Tuple[frozenset, frozenset]] = {}
+        self._learnt_cursor = 0  # native clause index already absorbed
         # defining-cone index: var -> indices of the clauses that define
         # it.  By construction (Tseitin), the defined gate is the
         # youngest variable in its defining clauses, so the default
@@ -137,6 +138,31 @@ class BlastContext:
                     if w > 1 and w not in seen_vars:
                         stack.append(w)
         return frozenset(seen_clauses), frozenset(seen_vars)
+
+    def absorb_learnts(self, max_width: int = 8) -> int:
+        """Pull clauses the native CDCL has learned since the last sync
+        into the host-side pool mirror, so the next device-pool refresh
+        ships them to the batched BCP kernels (SURVEY §5.8: CDCL-derived
+        pruning power transfers to the lockstep path).  Learned clauses
+        are implied by the pool, so absorbing them preserves the
+        device verdict soundness contract.  Returns how many were added.
+        """
+        try:
+            clauses, cursor = self.solver.learnt_clauses(
+                max_width=max_width, from_index=self._learnt_cursor
+            )
+        except Exception:  # noqa: BLE001 — sharing is an optimization
+            return 0
+        self._learnt_cursor = cursor
+        for lits in clauses:
+            index = len(self.clauses_py)
+            self.clauses_py.append(tuple(lits))
+            owner = max((abs(l) for l in lits), default=0)
+            if owner > 1:
+                self.def_clauses.setdefault(owner, []).append(index)
+        if clauses:
+            self.pool_version += 1
+        return len(clauses)
 
     def new_lit(self) -> int:
         return self.solver.new_var()
@@ -545,7 +571,13 @@ class BlastContext:
             if c is T.TRUE:
                 continue
             nodes.append(c)
-        env = self._probe_candidates(nodes)
+        from mythril_tpu.support.support_args import args as _args
+
+        env = (
+            self._probe_candidates(nodes)
+            if getattr(_args, "word_probing", True)
+            else None
+        )
         if env is not None:
             return SatSolver.SAT, env
         assumptions = [self.blast_lit(c) for c in nodes]

