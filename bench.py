@@ -136,6 +136,7 @@ def _run_corpus(mode: str):
             module.cache.clear()
         dispatch_stats.reset()
         stats = SolverStatistics()
+        stats.enabled = True
         stats.reset()
         contract = EVMContract(code=code, name=name)
         time_handler.start_execution(300)

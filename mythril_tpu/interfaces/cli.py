@@ -487,6 +487,51 @@ def load_code(disassembler: MythrilDisassembler, args: argparse.Namespace):
     return address
 
 
+def _build_analyzer(
+    disassembler: MythrilDisassembler,
+    address,
+    args: argparse.Namespace,
+    use_onchain_data: bool,
+) -> MythrilAnalyzer:
+    """One construction point for MythrilAnalyzer from CLI flags
+    (shared by analyze and truffle so new flags can't drift apart)."""
+    return MythrilAnalyzer(
+        strategy=args.strategy,
+        disassembler=disassembler,
+        address=address,
+        max_depth=args.max_depth,
+        execution_timeout=args.execution_timeout,
+        loop_bound=args.loop_bound,
+        create_timeout=args.create_timeout,
+        enable_iprof=args.enable_iprof,
+        disable_dependency_pruning=args.disable_dependency_pruning,
+        use_onchain_data=use_onchain_data,
+        solver_timeout=args.solver_timeout,
+        parallel_solving=args.parallel_solving,
+        custom_modules_directory=args.custom_modules_directory or "",
+        sparse_pruning=args.sparse_pruning,
+        unconstrained_storage=args.unconstrained_storage,
+        call_depth_limit=args.call_depth_limit,
+        enable_coverage_strategy=args.enable_coverage_strategy,
+    )
+
+
+def _fire_and_print(analyzer: MythrilAnalyzer, args: argparse.Namespace) -> None:
+    report = analyzer.fire_lasers(
+        modules=[m.strip() for m in args.modules.strip().split(",")]
+        if args.modules
+        else None,
+        transaction_count=args.transaction_count,
+    )
+    outputs = {
+        "json": report.as_json(),
+        "jsonv2": report.as_swc_standard_format(),
+        "text": report.as_text(),
+        "markdown": report.as_markdown(),
+    }
+    print(outputs[getattr(args, "outform", "text")])
+
+
 def execute_truffle(args: argparse.Namespace) -> None:
     """Analyze every compiled artifact of a truffle project: run from
     the project root after ``truffle compile``; each
@@ -529,38 +574,10 @@ def execute_truffle(args: argparse.Namespace) -> None:
             outform, "No deployable contracts found in build/contracts."
         )
 
-    analyzer = MythrilAnalyzer(
-        strategy=args.strategy,
-        disassembler=disassembler,
-        address=address,
-        max_depth=args.max_depth,
-        execution_timeout=args.execution_timeout,
-        loop_bound=args.loop_bound,
-        create_timeout=args.create_timeout,
-        enable_iprof=args.enable_iprof,
-        disable_dependency_pruning=args.disable_dependency_pruning,
-        use_onchain_data=False,
-        solver_timeout=args.solver_timeout,
-        parallel_solving=args.parallel_solving,
-        custom_modules_directory=args.custom_modules_directory or "",
-        sparse_pruning=args.sparse_pruning,
-        unconstrained_storage=args.unconstrained_storage,
-        call_depth_limit=args.call_depth_limit,
-        enable_coverage_strategy=args.enable_coverage_strategy,
+    _fire_and_print(
+        _build_analyzer(disassembler, address, args, use_onchain_data=False),
+        args,
     )
-    report = analyzer.fire_lasers(
-        modules=[m.strip() for m in args.modules.strip().split(",")]
-        if args.modules
-        else None,
-        transaction_count=args.transaction_count,
-    )
-    outputs = {
-        "json": report.as_json(),
-        "jsonv2": report.as_swc_standard_format(),
-        "text": report.as_text(),
-        "markdown": report.as_markdown(),
-    }
-    print(outputs[outform])
 
 
 def execute_command(
@@ -592,26 +609,9 @@ def execute_command(
         return
 
     if args.command in ANALYZE_LIST:
-        analyzer = MythrilAnalyzer(
-            strategy=args.strategy,
-            disassembler=disassembler,
-            address=address,
-            max_depth=args.max_depth,
-            execution_timeout=args.execution_timeout,
-            loop_bound=args.loop_bound,
-            create_timeout=args.create_timeout,
-            enable_iprof=args.enable_iprof,
-            disable_dependency_pruning=args.disable_dependency_pruning,
+        analyzer = _build_analyzer(
+            disassembler, address, args,
             use_onchain_data=not args.no_onchain_data,
-            solver_timeout=args.solver_timeout,
-            parallel_solving=args.parallel_solving,
-            custom_modules_directory=args.custom_modules_directory
-            if args.custom_modules_directory
-            else "",
-            sparse_pruning=args.sparse_pruning,
-            unconstrained_storage=args.unconstrained_storage,
-            call_depth_limit=args.call_depth_limit,
-            enable_coverage_strategy=args.enable_coverage_strategy,
         )
 
         if not disassembler.contracts:
@@ -646,19 +646,7 @@ def execute_command(
             return
 
         try:
-            report = analyzer.fire_lasers(
-                modules=[m.strip() for m in args.modules.strip().split(",")]
-                if args.modules
-                else None,
-                transaction_count=args.transaction_count,
-            )
-            outputs = {
-                "json": report.as_json(),
-                "jsonv2": report.as_swc_standard_format(),
-                "text": report.as_text(),
-                "markdown": report.as_markdown(),
-            }
-            print(outputs[args.outform])
+            _fire_and_print(analyzer, args)
         except DetectorNotFoundError as e:
             exit_with_error(args.outform, format(e))
         except CriticalError as e:

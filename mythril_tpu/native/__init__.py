@@ -73,6 +73,9 @@ def load() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_int64),
         ]
         lib.cdcl_learnt_clauses.restype = ctypes.c_int64
+        lib.cdcl_set_relevant.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ]
         lib.keccak256_native.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
         ]
@@ -137,6 +140,13 @@ class SatSolver:
 
     def model(self, variables: Sequence[int]) -> List[bool]:
         return [self.model_value(v) for v in variables]
+
+    def set_relevant(self, variables: Sequence[int]) -> None:
+        """Restrict decisions to the given variables (the query's cone);
+        pass an empty sequence to lift the restriction.  See the C++
+        soundness note on Solver::set_relevant."""
+        arr = (ctypes.c_int32 * len(variables))(*variables)
+        self._lib.cdcl_set_relevant(self._handle, arr, len(variables))
 
     def learnt_clauses(
         self, max_width: int = 8, from_index: int = 0, cap: int = 1 << 18

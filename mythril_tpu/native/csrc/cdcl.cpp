@@ -113,6 +113,24 @@ class Solver {
   }
 
   // 1 sat, -1 unsat, 0 unknown (budget exhausted)
+  // Restrict decisions to a relevant-variable set (the assumption
+  // cone).  Sound: the shared pool holds only definitional (Tseitin)
+  // and implied (learned) clauses, which are satisfiable under ANY
+  // assignment of their inputs, so once every relevant var is assigned
+  // without conflict a completion of the foreign gates exists;
+  // UNSAT verdicts come from conflicts over real clauses and are
+  // unaffected by decision policy.  n == 0 lifts the restriction.
+  void set_relevant(const int32_t* vars, int64_t n) {
+    restricted_ = n > 0;
+    if (!restricted_) return;
+    relevant_.assign(assigns_.size(), 0);
+    if (relevant_.size() > 1) relevant_[1] = 1;  // TRUE anchor
+    for (int64_t i = 0; i < n; ++i) {
+      int32_t v = vars[i];
+      if (v > 0 && (size_t)v < relevant_.size()) relevant_[v] = 1;
+    }
+  }
+
   int solve(const Lit* assumps, int n_assumps, int64_t conflict_budget,
             double time_budget_s) {
     conflict_core_.clear();
@@ -149,6 +167,12 @@ class Solver {
     if (status == 1) {
       model_.assign(assigns_.begin(), assigns_.end());
     }
+    // irrelevant vars stashed out of the decision heap during this
+    // query go back so later (differently-coned) queries see them
+    for (Var v : stash_) {
+      if (heap_pos_[v] == -1) heap_insert(v);
+    }
+    stash_.clear();
     // keep the trail: the next call reuses the matching prefix
     return status;
   }
@@ -205,6 +229,9 @@ class Solver {
   vector<int> heap_pos_;
   vector<Lit> assumptions_;
   vector<Lit> prev_assumptions_;  // for assumption-prefix trail reuse
+  vector<uint8_t> relevant_;      // decision restriction (see set_relevant)
+  bool restricted_ = false;
+  vector<Var> stash_;             // irrelevant vars parked during a solve
   vector<Lit> conflict_core_;
   vector<int8_t> model_;
   int64_t budget_conflicts_ = -1;
@@ -558,13 +585,19 @@ class Solver {
           uncheckedEnqueue(a, -1);
           continue;
         }
-        // normal decision
+        // normal decision (restricted to the assumption cone when set)
         Var next = 0;
         while (!heap_.empty()) {
           Var cand = heap_pop();
-          if (assigns_[cand] == 0) { next = cand; break; }
+          if (assigns_[cand] != 0) continue;
+          if (restricted_ && !relevant_[cand]) {
+            stash_.push_back(cand);
+            continue;
+          }
+          next = cand;
+          break;
         }
-        if (next == 0) return 1;  // all assigned: SAT
+        if (next == 0) return 1;  // every relevant var assigned: SAT
         trail_lim_.push_back((int)trail_.size());
         Lit decision = polarity_[next] ? next : -next;
         uncheckedEnqueue(decision, -1);
@@ -596,6 +629,9 @@ int64_t cdcl_num_clauses(void* s) { return ((Solver*)s)->num_clauses(); }
 int64_t cdcl_learnt_clauses(void* s, int32_t max_width, int64_t from,
                             int32_t* out, int64_t cap, int64_t* next) {
   return ((Solver*)s)->collect_learnts(max_width, from, out, cap, next);
+}
+void cdcl_set_relevant(void* s, const int32_t* vars, int64_t n) {
+  ((Solver*)s)->set_relevant(vars, n);
 }
 
 // ---------------------------------------------------------------------------

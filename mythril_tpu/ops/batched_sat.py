@@ -82,6 +82,8 @@ class DevicePool:
         self.num_vars = 0
         self.num_clauses = 0
         self.dropped = 0
+        self.consumed = 0       # ctx.clauses_py rows reflected on device
+        self.filled = 0         # non-pad rows used in the bucket
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -105,6 +107,7 @@ class DevicePool:
             )
         if not rows:
             rows = [[0] * MAX_CLAUSE_WIDTH]
+        real_rows = len(rows)
         # pad clause count to the bucket with inert all-zero rows
         target_c = self._bucket(len(rows))
         rows.extend([[0] * MAX_CLAUSE_WIDTH] * (target_c - len(rows)))
@@ -114,6 +117,33 @@ class DevicePool:
         self.num_vars = self._bucket(num_vars)
         self.num_clauses = target_c
         self.dropped = dropped
+        self.consumed = len(clauses_py)
+        self.filled = real_rows
+
+    def append(self, new_clauses: Sequence[Tuple[int, ...]], num_vars: int) -> bool:
+        """Reflect a pool delta in-place when it fits the existing
+        buckets: pad rows are overwritten on host and device (a device
+        .at[].set touches only the delta) — no full rebuild/upload per
+        dispatch while the CDCL tail keeps learning clauses."""
+        if self.lits is None or self._bucket(num_vars) > self.num_vars:
+            return False
+        rows = []
+        for clause in new_clauses:
+            if len(clause) > MAX_CLAUSE_WIDTH:
+                self.dropped += 1
+                continue
+            rows.append(list(clause) + [0] * (MAX_CLAUSE_WIDTH - len(clause)))
+        if self.filled + len(rows) > self.num_clauses:
+            return False
+        if rows:
+            block = np.asarray(rows, dtype=np.int32)
+            self.lits_np[self.filled : self.filled + len(rows)] = block
+            self.lits = self.lits.at[
+                self.filled : self.filled + len(rows)
+            ].set(block)
+            self.filled += len(rows)
+        self.consumed += len(new_clauses)
+        return True
 
 
 def build_solve_lane(
@@ -283,9 +313,13 @@ class BatchedSatBackend:
         # few thousand clauses it costs orders of magnitude more than the
         # incremental CDCL it is trying to save (measured: ~45 s/dispatch
         # at 76k clauses vs ~ms per CDCL query).  Big-cone lanes go
-        # straight to the CDCL tail.
+        # straight to the CDCL tail.  Absorbed learnt clauses don't count
+        # against the budget — sharing them must not shut the device off.
+        base_clauses = len(ctx.clauses_py) - getattr(
+            ctx, "absorbed_learnt_count", 0
+        )
         if (
-            len(ctx.clauses_py) > MAX_GATHER_CLAUSES
+            base_clauses > MAX_GATHER_CLAUSES
             or num_vars > MAX_GATHER_VARS
             or not device_ok()
         ):
@@ -301,7 +335,12 @@ class BatchedSatBackend:
         if self.pool.version != ctx.pool_version or (
             self.pool.num_vars < num_vars
         ):
-            self.pool.refresh(ctx.clauses_py, num_vars)
+            # delta append into the existing buckets when possible; full
+            # rebuild + upload only when a bucket grows
+            if not self.pool.append(
+                ctx.clauses_py[self.pool.consumed :], num_vars
+            ):
+                self.pool.refresh(ctx.clauses_py, num_vars)
             self.pool.version = ctx.pool_version
 
         batch = len(assumption_sets)
@@ -411,17 +450,15 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
             node_sets.append(nodes)
 
     # host word-level probe: evaluation against candidate models is a
-    # full verification, so a hit is a sound SAT verdict
-    probe_cache: Dict[Tuple[int, ...], bool] = {}
+    # full verification, so a hit is a sound SAT verdict.  Results are
+    # memoized on the context (shared with the CDCL tail): SAT is
+    # permanent; a failed probe is retried only after a new model lands
+    # in recent_models (frontiers repeat constraint sets across rounds,
+    # so re-probing measured ~20% of corpus wall-clock)
     for i, nodes in enumerate(node_sets):
         if nodes is None or not getattr(args, "word_probing", True):
             continue
-        key = tuple(sorted(n.id for n in nodes))
-        hit = probe_cache.get(key)
-        if hit is None:
-            hit = ctx._probe_candidates(nodes) is not None
-            probe_cache[key] = hit
-        if hit:
+        if ctx.probe_with_memo(nodes) is not None:
             decided[i] = True
             dispatch_stats.host_probe_sat += 1
 

@@ -57,6 +57,13 @@ class BlastContext:
         self._freevar_cache: Dict[int, frozenset] = {}
         self._cone_cache: Dict[int, Tuple[frozenset, frozenset]] = {}
         self._learnt_cursor = 0  # native clause index already absorbed
+        self.absorbed_learnt_count = 0  # learnts folded into clauses_py
+        # probe memo: constraint-set key -> EvalEnv (SAT verdicts are
+        # permanent) or (False, model_version) (negative probes expire
+        # when a new model lands in recent_models); shared by the batch
+        # frontier pass and the per-query CDCL tail
+        self.probe_memo: Dict[Tuple[int, ...], object] = {}
+        self.model_version = 0
         # defining-cone index: var -> indices of the clauses that define
         # it.  By construction (Tseitin), the defined gate is the
         # youngest variable in its defining clauses, so the default
@@ -162,6 +169,7 @@ class BlastContext:
                 self.def_clauses.setdefault(owner, []).append(index)
         if clauses:
             self.pool_version += 1
+            self.absorbed_learnt_count += len(clauses)
         return len(clauses)
 
     def new_lit(self) -> int:
@@ -573,14 +581,25 @@ class BlastContext:
             nodes.append(c)
         from mythril_tpu.support.support_args import args as _args
 
-        env = (
-            self._probe_candidates(nodes)
-            if getattr(_args, "word_probing", True)
-            else None
-        )
-        if env is not None:
-            return SatSolver.SAT, env
+        if getattr(_args, "word_probing", True):
+            env = self.probe_with_memo(nodes)
+            if env is not None:
+                return SatSolver.SAT, env
         assumptions = [self.blast_lit(c) for c in nodes]
+        # restrict CDCL decisions to the query's cone: against a large
+        # shared pool, VSIDS otherwise wanders into foreign gates and
+        # pays full-pool propagation per irrelevant decision
+        if getattr(_args, "cone_decisions", True):
+            try:
+                _, cone_vars = self.cone(assumptions)
+                relevant = set(cone_vars)
+                relevant.update(abs(lit) for lit in assumptions)
+                self.solver.set_relevant(list(relevant))
+            except Exception:  # noqa: BLE001 — optimization only
+                self.solver.set_relevant([])
+        else:
+            # a stale restriction from an earlier query would be unsound
+            self.solver.set_relevant([])
         status = self.solver.solve(assumptions, conflict_budget, timeout_s)
         if status != SatSolver.SAT:
             return status, None
@@ -618,9 +637,19 @@ class BlastContext:
 
     @staticmethod
     def _equality_hints(nodes: Sequence[T.Node]) -> Dict[int, int]:
-        """var node id -> forced value from top-level ``var == const``
-        conjuncts (the dominant constraint shape: function selectors,
-        fixed callvalues, storage keys)."""
+        """var node id -> candidate value from constraint structure:
+
+        - top-level ``var == const`` conjuncts (function selectors,
+          fixed callvalues, storage keys);
+        - disjunctions whose arms pin a var: pick the first arm's value
+          (the dominant shape is ``caller == CREATOR || caller ==
+          ATTACKER || ...`` — under the plain zero candidate such an Or
+          evaluates false and the probe misses for no reason);
+        - one-sided bounds ``ULE(var, c)`` / ``ULE(c, var)``: the
+          boundary value itself.
+
+        Hints are guesses, not facts — every candidate model is fully
+        verified by evaluation before being trusted."""
         hints: Dict[int, int] = {}
         work = list(nodes)
         while work:
@@ -640,7 +669,134 @@ class BlastContext:
                     hints.setdefault(a.id, b.params[0])
                 elif b.op == "var" and a.op == "const":
                     hints.setdefault(b.id, a.params[0])
+            elif n.op == "bor":
+                # satisfy the disjunction through its first pinnable arm
+                arms = list(n.args)
+                while arms:
+                    arm = arms.pop(0)
+                    if arm.op == "bor":
+                        arms = list(arm.args) + arms
+                        continue
+                    if arm.op == "eq":
+                        a, b = arm.args
+                        if a.op == "var" and b.op == "const":
+                            hints.setdefault(a.id, b.params[0])
+                            break
+                        if b.op == "var" and a.op == "const":
+                            hints.setdefault(b.id, a.params[0])
+                            break
+            elif n.op in ("ule", "ult"):
+                a, b = n.args
+                if a.op == "var" and b.op == "const":
+                    bound = b.params[0] - (1 if n.op == "ult" else 0)
+                    if bound >= 0:
+                        hints.setdefault(a.id, bound)
+                elif b.op == "var" and a.op == "const":
+                    bound = a.params[0] + (1 if n.op == "ult" else 0)
+                    hints.setdefault(b.id, bound)
         return hints
+
+    @staticmethod
+    def _push_target(x: T.Node, value: int, var_hints, cell_hints) -> None:
+        """Backward-propagate the guess ``x == value`` through invertible
+        structure into variable / array-cell hints.  This cracks the
+        dominant probe-resistant shape — function-selector equations
+        ``const == (concat(calldata[0..3]...) >> 224) & 0xffffffff`` —
+        by writing the selector bytes into the calldata cells.  Hints
+        are guesses only; candidates are verified by evaluation."""
+        while True:
+            op = x.op
+            if op == "var":
+                var_hints.setdefault(x.id, value)
+                return
+            if op == "select":
+                base, idx = x.args
+                if base.op == "avar" and idx.is_const:
+                    cell_hints.setdefault(base.id, {}).setdefault(
+                        idx.params[0], value
+                    )
+                return
+            if op == "ite":
+                # ite(cond, select(...), 0): aim for the then-branch
+                x = x.args[1]
+                continue
+            if op == "and" and len(x.args) == 2:  # bitvector mask
+                a, b = x.args
+                if a.is_const and value & ~a.params[0] == 0:
+                    x = b
+                    continue
+                if b.is_const and value & ~b.params[0] == 0:
+                    x = a
+                    continue
+                return
+            if op == "lshr" and x.args[1].is_const:
+                shifted = value << x.args[1].params[0]
+                if shifted >> x.width:
+                    return
+                x, value = x.args[0], shifted
+                continue
+            if op == "shl" and x.args[1].is_const:
+                shift = x.args[1].params[0]
+                if value & ((1 << shift) - 1):
+                    return
+                x, value = x.args[0], value >> shift
+                continue
+            if op in ("zext", "sext"):
+                x = x.args[0]
+                value &= T.mask(x.width)
+                continue
+            if op == "extract":
+                high, low = x.params
+                x, value = x.args[0], value << low
+                continue
+            if op == "concat":
+                # first arg holds the highest bits
+                remaining = sum(a.width for a in x.args)
+                for part in x.args:
+                    remaining -= part.width
+                    BlastContext._push_target(
+                        part,
+                        (value >> remaining) & T.mask(part.width),
+                        var_hints,
+                        cell_hints,
+                    )
+                return
+            return
+
+    def _structure_hints(self, nodes: Sequence[T.Node]):
+        """(var_hints, cell_hints) from ``const == X`` top-level
+        conjuncts whose X decomposes bytewise."""
+        var_hints: Dict[int, int] = {}
+        cell_hints: Dict[int, Dict[int, int]] = {}
+        work = list(nodes)
+        while work:
+            n = work.pop()
+            if n.op == "band":
+                work.extend(n.args)
+            elif n.op == "eq":
+                a, b = n.args
+                if a.is_const and not b.is_const:
+                    self._push_target(b, a.params[0], var_hints, cell_hints)
+                elif b.is_const and not a.is_const:
+                    self._push_target(a, b.params[0], var_hints, cell_hints)
+        return var_hints, cell_hints
+
+    def probe_with_memo(self, nodes: Sequence[T.Node]) -> Optional[T.EvalEnv]:
+        """_probe_candidates behind the shared memo: SAT hits are
+        permanent, failures expire when a new model lands.  Both the
+        frontier batch pass and the per-query CDCL tail go through here
+        so an undecided lane is probed once per round, not twice."""
+        key = tuple(sorted(n.id for n in nodes))
+        memo = self.probe_memo.get(key)
+        if isinstance(memo, T.EvalEnv):
+            return memo  # SAT is a permanent property of the set
+        if memo is not None and memo[1] == self.model_version:
+            return None  # known-failed against the current model set
+        env = self._probe_candidates(nodes)
+        self.probe_memo[key] = (
+            env if env is not None else (False, self.model_version)
+        )
+        return env
 
     def _probe_candidates(
         self, nodes: Sequence[T.Node]
@@ -655,6 +811,9 @@ class BlastContext:
         for n in nodes:
             free |= self._free_vars(n)
         hints = self._equality_hints(nodes)
+        struct_vars, cell_hints = self._structure_hints(nodes)
+        for var_id, value in struct_vars.items():
+            hints.setdefault(var_id, value)
         bv = [n for n in free if n.op == "var"]
 
         def filled(base: Dict[int, int], fill) -> Dict[int, int]:
@@ -665,22 +824,40 @@ class BlastContext:
                     out[n.id] = fill(n)
             return out
 
+        def cells() -> Dict[int, Dict[int, int]]:
+            return {k: dict(v) for k, v in cell_hints.items()}
+
         candidates: List[T.EvalEnv] = [
-            T.EvalEnv(variables=dict(hints)),  # hints + zeros
-            T.EvalEnv(variables=filled({}, lambda n: T.mask(n.width))),
-            T.EvalEnv(variables=filled({}, lambda n: 1 << (n.width - 1))),
+            T.EvalEnv(variables=dict(hints), arrays=cells()),  # + zeros
+            T.EvalEnv(
+                variables=filled({}, lambda n: T.mask(n.width)),
+                arrays=cells(),
+            ),
+            # hints + zero vars, but unwritten array cells read 0xFF:
+            # satisfies "large word" constraints over symbolic calldata
+            # (overflow conditions) while selector cells stay pinned
+            T.EvalEnv(
+                variables=dict(hints), arrays=cells(), array_default=0xFF
+            ),
+            T.EvalEnv(
+                variables=filled({}, lambda n: 1 << (n.width - 1)),
+                arrays=cells(),
+            ),
         ]
         for env in self.recent_models:
             merged = dict(env.variables)
             merged.update(hints)
+            arrays = {k: dict(v) for k, v in env.arrays.items()}
+            for base_id, table in cell_hints.items():
+                arrays.setdefault(base_id, {}).update(table)
             candidates.append(
                 T.EvalEnv(
                     variables=merged,
-                    arrays={k: dict(v) for k, v in env.arrays.items()},
+                    arrays=arrays,
                     ufs=dict(env.ufs),
                 )
             )
-        for env in candidates:
+        for index, env in enumerate(candidates):
             cache: Dict[int, object] = {}
             try:
                 if all(
@@ -690,11 +867,150 @@ class BlastContext:
                     return env
             except Exception:  # noqa: BLE001 — probe failure is normal
                 continue
+            if index in (0, 4):  # zeros env + newest recent model
+                repaired = self._repair(nodes, env)
+                if repaired is not None:
+                    self._remember_model(repaired)
+                    return repaired
         return None
+
+    # -- word-level local repair ---------------------------------------
+
+    def _repair(
+        self, nodes: Sequence[T.Node], env: T.EvalEnv, rounds: int = 3
+    ) -> Optional[T.EvalEnv]:
+        """Bounded local search: evaluate the candidate, and for each
+        falsified constraint push concretely-known values across
+        equalities into free variables / array cells of the other side
+        (e.g. ``sender == owner_storage_slot`` repairs by writing the
+        sender's value into the storage cell).  Sound by construction —
+        the final env is only returned after full re-verification."""
+        env = T.EvalEnv(
+            variables=dict(env.variables),
+            arrays={k: dict(v) for k, v in env.arrays.items()},
+            ufs=dict(env.ufs),
+        )
+        for _ in range(rounds):
+            cache: Dict[int, object] = {}
+            try:
+                failed = [
+                    n for n in nodes if T.evaluate(n, env, cache) is not True
+                ]
+            except Exception:  # noqa: BLE001
+                return None
+            if not failed:
+                return env
+            progressed = False
+            for n in failed:
+                try:
+                    progressed |= self._repair_one(n, env, cache, True)
+                except Exception:  # noqa: BLE001
+                    continue
+            if not progressed:
+                return None
+        return None
+
+    def _repair_one(
+        self, n: T.Node, env: T.EvalEnv, cache, want: bool
+    ) -> bool:
+        """Try one structural adjustment making ``n`` evaluate ``want``;
+        returns True if the env was changed."""
+        op = n.op
+        if op == "bnot":
+            return self._repair_one(n.args[0], env, cache, not want)
+        if op == "band" and want:
+            changed = False
+            for arm in n.args:
+                if T.evaluate(arm, env, dict(cache)) is not True:
+                    changed |= self._repair_one(arm, env, cache, True)
+            return changed
+        if op == "bor" and want:
+            return self._repair_one(n.args[0], env, cache, True)
+        if op == "eq":
+            a, b = n.args
+            va = T.evaluate(a, env, dict(cache))
+            vb = T.evaluate(b, env, dict(cache))
+            if want:
+                if va == vb:
+                    return False
+                # bool-encoding bridge: const == ite(cond, c1, c0)
+                for const_side, other in ((a, b), (b, a)):
+                    if (
+                        const_side.is_const
+                        and other.op == "ite"
+                        and other.args[1].is_const
+                        and other.args[2].is_const
+                    ):
+                        target = const_side.params[0]
+                        if other.args[1].params[0] == target:
+                            return self._repair_one(
+                                other.args[0], env, cache, True
+                            )
+                        if other.args[2].params[0] == target:
+                            return self._repair_one(
+                                other.args[0], env, cache, False
+                            )
+                # push the concretely-evaluated side into the other
+                var_hints: Dict[int, int] = {}
+                cell_hints: Dict[int, Dict[int, int]] = {}
+                self._push_target(b, va, var_hints, cell_hints)
+                if not var_hints and not cell_hints:
+                    self._push_target(a, vb, var_hints, cell_hints)
+                return self._apply_hints(env, var_hints, cell_hints)
+            # want a disequality: nudge a directly-free side
+            if va != vb:
+                return False
+            for side, other_val in ((a, vb), (b, va)):
+                bump = (other_val + 1) & T.mask(side.width or 256)
+                if side.op == "var":
+                    env.variables[side.id] = bump
+                    return True
+                if (
+                    side.op == "select"
+                    and side.args[0].op == "avar"
+                    and side.args[1].is_const
+                ):
+                    env.arrays.setdefault(side.args[0].id, {})[
+                        side.args[1].params[0]
+                    ] = bump
+                    return True
+            return False
+        if op in ("ule", "ult") and want:
+            a, b = n.args
+            va = T.evaluate(a, env, dict(cache))
+            var_hints, cell_hints = {}, {}
+            # raise the upper side to meet the lower one
+            self._push_target(
+                b, min(va + (1 if op == "ult" else 0), T.mask(b.width)),
+                var_hints, cell_hints,
+            )
+            if not var_hints and not cell_hints:
+                # or lower the bounded side to zero
+                self._push_target(a, 0, var_hints, cell_hints)
+            return self._apply_hints(env, var_hints, cell_hints)
+        if op == "ite":
+            return self._repair_one(n.args[0], env, cache, want)
+        return False
+
+    @staticmethod
+    def _apply_hints(env: T.EvalEnv, var_hints, cell_hints) -> bool:
+        changed = False
+        for var_id, value in var_hints.items():
+            if env.variables.get(var_id) != value:
+                env.variables[var_id] = value
+                changed = True
+        for base_id, table in cell_hints.items():
+            cells = env.arrays.setdefault(base_id, {})
+            for idx, value in table.items():
+                if cells.get(idx) != value:
+                    cells[idx] = value
+                    changed = True
+        return changed
 
     def _remember_model(self, env: T.EvalEnv, keep: int = 6) -> None:
         self.recent_models.insert(0, env)
         del self.recent_models[keep:]
+        self.model_version += 1  # expires negative batch-probe memos
 
     def _bits_value(self, bits: List[int]) -> int:
         value = 0

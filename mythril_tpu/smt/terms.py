@@ -608,10 +608,15 @@ class EvalEnv:
     -> value.  Missing entries default to 0 / False / empty.
     """
 
-    def __init__(self, variables=None, arrays=None, ufs=None):
+    def __init__(self, variables=None, arrays=None, ufs=None,
+                 array_default: int = 0):
         self.variables = variables or {}
         self.arrays = arrays or {}
         self.ufs = ufs or {}
+        # value an unwritten cell of a symbolic array reads as — probe
+        # candidates use 0xFF to satisfy "large input" constraints
+        # (e.g. overflow conditions over calldata words)
+        self.array_default = array_default
 
 
 def evaluate(node: Node, env: EvalEnv, cache: Optional[dict] = None):
@@ -730,7 +735,7 @@ def _eval_select(arr: Node, idx_val: int, env: EvalEnv, memo: dict):
         elif arr.op == "constarr":
             return _eval(arr.args[0], env, memo)
         elif arr.op == "avar":
-            return env.arrays.get(arr.id, {}).get(idx_val, 0)
+            return env.arrays.get(arr.id, {}).get(idx_val, env.array_default)
         else:
             raise NotImplementedError(f"select base: {arr.op}")
 

@@ -20,6 +20,7 @@ class Args(object, metaclass=Singleton):
         # TPU-build extras
         self.batched_solving = True          # batch frontier feasibility checks
         self.word_probing = True             # host word-level model probing
+        self.cone_decisions = True           # CDCL decisions restricted to query cone
         self.batch_width = 16                # VM states stepped per scheduler round
         self.concrete_replay = True          # lockstep replay of exploit sequences
         self.batch_lanes = 64                # target lanes per TPU solver batch

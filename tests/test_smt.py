@@ -345,3 +345,45 @@ def test_optimize_exact_flag_and_unknown_handling():
     value = opt2.model().eval(y).as_long()
     assert value > 100  # model still satisfies the constraints
     assert calls["n"] == 2  # search stopped at the first unknown
+
+
+def test_cone_restricted_decisions_match_unrestricted():
+    """Decision restriction to the query cone must never change a
+    verdict (soundness note on Solver::set_relevant): random mixed
+    queries against a shared pool, restricted vs unrestricted."""
+    import random
+
+    from mythril_tpu.smt.solver import get_blast_context, reset_blast_context
+    from mythril_tpu.support.support_args import args as sargs
+    from mythril_tpu.native import SatSolver
+
+    rng = random.Random(99)
+    for trial in range(6):
+        reset_blast_context()
+        ctx = get_blast_context()
+        # a pool holding several independent constraint families
+        families = []
+        for f in range(4):
+            x = symbol_factory.BitVecSym(f"cd{trial}_{f}_x", 16)
+            y = symbol_factory.BitVecSym(f"cd{trial}_{f}_y", 16)
+            a = rng.randrange(1, 50)
+            sat_set = [(x + y == a + 7).raw, ULT(x, symbol_factory.BitVecVal(a, 16)).raw]
+            unsat_set = sat_set + [UGT(x, symbol_factory.BitVecVal(a + 90, 16)).raw]
+            families.append((sat_set, unsat_set))
+        for sat_set, unsat_set in families:
+            for nodes in (sat_set, unsat_set):
+                sargs.word_probing = False  # force the CDCL path
+                try:
+                    sargs.cone_decisions = True
+                    restricted, _ = ctx.check(nodes)
+                    sargs.cone_decisions = False
+                    ctx.solver.set_relevant([])
+                    unrestricted, _ = ctx.check(nodes)
+                finally:
+                    sargs.word_probing = True
+                    sargs.cone_decisions = True
+                assert restricted == unrestricted, (
+                    f"verdict drift: restricted={restricted} "
+                    f"unrestricted={unrestricted}"
+                )
+                assert restricted in (SatSolver.SAT, SatSolver.UNSAT)
