@@ -523,13 +523,13 @@ def _fire_and_print(analyzer: MythrilAnalyzer, args: argparse.Namespace) -> None
         else None,
         transaction_count=args.transaction_count,
     )
-    outputs = {
-        "json": report.as_json(),
-        "jsonv2": report.as_swc_standard_format(),
-        "text": report.as_text(),
-        "markdown": report.as_markdown(),
+    renderers = {
+        "json": report.as_json,
+        "jsonv2": report.as_swc_standard_format,
+        "text": report.as_text,
+        "markdown": report.as_markdown,
     }
-    print(outputs[getattr(args, "outform", "text")])
+    print(renderers[getattr(args, "outform", "text")]())
 
 
 def execute_truffle(args: argparse.Namespace) -> None:

@@ -63,6 +63,13 @@ def load() -> ctypes.CDLL:
         lib.cdcl_solve.restype = ctypes.c_int32
         lib.cdcl_model_value.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.cdcl_model_value.restype = ctypes.c_int32
+        lib.cdcl_add_clauses.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ]
+        lib.cdcl_add_clauses.restype = ctypes.c_int64
+        lib.cdcl_model_into.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int8), ctypes.c_int32,
+        ]
         lib.cdcl_conflicts.argtypes = [ctypes.c_void_p]
         lib.cdcl_conflicts.restype = ctypes.c_int64
         lib.cdcl_num_clauses.argtypes = [ctypes.c_void_p]
@@ -135,18 +142,56 @@ class SatSolver:
             self._handle, arr, len(assumptions), conflict_budget, time_budget_s
         )
 
+    def add_clauses_flat(self, flat) -> int:
+        """Bulk clause load from a 0-separated int32 numpy array (one
+        ctypes crossing for the whole batch).  Returns the number of
+        clauses consumed; negative when the database became trivially
+        UNSAT."""
+        import numpy as np
+
+        buf = np.ascontiguousarray(flat, dtype=np.int32)
+        return int(
+            self._lib.cdcl_add_clauses(
+                self._handle,
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                buf.size,
+            )
+        )
+
     def model_value(self, variable: int) -> bool:
         return self._lib.cdcl_model_value(self._handle, variable) > 0
 
     def model(self, variables: Sequence[int]) -> List[bool]:
         return [self.model_value(v) for v in variables]
 
-    def set_relevant(self, variables: Sequence[int]) -> None:
+    def model_array(self, count: Optional[int] = None):
+        """Whole model as an int8 numpy vector indexed by var (1 true /
+        -1 false / 0 unset); replaces per-bit ctypes calls."""
+        import numpy as np
+
+        n = (self.num_vars + 1) if count is None else count
+        out = np.empty(n, dtype=np.int8)
+        self._lib.cdcl_model_into(
+            self._handle,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+            n,
+        )
+        return out
+
+    def set_relevant(self, variables) -> None:
         """Restrict decisions to the given variables (the query's cone);
         pass an empty sequence to lift the restriction.  See the C++
         soundness note on Solver::set_relevant."""
-        arr = (ctypes.c_int32 * len(variables))(*variables)
-        self._lib.cdcl_set_relevant(self._handle, arr, len(variables))
+        import numpy as np
+
+        buf = np.fromiter(variables, dtype=np.int32) if not isinstance(
+            variables, np.ndarray
+        ) else np.ascontiguousarray(variables, dtype=np.int32)
+        self._lib.cdcl_set_relevant(
+            self._handle,
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            buf.size,
+        )
 
     def learnt_clauses(
         self, max_width: int = 8, from_index: int = 0, cap: int = 1 << 18

@@ -173,6 +173,12 @@ class Solver {
       if (heap_pos_[v] == -1) heap_insert(v);
     }
     stash_.clear();
+    // the decision restriction is one-shot: callers issue set_relevant
+    // immediately before each solve; letting it persist would silently
+    // run later direct solves under a stale foreign query's cone (and
+    // its early all-relevant-assigned SAT return would be unsound for
+    // them)
+    restricted_ = false;
     // keep the trail: the next call reuses the matching prefix
     return status;
   }
@@ -590,7 +596,8 @@ class Solver {
         while (!heap_.empty()) {
           Var cand = heap_pop();
           if (assigns_[cand] != 0) continue;
-          if (restricted_ && !relevant_[cand]) {
+          if (restricted_ &&
+              ((size_t)cand >= relevant_.size() || !relevant_[cand])) {
             stash_.push_back(cand);
             continue;
           }
@@ -620,6 +627,36 @@ int32_t cdcl_add_clause(void* s, const int32_t* lits, int32_t n) {
 int32_t cdcl_solve(void* s, const int32_t* assumps, int32_t n,
                    int64_t conflict_budget, double time_budget_s) {
   return ((Solver*)s)->solve(assumps, n, conflict_budget, time_budget_s);
+}
+// Bulk clause load: `flat` holds clauses separated by 0 terminators.
+// Returns the number of clauses consumed; negative if any clause made
+// the database trivially UNSAT (magnitude still counts consumed).
+int64_t cdcl_add_clauses(void* s, const int32_t* flat, int64_t n) {
+  Solver* sv = (Solver*)s;
+  vector<Lit> cur;
+  int64_t added = 0;
+  bool ok = true;
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t l = flat[i];
+    if (l == 0) {
+      if (!sv->add_clause(cur)) ok = false;
+      cur.clear();
+      ++added;
+    } else {
+      cur.push_back(l);
+    }
+  }
+  if (!cur.empty()) {
+    if (!sv->add_clause(cur)) ok = false;
+    ++added;
+  }
+  return ok ? added : -added;
+}
+// Bulk model read: out[v] = truth of var v (1 true / -1 false / 0 unset)
+// for v in [0, n).  One call replaces n ctypes round-trips.
+void cdcl_model_into(void* s, int8_t* out, int32_t n) {
+  Solver* sv = (Solver*)s;
+  for (int32_t v = 0; v < n; ++v) out[v] = (int8_t)sv->model_value(v);
 }
 int32_t cdcl_model_value(void* s, int32_t var) {
   return ((Solver*)s)->model_value(var);

@@ -38,6 +38,7 @@ PROPAGATE_ITERS = 256  # BCP fixpoint cap per decision round
 DECISION_ROUNDS = 24  # probing depth before handing the lane to CDCL
 MAX_GATHER_CLAUSES = 8192  # beyond this the full-pool gather probe loses
 MAX_GATHER_VARS = 8192     # to the CDCL tail outright (see check_assumption_sets)
+MAX_LEARNT_EXEMPTION = 8192  # absorbed-learnt budget exemption cap
 
 
 class DispatchStats:
@@ -273,6 +274,7 @@ class BatchedSatBackend:
 
     def __init__(self):
         self.pool = DevicePool()
+        self.pool_generation = -1  # BlastContext.generation of the pool
         self._step_cache: Dict[int, object] = {}
         self._seed = 0
         # True iff the last check_assumption_sets actually ran a device
@@ -309,30 +311,43 @@ class BatchedSatBackend:
         from mythril_tpu.ops.device_health import device_ok
 
         num_vars = ctx.solver.num_vars
+        if num_vars > MAX_GATHER_VARS or not device_ok():
+            self.last_assignments = np.zeros(
+                (len(assumption_sets), num_vars + 1), np.int8
+            )
+            return [None] * len(assumption_sets)
+        # fold clauses the CDCL tail learned since the last refresh into
+        # the pool mirror BEFORE the budget check, so the count the gate
+        # sees is the count the kernel will actually scan
+        ctx.absorb_learnts(max_width=MAX_CLAUSE_WIDTH)
         # The gather probe scans the WHOLE pool per BCP iteration; past a
         # few thousand clauses it costs orders of magnitude more than the
         # incremental CDCL it is trying to save (measured: ~45 s/dispatch
         # at 76k clauses vs ~ms per CDCL query).  Big-cone lanes go
-        # straight to the CDCL tail.  Absorbed learnt clauses don't count
-        # against the budget — sharing them must not shut the device off.
-        base_clauses = len(ctx.clauses_py) - getattr(
-            ctx, "absorbed_learnt_count", 0
+        # straight to the CDCL tail.  Absorbed learnt clauses get a
+        # bounded budget exemption — sharing them must not shut the
+        # device off, but an unbounded exemption would let the total
+        # pool (which the kernel scans in full) regrow the pathology.
+        absorbed = min(
+            getattr(ctx, "absorbed_learnt_count", 0), MAX_LEARNT_EXEMPTION
         )
-        if (
-            base_clauses > MAX_GATHER_CLAUSES
-            or num_vars > MAX_GATHER_VARS
-            or not device_ok()
-        ):
+        base_clauses = len(ctx.clauses_py) - absorbed
+        if base_clauses > MAX_GATHER_CLAUSES:
             self.last_assignments = np.zeros(
                 (len(assumption_sets), num_vars + 1), np.int8
             )
             return [None] * len(assumption_sets)
 
         jax, jnp = _require_jax()
-        # fold clauses the CDCL tail learned since the last refresh into
-        # the pool mirror before shipping it to the device
-        ctx.absorb_learnts(max_width=MAX_CLAUSE_WIDTH)
-        if self.pool.version != ctx.pool_version or (
+        if self.pool_generation != ctx.generation:
+            # a new BlastContext (reset between analyses): the resident
+            # pool describes a different formula — appending would graft
+            # the new clauses onto it at stale offsets and make device
+            # UNSAT verdicts unsound, so always rebuild from scratch
+            self.pool.refresh(ctx.clauses_py, num_vars)
+            self.pool.version = ctx.pool_version
+            self.pool_generation = ctx.generation
+        elif self.pool.version != ctx.pool_version or (
             self.pool.num_vars < num_vars
         ):
             # delta append into the existing buckets when possible; full
@@ -539,41 +554,7 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
 
 
 def _env_from_assignment(ctx, assignment: np.ndarray):
-    """Build an EvalEnv from a device assignment vector (mirrors
-    BlastContext._extract_model but reads array values)."""
-    from mythril_tpu.smt import terms as T
-
-    def bit_of(lit: int) -> int:
-        if lit == 1:
-            return 1
-        if lit == -1:
-            return 0
-        value = assignment[abs(lit)] if abs(lit) < len(assignment) else 0
-        bit = 1 if value > 0 else 0
-        return bit if lit > 0 else 1 - bit
-
-    env = T.EvalEnv()
-    for node_id, bits in ctx.var_bits.items():
-        value = 0
-        for i, lit in enumerate(bits):
-            value |= bit_of(lit) << i
-        env.variables[node_id] = value
-    for node_id, lit in ctx.bool_var_lits.items():
-        env.variables[node_id] = bool(bit_of(lit))
-    for _ in range(3):
-        for base_id, reads in ctx.array_reads.items():
-            table = env.arrays.setdefault(base_id, {})
-            for idx_node, bits in reads:
-                idx_val = T.evaluate(idx_node, env)
-                value = 0
-                for i, lit in enumerate(bits):
-                    value |= bit_of(lit) << i
-                table[idx_val] = value
-        for func_id, apps in ctx.uf_apps.items():
-            for args, bits in apps:
-                arg_vals = tuple(T.evaluate(a, env) for a in args)
-                value = 0
-                for i, lit in enumerate(bits):
-                    value |= bit_of(lit) << i
-                env.ufs[(func_id, arg_vals)] = value
-    return env
+    """Build an EvalEnv from a device assignment vector — one
+    vectorized decode shared with the native-model path
+    (BlastContext.extract_env)."""
+    return ctx.extract_env(assignment)

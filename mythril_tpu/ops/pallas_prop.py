@@ -483,7 +483,7 @@ class PallasSatBackend:
         all_lits = sorted({l for lits in assumption_sets for l in lits})
         clause_idx, cone_vars = ctx.cone(all_lits)
         remap = {1: 1}
-        for var in sorted(cone_vars):
+        for var in cone_vars.tolist():  # already sorted
             if var not in remap:
                 remap[var] = len(remap) + 1
         for lits in assumption_sets:

@@ -21,6 +21,8 @@ Bit order convention: bits[0] is the LSB.  Literal 1 is constant TRUE
 import logging
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from mythril_tpu.native import SatSolver
 from mythril_tpu.smt import terms as T
 
@@ -29,13 +31,73 @@ log = logging.getLogger(__name__)
 TRUE_LIT = 1
 FALSE_LIT = -1
 
+# probe-memo entry cap (SAT entries pin whole EvalEnvs; see
+# probe_with_memo) — oldest quarter is evicted when full
+PROBE_MEMO_CAP = 16384
+
+# powers of two for vectorized bit packing (64-bit limbs)
+_POW2_64 = np.uint64(1) << np.arange(64, dtype=np.uint64)
+
+
+def pack_lit_words(lits_matrix: np.ndarray, truth: np.ndarray) -> np.ndarray:
+    """Decode a [rows, bits] literal matrix against a var-indexed truth
+    vector (>0 = true) into per-row uint64 limb words [rows, bits/64].
+
+    Encodes the ``bit_of`` contract in one vector pass: literal 1 is
+    constant TRUE, -1 constant FALSE, negative literals invert, and
+    variables outside ``truth`` read as false.  Pad rows with FALSE_LIT
+    (-1); padding decodes to 0 bits.
+    """
+    a = np.abs(lits_matrix)
+    in_range = a < len(truth)
+    vals = truth[np.minimum(a, len(truth) - 1)] > 0
+    vals &= in_range
+    vals |= a == 1  # constant TRUE/FALSE anchor: value true, sign decides
+    bits = vals ^ (lits_matrix < 0)
+    rows, nbits = bits.shape
+    pad = (-nbits) % 64
+    if pad:
+        bits = np.concatenate(
+            [bits, np.zeros((rows, pad), dtype=bool)], axis=1
+        )
+    return bits.reshape(rows, -1, 64).astype(np.uint64) @ _POW2_64
+
+
+def words_to_int(words: np.ndarray) -> int:
+    value = 0
+    for limb_index in range(len(words)):
+        value |= int(words[limb_index]) << (64 * limb_index)
+    return value
+
+
+def _truth_bit(lit: int, truth: np.ndarray) -> bool:
+    """Scalar ``bit_of``: literal 1/-1 are constants, out-of-range vars
+    read false, negative literals invert."""
+    if lit == TRUE_LIT:
+        return True
+    if lit == FALSE_LIT:
+        return False
+    var = abs(lit)
+    value = bool(truth[var] > 0) if var < len(truth) else False
+    return value if lit > 0 else not value
+
 
 def _const_bits(value: int, width: int) -> List[int]:
     return [TRUE_LIT if (value >> i) & 1 else FALSE_LIT for i in range(width)]
 
 
+_CTX_GENERATION = 0
+
+
 class BlastContext:
     def __init__(self):
+        # process-unique id: device pools owned by process-global
+        # backends key their uploaded clause mirror to this, so a new
+        # context (reset_blast_context) can never be grafted onto an
+        # older context's pool
+        global _CTX_GENERATION
+        _CTX_GENERATION += 1
+        self.generation = _CTX_GENERATION
         self.solver = SatSolver()
         # host-side mirror of the clause pool for the batched TPU backend
         # (the native solver owns its own copy); list of literal tuples
@@ -55,7 +117,7 @@ class BlastContext:
         # walk, orders of magnitude cheaper than a CDCL search
         self.recent_models: List[T.EvalEnv] = []
         self._freevar_cache: Dict[int, frozenset] = {}
-        self._cone_cache: Dict[int, Tuple[frozenset, frozenset]] = {}
+        self._cone_cache: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         self._learnt_cursor = 0  # native clause index already absorbed
         self.absorbed_learnt_count = 0  # learnts folded into clauses_py
         # probe memo: constraint-set key -> EvalEnv (SAT verdicts are
@@ -64,6 +126,19 @@ class BlastContext:
         # frontier pass and the per-query CDCL tail
         self.probe_memo: Dict[Tuple[int, ...], object] = {}
         self.model_version = 0
+        # clauses are mirrored into the native solver lazily: _clause
+        # appends to a flat 0-separated literal buffer and check() ships
+        # the whole batch in one ctypes crossing (add_clauses_flat) —
+        # per-clause crossings were ~8% of corpus wall time
+        self._pending_flat: List[int] = []
+        # native model snapshot (int8, var-indexed) for the last SAT
+        # verdict; lets model extraction run vectorized instead of one
+        # ctypes call per bit
+        self._model_arr: Optional[np.ndarray] = None
+        # var_bits lowered to a padded literal matrix for vectorized
+        # model extraction; rebuilt when var_bits grows
+        self._var_matrix_cache = None
+        self._bits_np: Dict[int, np.ndarray] = {}  # id(bits) -> np lits
         # defining-cone index: var -> indices of the clauses that define
         # it.  By construction (Tseitin), the defined gate is the
         # youngest variable in its defining clauses, so the default
@@ -77,8 +152,19 @@ class BlastContext:
     # gates
     # ------------------------------------------------------------------
 
+    def flush_native(self) -> None:
+        """Ship buffered clauses to the native solver in one bulk ctypes
+        crossing.  Must run before every native solve; the device/mirror
+        paths read ``clauses_py`` directly and need no flush."""
+        if not self._pending_flat:
+            return
+        flat = np.array(self._pending_flat, dtype=np.int32)
+        self._pending_flat.clear()
+        self.solver.add_clauses_flat(flat)
+
     def _clause(self, lits: Sequence[int], owners: Sequence[int] = ()) -> None:
-        self.solver.add_clause(lits)
+        self._pending_flat.extend(lits)
+        self._pending_flat.append(0)
         index = len(self.clauses_py)
         self.clauses_py.append(tuple(lits))
         owner = max((abs(l) for l in lits), default=0)
@@ -102,12 +188,14 @@ class BlastContext:
 
         Per-root cones are memoized: a stale cached cone (late congruence
         clauses can attach to already-walked vars) is a clause *subset* —
-        still sound for UNSAT, at worst weaker at propagation.  This
-        turns the per-dispatch cost from a full pool walk into a union of
-        cached frozensets.
+        still sound for UNSAT, at worst weaker at propagation.  Cached
+        cones are sorted int64 arrays; per-call union is one
+        concatenate+unique pass instead of large frozenset unions.
+
+        Returns (clause_indices, vars) as sorted numpy int64 arrays.
         """
-        clause_set = set()
-        var_set = set()
+        clause_parts = []
+        var_parts = []
         for root in root_lits:
             var = abs(root)
             if var <= 1:
@@ -116,13 +204,21 @@ class BlastContext:
             if cached is None:
                 cached = self._cone_of_var(var)
                 self._cone_cache[var] = cached
-            clause_set |= cached[0]
-            var_set |= cached[1]
-        return sorted(clause_set), var_set
+            clause_parts.append(cached[0])
+            var_parts.append(cached[1])
+        if not clause_parts:
+            empty = np.empty(0, dtype=np.int64)
+            return empty, empty
+        if len(clause_parts) == 1:
+            return clause_parts[0], var_parts[0]
+        return (
+            np.unique(np.concatenate(clause_parts)),
+            np.unique(np.concatenate(var_parts)),
+        )
 
     def _cone_of_var(self, root_var: int):
-        """Uncached single-root cone walk; returns (frozenset of clause
-        indices, frozenset of vars).  Reuses memoized sub-cones."""
+        """Uncached single-root cone walk; returns (clause indices,
+        vars) as sorted numpy arrays.  Reuses memoized sub-cones."""
         seen_vars = set()
         seen_clauses = set()
         stack = [root_var]
@@ -133,8 +229,8 @@ class BlastContext:
             seen_vars.add(var)
             hit = self._cone_cache.get(var)
             if hit is not None:
-                seen_clauses |= hit[0]
-                seen_vars |= hit[1]
+                seen_clauses.update(hit[0].tolist())
+                seen_vars.update(hit[1].tolist())
                 continue
             for ci in self.def_clauses.get(var, ()):
                 if ci in seen_clauses:
@@ -144,7 +240,11 @@ class BlastContext:
                     w = abs(lit)
                     if w > 1 and w not in seen_vars:
                         stack.append(w)
-        return frozenset(seen_clauses), frozenset(seen_vars)
+        clause_arr = np.fromiter(seen_clauses, dtype=np.int64, count=len(seen_clauses))
+        var_arr = np.fromiter(seen_vars, dtype=np.int64, count=len(seen_vars))
+        clause_arr.sort()
+        var_arr.sort()
+        return clause_arr, var_arr
 
     def absorb_learnts(self, max_width: int = 8) -> int:
         """Pull clauses the native CDCL has learned since the last sync
@@ -519,6 +619,9 @@ class BlastContext:
     # ------------------------------------------------------------------
 
     def blast_lit(self, node: T.Node) -> int:
+        # NOTE: generated clauses are buffered host-side; callers that
+        # hit self.solver directly afterwards (instead of going through
+        # check(), which flushes) must call flush_native() first
         cached = self.lit_cache.get(node.id)
         if cached is not None:
             return cached
@@ -592,14 +695,18 @@ class BlastContext:
         if getattr(_args, "cone_decisions", True):
             try:
                 _, cone_vars = self.cone(assumptions)
-                relevant = set(cone_vars)
-                relevant.update(abs(lit) for lit in assumptions)
-                self.solver.set_relevant(list(relevant))
+                assumption_vars = np.abs(
+                    np.fromiter(assumptions, dtype=np.int64, count=len(assumptions))
+                )
+                self.solver.set_relevant(
+                    np.union1d(cone_vars, assumption_vars).astype(np.int32)
+                )
             except Exception:  # noqa: BLE001 — optimization only
                 self.solver.set_relevant([])
         else:
             # a stale restriction from an earlier query would be unsound
             self.solver.set_relevant([])
+        self.flush_native()
         status = self.solver.solve(assumptions, conflict_budget, timeout_s)
         if status != SatSolver.SAT:
             return status, None
@@ -793,6 +900,12 @@ class BlastContext:
         if memo is not None and memo[1] == self.model_version:
             return None  # known-failed against the current model set
         env = self._probe_candidates(nodes)
+        if len(self.probe_memo) >= PROBE_MEMO_CAP:
+            # bounded: deep analyses generate an unbounded stream of
+            # unique constraint-set keys, and SAT entries pin whole
+            # EvalEnvs — evict oldest-inserted (dict preserves order)
+            for stale_key in list(self.probe_memo)[: PROBE_MEMO_CAP // 4]:
+                del self.probe_memo[stale_key]
         self.probe_memo[key] = (
             env if env is not None else (False, self.model_version)
         )
@@ -1012,38 +1125,58 @@ class BlastContext:
         del self.recent_models[keep:]
         self.model_version += 1  # expires negative batch-probe memos
 
-    def _bits_value(self, bits: List[int]) -> int:
-        value = 0
-        for i, lit in enumerate(bits):
-            if lit == TRUE_LIT:
-                bit = 1
-            elif lit == FALSE_LIT:
-                bit = 0
-            else:
-                assigned = self.solver.model_value(abs(lit))
-                bit = int(assigned if lit > 0 else not assigned)
-            value |= bit << i
-        return value
+    def _var_matrix(self):
+        """var_bits as (node_ids, FALSE_LIT-padded literal matrix);
+        rebuilt only when var_bits has grown."""
+        cached = self._var_matrix_cache
+        if cached is not None and cached[0] == len(self.var_bits):
+            return cached[1], cached[2]
+        ids = list(self.var_bits.keys())
+        width = max((len(b) for b in self.var_bits.values()), default=1)
+        mat = np.full((len(ids), width), FALSE_LIT, dtype=np.int64)
+        for row, node_id in enumerate(ids):
+            bits = self.var_bits[node_id]
+            mat[row, : len(bits)] = bits
+        self._var_matrix_cache = (len(ids), ids, mat)
+        return ids, mat
 
-    def _extract_model(self) -> T.EvalEnv:
+    def _bits_np_of(self, bits: List[int]) -> np.ndarray:
+        """Literal list -> cached np row (the lists live as long as the
+        context, so id() keys are stable)."""
+        arr = self._bits_np.get(id(bits))
+        if arr is None:
+            arr = np.fromiter(bits, dtype=np.int64, count=len(bits))
+            self._bits_np[id(bits)] = arr
+        return arr
+
+    def extract_env(self, truth: np.ndarray) -> T.EvalEnv:
+        """EvalEnv from any var-indexed truth vector (>0 = true): the
+        native model snapshot or a device assignment row.  Word
+        variables decode in one vectorized pass; array reads and UF
+        apps iterate to a (cheap) fixed point because index/arg
+        expressions may themselves contain reads."""
         env = T.EvalEnv()
-        for node_id, bits in self.var_bits.items():
-            env.variables[node_id] = self._bits_value(bits)
+        ids, mat = self._var_matrix()
+        if ids:
+            words = pack_lit_words(mat, truth)
+            for row, node_id in enumerate(ids):
+                env.variables[node_id] = words_to_int(words[row])
         for node_id, lit in self.bool_var_lits.items():
-            env.variables[node_id] = (
-                self.solver.model_value(abs(lit)) if lit > 0
-                else not self.solver.model_value(abs(lit))
-            )
-        # array reads & UF apps: index/arg expressions may themselves contain
-        # reads; iterate to a (cheap) fixed point
+            env.variables[node_id] = _truth_bit(lit, truth)
         for _ in range(3):
             for base_id, reads in self.array_reads.items():
                 table = env.arrays.setdefault(base_id, {})
                 for idx_node, bits in reads:
                     idx_val = T.evaluate(idx_node, env)
-                    table[idx_val] = self._bits_value(bits)
+                    row = pack_lit_words(self._bits_np_of(bits)[None, :], truth)
+                    table[idx_val] = words_to_int(row[0])
             for func_id, apps in self.uf_apps.items():
                 for args, bits in apps:
                     arg_vals = tuple(T.evaluate(a, env) for a in args)
-                    env.ufs[(func_id, arg_vals)] = self._bits_value(bits)
+                    row = pack_lit_words(self._bits_np_of(bits)[None, :], truth)
+                    env.ufs[(func_id, arg_vals)] = words_to_int(row[0])
         return env
+
+    def _extract_model(self) -> T.EvalEnv:
+        self._model_arr = self.solver.model_array()
+        return self.extract_env(self._model_arr)

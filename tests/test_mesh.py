@@ -77,6 +77,7 @@ def test_sharded_solve_verdicts_match_cdcl():
     mesh = build_mesh(8)
     _, status = sharded_frontier_solve(mesh, lits, assign)
 
+    ctx.flush_native()  # direct native solves bypass check()'s flush
     for i in range(6):
         verdict = ctx.solver.solve(assumption_sets[i])
         if status[i] == 2:  # sharded UNSAT must be sound

@@ -171,3 +171,38 @@ def test_wide_clauses_not_dropped():
         jnp.asarray(A0), jax.random.PRNGKey(0),
     )
     assert int(np.asarray(st)[0, 0]) == 2
+
+
+def test_pool_not_grafted_across_context_reset(monkeypatch):
+    """A process-global backend must rebuild its device pool when the
+    blast context is reset: appending the new context's clauses onto the
+    old pool at stale offsets would make device UNSAT verdicts unsound
+    (feasible paths of the new contract pruned against the old one's
+    CNF).  Forces the gather path — the dense Pallas path extracts a
+    per-call cone and has no resident pool."""
+    monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
+    from mythril_tpu.ops.batched_sat import BatchedSatBackend
+
+    backend = BatchedSatBackend()
+    ctx_a = get_blast_context()
+    lanes_a = _lane_constraints(8)
+    sets_a = [[ctx_a.blast_lit(c.raw) for c in lane] for lane in lanes_a]
+    backend.check_assumption_sets(ctx_a, sets_a)
+    assert backend.pool_generation == ctx_a.generation
+
+    reset_blast_context()
+    ctx_b = get_blast_context()
+    assert ctx_b.generation != ctx_a.generation
+    x = symbol_factory.BitVecSym("graft_x", 16)
+    lanes_b = [
+        [x == 3],  # SAT — must never be pruned as UNSAT
+        [
+            ULT(x, symbol_factory.BitVecVal(5, 16)),
+            UGT(x, symbol_factory.BitVecVal(10, 16)),
+        ],  # BCP-decidable UNSAT
+    ]
+    sets_b = [[ctx_b.blast_lit(c.raw) for c in lane] for lane in lanes_b]
+    results = backend.check_assumption_sets(ctx_b, sets_b)
+    assert backend.pool_generation == ctx_b.generation
+    assert results[0] is not False, "SAT lane pruned: pool was grafted"
+    assert results[1] is False
