@@ -56,6 +56,10 @@ class DispatchStats:
         self.undecided = 0         # lanes handed to the CDCL tail
         self.host_probe_sat = 0    # lanes decided by host word-level probing
         self.mesh_dispatches = 0   # invocations through the sharded mesh path
+        # dispatch attempts that bailed on the size caps (cone too large
+        # for the dense kernel AND pool too large for the gather probe):
+        # explains a zero dispatch count on small-contract corpora
+        self.size_bailouts = 0
 
     def as_dict(self):
         return dict(self.__dict__)
@@ -311,7 +315,13 @@ class BatchedSatBackend:
         from mythril_tpu.ops.device_health import device_ok
 
         num_vars = ctx.solver.num_vars
-        if num_vars > MAX_GATHER_VARS or not device_ok():
+        if not device_ok():
+            self.last_assignments = np.zeros(
+                (len(assumption_sets), num_vars + 1), np.int8
+            )
+            return [None] * len(assumption_sets)
+        if num_vars > MAX_GATHER_VARS:
+            dispatch_stats.size_bailouts += 1
             self.last_assignments = np.zeros(
                 (len(assumption_sets), num_vars + 1), np.int8
             )
@@ -333,6 +343,7 @@ class BatchedSatBackend:
         )
         base_clauses = len(ctx.clauses_py) - absorbed
         if base_clauses > MAX_GATHER_CLAUSES:
+            dispatch_stats.size_bailouts += 1
             self.last_assignments = np.zeros(
                 (len(assumption_sets), num_vars + 1), np.int8
             )

@@ -112,6 +112,13 @@ def backend_name() -> Optional[str]:
     return _backend_name
 
 
+def probe_completed() -> bool:
+    """True once the health probe has run (its verdict is cached); lets
+    callers consult the cheap cached verdict without risking the cold
+    first probe."""
+    return _verdict is not None
+
+
 def reset_for_tests() -> None:
     global _verdict, _backend_name
     _verdict = None

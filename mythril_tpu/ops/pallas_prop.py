@@ -456,30 +456,28 @@ class PallasSatBackend:
         self._seed = 0
 
     def available_for(self, ctx) -> bool:
-        return _use_pallas()
+        # only the cheap forced-off check: the full availability probe
+        # (device_ok/backend_name) can cold-start the TPU client, so it
+        # runs inside check_assumption_sets AFTER the host-side cone
+        # fits() gate has shown a dispatch is even possible
+        return pallas_enabled() is not False
 
     def check_assumption_sets(
         self, ctx, assumption_sets: List[List[int]]
     ) -> Optional[Tuple[List[Optional[bool]], np.ndarray]]:
         """None when the per-call cone exceeds the dense caps (the
         caller falls through to the gather backend)."""
-        import jax
-        import jax.numpy as jnp
+        from mythril_tpu.ops.device_health import probe_completed
 
-        from mythril_tpu.ops import configure_jax
-        from mythril_tpu.ops.device_health import backend_name
-
-        configure_jax()
-        # backend_name() keeps backend discovery under the health
-        # deadline (a direct jax.default_backend() here could be the
-        # process's first backend init and hang on a wedged tunnel)
-        interpret = backend_name() != "tpu"
-        batch = len(assumption_sets)
-        orig_v1 = ctx.solver.num_vars + 1
-        assignments = np.zeros((batch, orig_v1), dtype=np.int8)
-        assignments[:, 1] = 1
-
+        # once the health probe has run its verdict is cached, so the
+        # availability check is cheap — rejecting here skips the cone
+        # union + remap work on hosts where the device is known-unusable
+        if probe_completed() and not _use_pallas():
+            return None
         # host-side cone extraction over the union of all lanes' roots
+        # FIRST: the fits() verdict needs no device, and initializing
+        # the backend (a cold TPU tunnel client costs ~7 s) would be
+        # pure waste for cones the dense kernel can never take
         all_lits = sorted({l for lits in assumption_sets for l in lits})
         clause_idx, cone_vars = ctx.cone(all_lits)
         remap = {1: 1}
@@ -498,6 +496,25 @@ class PallasSatBackend:
                 len(clause_idx), num_cone_vars,
             )
             return None  # caller falls through to the gather backend
+
+        if not _use_pallas():
+            return None  # unhealthy device / CPU backend not forced
+
+        import jax
+        import jax.numpy as jnp
+
+        from mythril_tpu.ops import configure_jax
+        from mythril_tpu.ops.device_health import backend_name
+
+        configure_jax()
+        # backend_name() keeps backend discovery under the health
+        # deadline (a direct jax.default_backend() here could be the
+        # process's first backend init and hang on a wedged tunnel)
+        interpret = backend_name() != "tpu"
+        batch = len(assumption_sets)
+        orig_v1 = ctx.solver.num_vars + 1
+        assignments = np.zeros((batch, orig_v1), dtype=np.int8)
+        assignments[:, 1] = 1
 
         cone_clauses = [
             tuple(

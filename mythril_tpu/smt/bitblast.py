@@ -138,7 +138,10 @@ class BlastContext:
         # var_bits lowered to a padded literal matrix for vectorized
         # model extraction; rebuilt when var_bits grows
         self._var_matrix_cache = None
-        self._bits_np: Dict[int, np.ndarray] = {}  # id(bits) -> np lits
+        # array-read/UF rows lowered likewise (see _reads_matrix), plus
+        # a node-id cache for "contains a read/UF" nesting checks
+        self._reads_matrix_cache = None
+        self._theory_node_cache: Dict[int, bool] = {}
         # defining-cone index: var -> indices of the clauses that define
         # it.  By construction (Tseitin), the defined gate is the
         # youngest variable in its defining clauses, so the default
@@ -162,12 +165,21 @@ class BlastContext:
         self._pending_flat.clear()
         self.solver.add_clauses_flat(flat)
 
-    def _clause(self, lits: Sequence[int], owners: Sequence[int] = ()) -> None:
+    def _clause(
+        self,
+        lits: Sequence[int],
+        owners: Sequence[int] = (),
+        owner: Optional[int] = None,
+    ) -> None:
+        """``owner`` short-circuits the max-|lit| scan when the caller
+        just allocated the defined gate var (always the newest, hence
+        the max) — the scan was measurable at millions of clauses."""
         self._pending_flat.extend(lits)
         self._pending_flat.append(0)
         index = len(self.clauses_py)
         self.clauses_py.append(tuple(lits))
-        owner = max((abs(l) for l in lits), default=0)
+        if owner is None:
+            owner = max((abs(l) for l in lits), default=0)
         if owner > 1:
             self.def_clauses.setdefault(owner, []).append(index)
         for extra in owners:
@@ -176,7 +188,7 @@ class BlastContext:
         self.pool_version += 1
         self.clause_count += 1
 
-    def cone(self, root_lits: Sequence[int]):
+    def cone(self, root_lits: Sequence[int], need_clauses: bool = True):
         """(clause_indices, vars) of the defining cone of ``root_lits``.
 
         Walks defining clauses backward from the roots: every variable's
@@ -211,10 +223,11 @@ class BlastContext:
             return empty, empty
         if len(clause_parts) == 1:
             return clause_parts[0], var_parts[0]
-        return (
-            np.unique(np.concatenate(clause_parts)),
-            np.unique(np.concatenate(var_parts)),
+        clause_union = (
+            np.unique(np.concatenate(clause_parts)) if need_clauses
+            else np.empty(0, dtype=np.int64)
         )
+        return clause_union, np.unique(np.concatenate(var_parts))
 
     def _cone_of_var(self, root_var: int):
         """Uncached single-root cone walk; returns (clause indices,
@@ -286,9 +299,9 @@ class BlastContext:
         lit = self.gate_cache.get(key)
         if lit is None:
             lit = self.new_lit()
-            self._clause([-lit, a])
-            self._clause([-lit, b])
-            self._clause([lit, -a, -b])
+            self._clause([-lit, a], owner=lit)
+            self._clause([-lit, b], owner=lit)
+            self._clause([lit, -a, -b], owner=lit)
             self.gate_cache[key] = lit
         return lit
 
@@ -317,10 +330,10 @@ class BlastContext:
         lit = self.gate_cache.get(key)
         if lit is None:
             lit = self.new_lit()
-            self._clause([-lit, va, vb])
-            self._clause([-lit, -va, -vb])
-            self._clause([lit, -va, vb])
-            self._clause([lit, va, -vb])
+            self._clause([-lit, va, vb], owner=lit)
+            self._clause([-lit, -va, -vb], owner=lit)
+            self._clause([lit, -va, vb], owner=lit)
+            self._clause([lit, va, -vb], owner=lit)
             self.gate_cache[key] = lit
         return -lit if flip else lit
 
@@ -340,13 +353,13 @@ class BlastContext:
         lit = self.gate_cache.get(key)
         if lit is None:
             lit = self.new_lit()
-            self._clause([-s, -a, lit])
-            self._clause([-s, a, -lit])
-            self._clause([s, -b, lit])
-            self._clause([s, b, -lit])
+            self._clause([-s, -a, lit], owner=lit)
+            self._clause([-s, a, -lit], owner=lit)
+            self._clause([s, -b, lit], owner=lit)
+            self._clause([s, b, -lit], owner=lit)
             if a != TRUE_LIT and a != FALSE_LIT and b != TRUE_LIT and b != FALSE_LIT:
-                self._clause([-a, -b, lit])   # redundant, aids propagation
-                self._clause([a, b, -lit])
+                self._clause([-a, -b, lit], owner=lit)   # redundant, aids propagation
+                self._clause([a, b, -lit], owner=lit)
             self.gate_cache[key] = lit
         return lit
 
@@ -694,12 +707,16 @@ class BlastContext:
         # pays full-pool propagation per irrelevant decision
         if getattr(_args, "cone_decisions", True):
             try:
-                _, cone_vars = self.cone(assumptions)
+                _, cone_vars = self.cone(assumptions, need_clauses=False)
                 assumption_vars = np.abs(
                     np.fromiter(assumptions, dtype=np.int64, count=len(assumptions))
                 )
+                # no dedupe needed: set_relevant marks a membership
+                # bitmap, duplicates are harmless
                 self.solver.set_relevant(
-                    np.union1d(cone_vars, assumption_vars).astype(np.int32)
+                    np.concatenate([cone_vars, assumption_vars]).astype(
+                        np.int32
+                    )
                 )
             except Exception:  # noqa: BLE001 — optimization only
                 self.solver.set_relevant([])
@@ -896,14 +913,21 @@ class BlastContext:
         key = tuple(sorted(n.id for n in nodes))
         memo = self.probe_memo.get(key)
         if isinstance(memo, T.EvalEnv):
-            return memo  # SAT is a permanent property of the set
+            # SAT is a permanent property of the set; refresh LRU order
+            # so the hot frontier entries survive eviction
+            self.probe_memo.pop(key)
+            self.probe_memo[key] = memo
+            return memo
         if memo is not None and memo[1] == self.model_version:
             return None  # known-failed against the current model set
         env = self._probe_candidates(nodes)
-        if len(self.probe_memo) >= PROBE_MEMO_CAP:
+        if key in self.probe_memo:
+            del self.probe_memo[key]  # re-write moves the key to the end
+        elif len(self.probe_memo) >= PROBE_MEMO_CAP:
             # bounded: deep analyses generate an unbounded stream of
             # unique constraint-set keys, and SAT entries pin whole
-            # EvalEnvs — evict oldest-inserted (dict preserves order)
+            # EvalEnvs — evict least-recently-used (dict preserves
+            # insertion order; hits/re-writes reinsert at the end)
             for stale_key in list(self.probe_memo)[: PROBE_MEMO_CAP // 4]:
                 del self.probe_memo[stale_key]
         self.probe_memo[key] = (
@@ -1140,21 +1164,75 @@ class BlastContext:
         self._var_matrix_cache = (len(ids), ids, mat)
         return ids, mat
 
-    def _bits_np_of(self, bits: List[int]) -> np.ndarray:
-        """Literal list -> cached np row (the lists live as long as the
-        context, so id() keys are stable)."""
-        arr = self._bits_np.get(id(bits))
-        if arr is None:
-            arr = np.fromiter(bits, dtype=np.int64, count=len(bits))
-            self._bits_np[id(bits)] = arr
-        return arr
+    def _reads_matrix(self):
+        """Array reads + UF apps lowered to one padded literal matrix:
+        (entries, matrix, rounds) where entries[i] describes matrix row
+        i as ("read", base_id, idx_node) or ("app", func_id, args), and
+        rounds is 1 when no index/arg expression nests another read or
+        UF (the common case) else 3.  Rebuilt when registrations grow."""
+        count = sum(len(r) for r in self.array_reads.values()) + sum(
+            len(a) for a in self.uf_apps.values()
+        )
+        cached = getattr(self, "_reads_matrix_cache", None)
+        if cached is not None and cached[0] == count:
+            return cached[1], cached[2], cached[3]
+        entries = []
+        rows = []
+        nested = False
+        for base_id, reads in self.array_reads.items():
+            for idx_node, bits in reads:
+                entries.append(("read", base_id, idx_node))
+                rows.append(bits)
+                nested = nested or self._has_theory_node(idx_node)
+        for func_id, apps in self.uf_apps.items():
+            for args, bits in apps:
+                entries.append(("app", func_id, args))
+                rows.append(bits)
+                nested = nested or any(
+                    self._has_theory_node(a) for a in args
+                )
+        width = max((len(b) for b in rows), default=1)
+        mat = np.full((len(rows), width), FALSE_LIT, dtype=np.int64)
+        for row_index, bits in enumerate(rows):
+            mat[row_index, : len(bits)] = bits
+        rounds = 3 if nested else 1
+        self._reads_matrix_cache = (count, entries, mat, rounds)
+        return entries, mat, rounds
+
+    def _has_theory_node(self, node: T.Node) -> bool:
+        """True when the DAG under ``node`` contains an array read or a
+        UF application (their valuation depends on the env tables, so
+        dependents need extra fixed-point rounds).  Cached by node id."""
+        cache = self._theory_node_cache
+        hit = cache.get(node.id)
+        if hit is not None:
+            return hit
+        stack = [node]
+        seen = set()
+        found = False
+        while stack and not found:
+            n = stack.pop()
+            if n.id in seen:
+                continue
+            seen.add(n.id)
+            sub = cache.get(n.id)
+            if sub is not None:
+                found = found or sub
+                continue
+            if n.op in ("select", "apply"):
+                found = True
+                break
+            stack.extend(n.args)
+        cache[node.id] = found
+        return found
 
     def extract_env(self, truth: np.ndarray) -> T.EvalEnv:
         """EvalEnv from any var-indexed truth vector (>0 = true): the
         native model snapshot or a device assignment row.  Word
-        variables decode in one vectorized pass; array reads and UF
-        apps iterate to a (cheap) fixed point because index/arg
-        expressions may themselves contain reads."""
+        variables and all read/UF result words decode in one vectorized
+        pass each; the remaining per-entry work is only evaluating the
+        index/arg expressions, iterated to a fixed point when those
+        expressions nest other reads."""
         env = T.EvalEnv()
         ids, mat = self._var_matrix()
         if ids:
@@ -1163,18 +1241,21 @@ class BlastContext:
                 env.variables[node_id] = words_to_int(words[row])
         for node_id, lit in self.bool_var_lits.items():
             env.variables[node_id] = _truth_bit(lit, truth)
-        for _ in range(3):
-            for base_id, reads in self.array_reads.items():
-                table = env.arrays.setdefault(base_id, {})
-                for idx_node, bits in reads:
-                    idx_val = T.evaluate(idx_node, env)
-                    row = pack_lit_words(self._bits_np_of(bits)[None, :], truth)
-                    table[idx_val] = words_to_int(row[0])
-            for func_id, apps in self.uf_apps.items():
-                for args, bits in apps:
-                    arg_vals = tuple(T.evaluate(a, env) for a in args)
-                    row = pack_lit_words(self._bits_np_of(bits)[None, :], truth)
-                    env.ufs[(func_id, arg_vals)] = words_to_int(row[0])
+        entries, reads_mat, rounds = self._reads_matrix()
+        if not entries:
+            return env
+        read_words = pack_lit_words(reads_mat, truth)
+        values = [words_to_int(read_words[i]) for i in range(len(entries))]
+        for _ in range(rounds):
+            for (kind, owner_id, key_node), value in zip(entries, values):
+                if kind == "read":
+                    table = env.arrays.setdefault(owner_id, {})
+                    table[T.evaluate(key_node, env)] = value
+                else:
+                    arg_vals = tuple(
+                        T.evaluate(a, env) for a in key_node
+                    )
+                    env.ufs[(owner_id, arg_vals)] = value
         return env
 
     def _extract_model(self) -> T.EvalEnv:
