@@ -98,6 +98,74 @@ def _full_corpus():
     return cases + _corpus()
 
 
+def scale_contract(depth: int = 6, guard_bits: int = 16) -> str:
+    """Wide-frontier stressor: a binary selector-bit dispatch tree whose
+    live frontier doubles per level (2**depth leaves in lockstep), then
+    per-leaf guards fork again.  This is the workload shape the batched
+    device solver exists for (SURVEY §2.16 north star: thousands of
+    forked world-states in lockstep); the linear dispatcher chains of
+    real small contracts keep the frontier ~6 wide, which is why corpus
+    telemetry shows host-probe + CDCL doing the work there.
+
+    Leaves mix BCP-decidable dead paths (a low-bit equality
+    contradicting the tree prefix), probe-resistant ADD-guards over a
+    masked calldata word, and SWC-106 suicide leaves (the findings
+    oracle).  The union cone of a full-width round measures ~10k
+    clauses / ~3k vars — inside the TPU dense tier, outside the
+    CPU-interpret tier (ops/pallas_prop.py caps), so device dispatch
+    telemetry on this scenario directly reflects TPU availability.
+    """
+    from mythril_tpu.support.assembler import asm
+
+    mask = (1 << guard_bits) - 1
+    lines = ["PUSH 0; CALLDATALOAD; PUSH 0xe0; SHR", "PUSH @nE; JUMP"]
+
+    def node_label(prefix):
+        return "n" + (prefix or "E")
+
+    prefixes = [""]
+    for level in range(depth):
+        grown = []
+        for prefix in prefixes:
+            lines.append(f"{node_label(prefix)}:")
+            lines.append("JUMPDEST")
+            lines.append(
+                f"DUP1; PUSH {1 << level}; AND; "
+                f"PUSH @{node_label(prefix + '1')}; JUMPI"
+            )
+            lines.append(f"PUSH @{node_label(prefix + '0')}; JUMP")
+            grown += [prefix + "0", prefix + "1"]
+        prefixes = grown
+    for i, prefix in enumerate(prefixes):
+        value = int(prefix[::-1], 2)
+        lines.append(f"{node_label(prefix)}:")
+        lines.append("JUMPDEST")
+        if i % 4 == 1:
+            # dead path: low-2-bit equality contradicting the tree bits
+            wrong = ((value & 3) + 1) & 3
+            lines.append(
+                f"DUP1; PUSH 3; AND; PUSH {wrong}; EQ; PUSH @ok{i}; JUMPI"
+            )
+            lines.append("PUSH 0; PUSH 0; REVERT")
+            lines.append(f"ok{i}:")
+            lines.append("JUMPDEST; PUSH 1; PUSH 0; SSTORE; STOP")
+        else:
+            addend = (0x1234 + 7919 * i) & mask
+            target = (0x6D2B + 104729 * i) & mask
+            lines.append(
+                f"PUSH 4; CALLDATALOAD; PUSH {mask}; AND; "
+                f"PUSH {addend}; ADD; PUSH {mask}; AND; "
+                f"PUSH {target}; EQ; PUSH @ok{i}; JUMPI"
+            )
+            lines.append("PUSH 0; PUSH 0; REVERT")
+            lines.append(f"ok{i}:")
+            if i % 16 == 6:
+                lines.append("JUMPDEST; CALLER; SUICIDE")
+            else:
+                lines.append(f"JUMPDEST; PUSH 1; PUSH {i}; SSTORE; STOP")
+    return asm("\n".join(lines))
+
+
 # Ablation modes (VERDICT r1 #3: the speedup must be attributable).
 # Select with --mode or MYTHRIL_BENCH_MODE; --all-modes runs every mode
 # and prints a per-mode summary to stderr (stdout stays one JSON line).
@@ -168,6 +236,61 @@ def _run_corpus(mode: str):
     return time.time() - begin, rows, missed
 
 
+def _run_scale(mode: str):
+    """One pass over the wide-frontier scale scenario; returns a
+    telemetry row.  The findings oracle (SWC-106 suicide leaves) is
+    enforced like the corpus contracts."""
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+    from mythril_tpu.analysis.security import fire_lasers
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.laser.ethereum.time_handler import time_handler
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+    from mythril_tpu.smt.solver import SolverStatistics, reset_blast_context
+    from mythril_tpu.solidity.evmcontract import EVMContract
+    from mythril_tpu.support.model import clear_model_cache
+    from mythril_tpu.support.support_args import args
+
+    for key, value in MODES[mode].items():
+        setattr(args, key, value)
+    saved_width = args.batch_width
+    args.batch_width = 128  # let the scheduler feed the full frontier
+    try:
+        reset_blast_context()
+        clear_model_cache()
+        for module in ModuleLoader().get_detection_modules():
+            module.reset_module()
+            module.cache.clear()
+        dispatch_stats.reset()
+        stats = SolverStatistics()
+        stats.enabled = True
+        stats.reset()
+        contract = EVMContract(code=scale_contract(depth=5), name="scale")
+        time_handler.start_execution(90)
+        t0 = time.time()
+        sym = SymExecWrapper(
+            contract,
+            address=0x901D12EBE1B195E5AA8748E62BD7734AE19B51F,
+            strategy="bfs",
+            max_depth=512,
+            execution_timeout=90,
+            create_timeout=10,
+            transaction_count=1,
+        )
+        issues = fire_lasers(sym)
+        found = {i.swc_id for i in issues}
+        return {
+            "contract": "scale",
+            "wall_s": round(time.time() - t0, 2),
+            "tx_count": 1,
+            "found": sorted(found),
+            "queries": stats.query_count,
+            "solver_s": round(stats.solver_time, 2),
+            **dispatch_stats.as_dict(),
+        }
+    finally:
+        args.batch_width = saved_width
+
+
 def main() -> None:
     import logging
 
@@ -195,6 +318,14 @@ def main() -> None:
         if missed:
             print(f"MISSED: {missed}", file=sys.stderr)
 
+    # wide-frontier scale scenario (device-dispatch telemetry; skipped
+    # with --no-scale for corpus-only timing runs)
+    scale_row = None
+    if "--no-scale" not in argv:
+        scale_row = _run_scale(mode)
+        print(f"--- scale scenario (mode={mode}) ---", file=sys.stderr)
+        print(json.dumps(scale_row), file=sys.stderr)
+
     wall, rows, missed = results[mode]
     summary = {
         "metric": "analyze_corpus_wall_s",
@@ -218,6 +349,20 @@ def main() -> None:
         summary["ablation_wall_s"] = {
             m: round(results[m][0], 2) for m in results
         }
+    if scale_row is not None:
+        summary["scale_wall_s"] = scale_row["wall_s"]
+        summary["scale_dispatches"] = scale_row["dispatches"]
+        summary["scale_device_lanes"] = scale_row["lanes"]
+        summary["scale_device_unsat"] = scale_row["unsat"]
+        summary["scale_sat_verified"] = scale_row["sat_verified"]
+        summary["scale_size_bailouts"] = scale_row["size_bailouts"]
+        summary["scale_fused"] = scale_row.get("fused", False)
+        # telemetry scenario, not the detection oracle: a miss (e.g. a
+        # timeout on a degraded device path) is recorded, not fatal
+        if "106" not in scale_row["found"]:
+            summary["scale_error"] = (
+                f"scale scenario missed SWC-106 (found {scale_row['found']})"
+            )
     if missed:
         summary["vs_baseline"] = 0.0
         summary["error"] = f"missed findings: {missed}"

@@ -39,6 +39,8 @@ DECISION_ROUNDS = 24  # probing depth before handing the lane to CDCL
 MAX_GATHER_CLAUSES = 8192  # beyond this the full-pool gather probe loses
 MAX_GATHER_VARS = 8192     # to the CDCL tail outright (see check_assumption_sets)
 MAX_LEARNT_EXEMPTION = 8192  # absorbed-learnt budget exemption cap
+FUTILE_DISPATCH_FUSE = 3   # consecutive zero-decision dispatches before
+                           # the device is skipped for the context
 
 
 class DispatchStats:
@@ -60,6 +62,9 @@ class DispatchStats:
         # for the dense kernel AND pool too large for the gather probe):
         # explains a zero dispatch count on small-contract corpora
         self.size_bailouts = 0
+        # True when the adaptive fuse disabled device dispatch for a
+        # context after FUTILE_DISPATCH_FUSE zero-decision dispatches
+        self.fused = False
 
     def as_dict(self):
         return dict(self.__dict__)
@@ -281,13 +286,21 @@ class BatchedSatBackend:
         self.pool_generation = -1  # BlastContext.generation of the pool
         self._step_cache: Dict[int, object] = {}
         self._seed = 0
+        # adaptive fuse: consecutive engaged dispatches that decided
+        # zero lanes; past the threshold the device is skipped for the
+        # rest of this blast context (paying kernel-dispatch latency
+        # for nothing but CDCL-tail work is strictly worse than going
+        # to the tail directly)
+        self.futile_dispatches = 0
+        self.futile_ctx_generation = -1
+        self.fused_generation = -1
         # True iff the last check_assumption_sets actually ran a device
         # (or interpret-mode kernel) pass — telemetry keys off this so
         # bail-outs don't inflate the attribution counters
         self.device_engaged = False
 
     def check_assumption_sets(
-        self, ctx, assumption_sets: List[List[int]]
+        self, ctx, assumption_sets: List[List[int]], walksat: bool = True
     ) -> List[Optional[bool]]:
         """For each assumption set over ctx's clause pool return
         True (verified SAT candidate assignment), False (sound UNSAT), or
@@ -296,6 +309,8 @@ class BatchedSatBackend:
         The returned SAT verdicts are *candidates*: the caller must
         verify the model against the original constraints (we only
         guarantee consistency with the device-resident clause subset).
+        ``walksat=False`` keeps dense dispatches BCP-only (see
+        PallasSatBackend.check_assumption_sets).
         """
         from mythril_tpu.ops.pallas_prop import get_pallas_backend
 
@@ -305,7 +320,9 @@ class BatchedSatBackend:
             # fused MXU kernels over the per-call cone: dense incidence
             # matmuls, BCP + WalkSAT, no clause-width cap.  None means
             # the cone exceeded the dense caps — gather path below.
-            dense = pallas.check_assumption_sets(ctx, assumption_sets)
+            dense = pallas.check_assumption_sets(
+                ctx, assumption_sets, walksat=walksat
+            )
             if dense is not None:
                 results, assignments = dense
                 self.last_assignments = assignments
@@ -522,8 +539,19 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         lane_of.append(lane)
 
     backend = get_backend()
+    if backend.futile_ctx_generation != ctx.generation:
+        backend.futile_ctx_generation = ctx.generation
+        backend.futile_dispatches = 0
+        dispatch_stats.fused = False  # stat mirrors the re-armed fuse
+    if backend.fused_generation == ctx.generation:
+        # adaptive fuse blown: earlier dispatches in this context kept
+        # deciding nothing, so the frontier goes straight to the tail
+        return decided
+    # BCP-only: the host probe above already harvested every lane its
+    # candidate models could satisfy, so device WalkSAT sweeps would
+    # retry what just failed — batched conflict detection is the win
     verdicts = backend.check_assumption_sets(
-        ctx, [assumption_sets[i] for i in rep_indices]
+        ctx, [assumption_sets[i] for i in rep_indices], walksat=False
     )
     # attribution counters tally only real device (or interpret-mode
     # kernel) passes — a bail-out to the CDCL tail is not a dispatch
@@ -534,6 +562,7 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
 
     counted_lanes = set()  # per-verdict counters tally device lanes,
     # not original states (several states can share one deduped lane)
+    device_decided = 0  # lanes THIS dispatch decided (fuse accounting)
     for pos, i in enumerate(open_indices):
         lane = lane_of[pos]
         first_for_lane = engaged and lane not in counted_lanes
@@ -543,6 +572,7 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
             decided[i] = False
             if first_for_lane:
                 dispatch_stats.unsat += 1
+                device_decided += 1
             continue
         # candidate lane: verify the (possibly partial) assignment by
         # evaluating the original terms; unassigned leaves default 0
@@ -559,8 +589,26 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         if first_for_lane:
             if ok:
                 dispatch_stats.sat_verified += 1
+                device_decided += 1
             else:
                 dispatch_stats.undecided += 1
+    if engaged:
+        # adaptive fuse accounting: a dispatch "paid off" iff it decided
+        # at least one lane (device UNSAT, or a device model that
+        # host-verified).  Consecutive zero-yield dispatches mean the
+        # workload shape is wrong for the device — stop paying kernel
+        # latency for it in this context.
+        if device_decided:
+            backend.futile_dispatches = 0
+        else:
+            backend.futile_dispatches += 1
+            if backend.futile_dispatches >= FUTILE_DISPATCH_FUSE:
+                backend.fused_generation = ctx.generation
+                dispatch_stats.fused = True
+                log.info(
+                    "device dispatch fused off: %d consecutive "
+                    "zero-decision dispatches", backend.futile_dispatches,
+                )
     return decided
 
 

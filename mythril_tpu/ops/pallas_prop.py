@@ -61,10 +61,23 @@ import numpy as np
 log = logging.getLogger(__name__)
 
 # Per-call dense cone caps: V and C are bucketed powers of two; the
-# four bf16 incidence matrices cost 8*C*V bytes of HBM.
+# four bf16 incidence matrices cost 8*C*V bytes of HBM.  Two tiers:
+# the small tier is what CPU interpret mode (tests, degraded hosts)
+# can chew through; a real TPU gets matrices sized for its HBM/MXU —
+# wide frontiers over medium cones (the lockstep north star) only fit
+# the large tier.
 MAX_VARS_DENSE = 4096
 MAX_CLAUSES_DENSE = 1 << 15
 MAX_CELLS_DENSE = 1 << 22    # 4M cells = 32 MB for the four matrices
+MAX_VARS_DENSE_TPU = 1 << 14
+MAX_CLAUSES_DENSE_TPU = 1 << 17
+MAX_CELLS_DENSE_TPU = 1 << 26  # 64M cells = 512 MB of incidence data
+# WalkSAT only pays on cones it can complete models for; the TPU tier
+# raises the var ceiling (matmul sweeps are cheap there).  NOTE: the
+# frontier pipeline dispatches BCP-only (walksat=False), so these
+# ceilings apply to direct API/test callers that ask for model search.
+WALKSAT_MAX_VARS = 1024
+WALKSAT_MAX_VARS_TPU = 8192
 MAX_LANES = 64               # per-chunk cap, further shrunk for wide V
 # the [B,V] assignment + two forced-count outputs stay VMEM-resident
 # across all grid steps; cap their f32 footprint (~12*B*V bytes)
@@ -126,9 +139,15 @@ class DenseClausePool:
         self.V = 0
 
     @staticmethod
-    def fits(num_clauses: int, num_vars: int) -> bool:
+    def fits(num_clauses: int, num_vars: int, tpu: bool = False) -> bool:
         C = _bucket(max(1, num_clauses))
         V = _bucket(num_vars + 1)
+        if tpu:
+            return (
+                C <= MAX_CLAUSES_DENSE_TPU
+                and V <= MAX_VARS_DENSE_TPU
+                and C * V <= MAX_CELLS_DENSE_TPU
+            )
         return (
             C <= MAX_CLAUSES_DENSE
             and V <= MAX_VARS_DENSE
@@ -463,10 +482,17 @@ class PallasSatBackend:
         return pallas_enabled() is not False
 
     def check_assumption_sets(
-        self, ctx, assumption_sets: List[List[int]]
+        self, ctx, assumption_sets: List[List[int]], walksat: bool = True
     ) -> Optional[Tuple[List[Optional[bool]], np.ndarray]]:
         """None when the per-call cone exceeds the dense caps (the
-        caller falls through to the gather backend)."""
+        caller falls through to the gather backend).
+
+        ``walksat=False`` runs BCP-only: the frontier pipeline passes
+        it because its lanes are pre-filtered by the host word probe —
+        the SAT lanes WalkSAT could crack are already gone, so sweeps
+        would only burn kernel time (measured: EVM-derived cones are
+        WalkSAT-resistant; batched conflict detection is where the
+        device pays)."""
         from mythril_tpu.ops.device_health import probe_completed
 
         # once the health probe has run its verdict is cached, so the
@@ -480,22 +506,25 @@ class PallasSatBackend:
         # pure waste for cones the dense kernel can never take
         all_lits = sorted({l for lits in assumption_sets for l in lits})
         clause_idx, cone_vars = ctx.cone(all_lits)
+        # size gate before paying for the remap dict: the remap is
+        # exactly anchor + cone vars (every assumption var is a cone
+        # root), and the TPU tier is the largest any backend offers —
+        # failing it here means no backend can take the dispatch, with
+        # zero backend-init cost
+        cone_var_count = 1 + len(cone_vars)
+        if not DenseClausePool.fits(len(clause_idx), cone_var_count, tpu=True):
+            log.debug(
+                "cone too large for dense kernel (%d clauses, %d vars)",
+                len(clause_idx), cone_var_count,
+            )
+            return None  # caller falls through to the gather backend
+        # every assumption var is a cone root, so the remap is exactly
+        # anchor + cone vars — the lower bound above was the exact count
         remap = {1: 1}
         for var in cone_vars.tolist():  # already sorted
             if var not in remap:
                 remap[var] = len(remap) + 1
-        for lits in assumption_sets:
-            for lit in lits:
-                if abs(lit) not in remap:
-                    remap[abs(lit)] = len(remap) + 1
         num_cone_vars = len(remap)
-
-        if not DenseClausePool.fits(len(clause_idx), num_cone_vars):
-            log.debug(
-                "cone too large for dense kernel (%d clauses, %d vars)",
-                len(clause_idx), num_cone_vars,
-            )
-            return None  # caller falls through to the gather backend
 
         if not _use_pallas():
             return None  # unhealthy device / CPU backend not forced
@@ -511,6 +540,12 @@ class PallasSatBackend:
         # deadline (a direct jax.default_backend() here could be the
         # process's first backend init and hang on a wedged tunnel)
         interpret = backend_name() != "tpu"
+        if interpret and not DenseClausePool.fits(
+            len(clause_idx), num_cone_vars, tpu=False
+        ):
+            # only a real TPU chews through the large tier; interpret
+            # mode (tests, degraded hosts) keeps the small caps
+            return None
         batch = len(assumption_sets)
         orig_v1 = ctx.solver.num_vars + 1
         assignments = np.zeros((batch, orig_v1), dtype=np.int8)
@@ -546,7 +581,8 @@ class PallasSatBackend:
             # cone clause to produce a candidate; past ~1k vars the hit
             # rate is ~0) — larger cones run BCP-only for sound UNSAT,
             # the host probe having already harvested the easy SAT lanes
-            rounds = WALK_ROUNDS if V <= 1024 else 0
+            walk_ceiling = WALKSAT_MAX_VARS if interpret else WALKSAT_MAX_VARS_TPU
+            rounds = WALK_ROUNDS if (walksat and V <= walk_ceiling) else 0
             step = make_dense_solve(pool.C, V, B, rounds, interpret)
             A, st = step(
                 pool.P, pool.N, pool.Pt, pool.Nt, pool.width,

@@ -206,3 +206,53 @@ def test_pool_not_grafted_across_context_reset(monkeypatch):
     assert backend.pool_generation == ctx_b.generation
     assert results[0] is not False, "SAT lane pruned: pool was grafted"
     assert results[1] is False
+
+
+def test_futile_dispatch_fuse(monkeypatch):
+    """Consecutive zero-decision device dispatches trip the fuse: the
+    frontier then goes straight to the CDCL tail for the rest of that
+    blast context (paying kernel latency for undecided lanes only is
+    strictly worse), and a fresh context re-arms it."""
+    monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
+    from mythril_tpu.ops import batched_sat as BS
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setattr(args, "device_min_lanes", 2)
+    monkeypatch.setattr(args, "word_probing", False)
+    backend = BS.get_backend()
+
+    # force "engaged but nothing decided" outcomes without a device:
+    # all-None verdicts with an all-zero assignment that cannot verify
+    # against the lanes below (x == i+1 is false under x = 0)
+    def fake_check(self, ctx, sets, walksat=True):
+        self.device_engaged = True
+        self.last_assignments = np.zeros(
+            (len(sets), ctx.solver.num_vars + 1), np.int8
+        )
+        return [None] * len(sets)
+
+    monkeypatch.setattr(
+        BS.BatchedSatBackend, "check_assumption_sets", fake_check
+    )
+    ctx = get_blast_context()
+    lanes = []
+    for i in range(4):
+        x = symbol_factory.BitVecSym(f"fuse_x{i}", 16)
+        lanes.append([x == i + 1])
+    sets = [[c for c in lane] for lane in lanes]
+    before = BS.dispatch_stats.dispatches
+    for _ in range(BS.FUTILE_DISPATCH_FUSE):
+        BS.batch_check_states(sets)
+    assert backend.fused_generation == ctx.generation
+    fused_count = BS.dispatch_stats.dispatches
+    BS.batch_check_states(sets)  # fused: no further dispatch
+    assert BS.dispatch_stats.dispatches == fused_count
+    assert fused_count - before == BS.FUTILE_DISPATCH_FUSE
+    assert BS.dispatch_stats.fused is True
+
+    reset_blast_context()  # new context re-arms the fuse
+    ctx2 = get_blast_context()
+    y = symbol_factory.BitVecSym("fuse_y", 16)
+    BS.batch_check_states([[y == 1], [y == 2]])
+    assert BS.dispatch_stats.dispatches == fused_count + 1
+    assert backend.fused_generation != ctx2.generation
