@@ -177,9 +177,11 @@ MODES = {
 }
 
 
-def _run_corpus(mode: str):
-    """One full corpus pass under an ablation mode; returns
-    (wall_s, rows, missed) where rows are per-contract dicts."""
+def _analyze_one(name, code, tx_count, execution_timeout, max_depth):
+    """Analyze one contract from a clean slate; returns (found_swcs,
+    telemetry_row).  Single reset sequence shared by the corpus and
+    scale passes so new caches can't get cleared in one but not the
+    other."""
     from mythril_tpu.analysis.module.loader import ModuleLoader
     from mythril_tpu.analysis.security import fire_lasers
     from mythril_tpu.analysis.symbolic import SymExecWrapper
@@ -188,6 +190,45 @@ def _run_corpus(mode: str):
     from mythril_tpu.smt.solver import SolverStatistics, reset_blast_context
     from mythril_tpu.solidity.evmcontract import EVMContract
     from mythril_tpu.support.model import clear_model_cache
+
+    reset_blast_context()
+    clear_model_cache()
+    for module in ModuleLoader().get_detection_modules():
+        module.reset_module()
+        module.cache.clear()
+    dispatch_stats.reset()
+    stats = SolverStatistics()
+    stats.enabled = True
+    stats.reset()
+    contract = EVMContract(code=code, name=name)
+    time_handler.start_execution(execution_timeout)
+    t0 = time.time()
+    sym = SymExecWrapper(
+        contract,
+        address=0x901D12EBE1B195E5AA8748E62BD7734AE19B51F,
+        strategy="bfs",
+        max_depth=max_depth,
+        execution_timeout=execution_timeout,
+        create_timeout=10,
+        transaction_count=tx_count,
+    )
+    issues = fire_lasers(sym)
+    found = {i.swc_id for i in issues}
+    row = {
+        "contract": name,
+        "wall_s": round(time.time() - t0, 2),
+        "tx_count": tx_count,
+        "found": sorted(found),
+        "queries": stats.query_count,
+        "solver_s": round(stats.solver_time, 2),
+        **dispatch_stats.as_dict(),
+    }
+    return found, row
+
+
+def _run_corpus(mode: str):
+    """One full corpus pass under an ablation mode; returns
+    (wall_s, rows, missed) where rows are per-contract dicts."""
     from mythril_tpu.support.support_args import args
 
     for key, value in MODES[mode].items():
@@ -197,57 +238,19 @@ def _run_corpus(mode: str):
     missed = []
     begin = time.time()
     for name, code, tx_count, expected_swcs in _full_corpus():
-        reset_blast_context()
-        clear_model_cache()
-        for module in ModuleLoader().get_detection_modules():
-            module.reset_module()
-            module.cache.clear()
-        dispatch_stats.reset()
-        stats = SolverStatistics()
-        stats.enabled = True
-        stats.reset()
-        contract = EVMContract(code=code, name=name)
-        time_handler.start_execution(300)
-        t0 = time.time()
-        sym = SymExecWrapper(
-            contract,
-            address=0x901D12EBE1B195E5AA8748E62BD7734AE19B51F,
-            strategy="bfs",
-            max_depth=128,
-            execution_timeout=300,
-            create_timeout=10,
-            transaction_count=tx_count,
+        found, row = _analyze_one(
+            name, code, tx_count, execution_timeout=300, max_depth=128
         )
-        issues = fire_lasers(sym)
-        found = {i.swc_id for i in issues}
         if not expected_swcs & found:
             missed.append((name, sorted(expected_swcs), sorted(found)))
-        rows.append(
-            {
-                "contract": name,
-                "wall_s": round(time.time() - t0, 2),
-                "tx_count": tx_count,
-                "found": sorted(found),
-                "queries": stats.query_count,
-                "solver_s": round(stats.solver_time, 2),
-                **dispatch_stats.as_dict(),
-            }
-        )
+        rows.append(row)
     return time.time() - begin, rows, missed
 
 
 def _run_scale(mode: str):
     """One pass over the wide-frontier scale scenario; returns a
-    telemetry row.  The findings oracle (SWC-106 suicide leaves) is
-    enforced like the corpus contracts."""
-    from mythril_tpu.analysis.module.loader import ModuleLoader
-    from mythril_tpu.analysis.security import fire_lasers
-    from mythril_tpu.analysis.symbolic import SymExecWrapper
-    from mythril_tpu.laser.ethereum.time_handler import time_handler
-    from mythril_tpu.ops.batched_sat import dispatch_stats
-    from mythril_tpu.smt.solver import SolverStatistics, reset_blast_context
-    from mythril_tpu.solidity.evmcontract import EVMContract
-    from mythril_tpu.support.model import clear_model_cache
+    telemetry row.  A finding miss here is recorded in the summary,
+    not fatal (the corpus remains the enforced detection oracle)."""
     from mythril_tpu.support.support_args import args
 
     for key, value in MODES[mode].items():
@@ -255,38 +258,11 @@ def _run_scale(mode: str):
     saved_width = args.batch_width
     args.batch_width = 128  # let the scheduler feed the full frontier
     try:
-        reset_blast_context()
-        clear_model_cache()
-        for module in ModuleLoader().get_detection_modules():
-            module.reset_module()
-            module.cache.clear()
-        dispatch_stats.reset()
-        stats = SolverStatistics()
-        stats.enabled = True
-        stats.reset()
-        contract = EVMContract(code=scale_contract(depth=5), name="scale")
-        time_handler.start_execution(90)
-        t0 = time.time()
-        sym = SymExecWrapper(
-            contract,
-            address=0x901D12EBE1B195E5AA8748E62BD7734AE19B51F,
-            strategy="bfs",
-            max_depth=512,
-            execution_timeout=90,
-            create_timeout=10,
-            transaction_count=1,
+        _, row = _analyze_one(
+            "scale", scale_contract(depth=5), 1,
+            execution_timeout=90, max_depth=512,
         )
-        issues = fire_lasers(sym)
-        found = {i.swc_id for i in issues}
-        return {
-            "contract": "scale",
-            "wall_s": round(time.time() - t0, 2),
-            "tx_count": 1,
-            "found": sorted(found),
-            "queries": stats.query_count,
-            "solver_s": round(stats.solver_time, 2),
-            **dispatch_stats.as_dict(),
-        }
+        return row
     finally:
         args.batch_width = saved_width
 

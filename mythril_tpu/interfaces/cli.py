@@ -700,6 +700,14 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
     )
     logging.getLogger("mythril_tpu").setLevel(level)
 
+    if getattr(args, "enable_iprof", False) and getattr(args, "verbosity", 2) < 4:
+        # parity with the reference (cli.py:552): profiler output goes
+        # through the logger, so it is invisible below -v 4
+        exit_with_error(
+            getattr(args, "outform", "text"),
+            "--enable-iprof must be used with -v LOG_LEVEL where LOG_LEVEL >= 4",
+        )
+
     if args.command == "function-to-hash":
         print(MythrilDisassembler.hash_for_function_signature(args.func_name))
         sys.exit()

@@ -547,11 +547,15 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         # adaptive fuse blown: earlier dispatches in this context kept
         # deciding nothing, so the frontier goes straight to the tail
         return decided
-    # BCP-only: the host probe above already harvested every lane its
-    # candidate models could satisfy, so device WalkSAT sweeps would
-    # retry what just failed — batched conflict detection is the win
+    # BCP-only when the host probe ran: it already harvested every lane
+    # its candidate models could satisfy, so device WalkSAT sweeps would
+    # retry what just failed — batched conflict detection is the win.
+    # With probing ablated (--mode noprobe) the premise fails, so the
+    # kernel keeps its model search.
     verdicts = backend.check_assumption_sets(
-        ctx, [assumption_sets[i] for i in rep_indices], walksat=False
+        ctx,
+        [assumption_sets[i] for i in rep_indices],
+        walksat=not getattr(args, "word_probing", True),
     )
     # attribution counters tally only real device (or interpret-mode
     # kernel) passes — a bail-out to the CDCL tail is not a dispatch
