@@ -51,6 +51,10 @@ COMMAND_LIST = (
 def exit_with_error(format_: str, message: str) -> None:
     if format_ == "text" or format_ == "markdown":
         log.error(message)
+        if not log.isEnabledFor(logging.ERROR):
+            # below -v 2 the logger swallows the message; a silent
+            # exit-with-no-output would look like a successful run
+            print(message, file=sys.stderr)
     elif format_ == "json":
         print(json.dumps({"success": False, "error": str(message), "issues": []}))
     else:

@@ -64,7 +64,11 @@ from mythril_tpu.smt import (
     simplify,
     symbol_factory,
 )
-from mythril_tpu.support.opcodes import calculate_sha3_gas, get_opcode_gas
+from mythril_tpu.support.opcodes import (
+    _LOG_DATA_MAX,
+    calculate_sha3_gas,
+    get_opcode_gas,
+)
 from mythril_tpu.support.support_utils import get_code_hash
 
 log = logging.getLogger(__name__)
@@ -1171,11 +1175,22 @@ class Instruction:
     def log_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
         topic_count = int(self.op_code[3:])
-        state.stack.pop()
-        state.stack.pop()
+        offset = state.stack.pop()
+        size = state.stack.pop()
         for _ in range(topic_count):
             state.stack.pop()
-        # event logs are not modeled
+        # event logs are not modeled, but the memory expansion and the
+        # per-byte data gas are real: LOG with a huge offset must halt
+        # out-of-gas (VMTests log1MemExp, skipped by the reference)
+        state.mem_extend(offset, size)
+        size_value = size.value if hasattr(size, "value") else size
+        if size_value is not None:
+            # the opcode table's LOG max already brackets data gas with
+            # an 8*32 stand-in (opcodes.py _LOG_DATA_MAX); replace it
+            # with the exact amount rather than stacking on top
+            state.min_gas_used += 8 * size_value
+            state.max_gas_used += 8 * size_value - _LOG_DATA_MAX
+            state.check_gas()
         return [global_state]
 
     # ------------------------------------------------------------------

@@ -24,8 +24,16 @@ def execute_message_call(
     gas_price,
     value,
     track_gas: bool = False,
+    block_number: Union[int, None] = None,
 ):
-    """Run one concrete message call (the conformance oracle entry)."""
+    """Run one concrete message call (the conformance oracle entry).
+
+    ``block_number`` concretizes NUMBER for this call: conformance
+    vectors (ethereum/tests VMTests ``env.currentNumber``) compute jump
+    targets from it, which a symbolic block number cannot resolve —
+    the reference harness skips those tests
+    (reference evm_test.py:33-60); with this hook they pass.
+    """
     open_states = laser_evm.open_states[:]
     del laser_evm.open_states[:]
     for open_world_state in open_states:
@@ -42,12 +50,20 @@ def execute_message_call(
             call_data=ConcreteCalldata(next_transaction_id, data),
             call_value=value,
         )
-        _setup_global_state_for_execution(laser_evm, transaction)
+        _setup_global_state_for_execution(
+            laser_evm, transaction, block_number=block_number
+        )
     return laser_evm.exec(track_gas=track_gas)
 
 
-def _setup_global_state_for_execution(laser_evm, transaction) -> None:
+def _setup_global_state_for_execution(
+    laser_evm, transaction, block_number=None
+) -> None:
     global_state = transaction.initial_global_state()
+    if block_number is not None:
+        global_state.environment.block_number = symbol_factory.BitVecVal(
+            block_number, 256
+        )
     global_state.transaction_stack.append((transaction, None))
     global_state.world_state.transaction_sequence.append(transaction)
     new_node = Node(global_state.environment.active_account.contract_name)

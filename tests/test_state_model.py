@@ -22,7 +22,7 @@ from mythril_tpu.smt import symbol_factory
 
 def test_machine_stack_limits():
     stack = MachineStack()
-    for i in range(1023):
+    for i in range(1024):
         stack.append(i)
     with pytest.raises(StackOverflowException):
         stack.append(1)

@@ -38,22 +38,19 @@ TEST_TYPES = [
     "vmIOandFlowOperations",
 ]
 
-# Same skip set as the reference harness (evm_test.py:33-60): tests that
-# need precise gas metering, real block numbers, or log output.
+# The reference harness skips 19 vectors (evm_test.py:33-60).  This
+# build passes 15 of them: the dynamic-jump family needed only a
+# concrete block number (concolic execute_message_call grew a
+# block_number hook), loop_stacklimit_1020 needed the real 1024-item
+# stack limit (the reference stops at 1023), and log1MemExp needed LOG
+# to meter its memory expansion.  The remaining four need exact
+# frontier-era gas metering (our opcode table charges later-fork
+# constants, e.g. SLOAD 200 vs 50), which the min/max range model
+# deliberately brackets instead of reproducing per fork.
 SKIPPED_TEST_NAMES = {
-    "gas0", "gas1",
-    "BlockNumberDynamicJumpi0", "BlockNumberDynamicJumpi1",
-    "BlockNumberDynamicJump0_jumpdest2", "DynamicJumpPathologicalTest0",
-    "BlockNumberDynamicJumpifInsidePushWithJumpDest",
-    "BlockNumberDynamicJumpiAfterStop",
-    "BlockNumberDynamicJumpifInsidePushWithoutJumpDest",
-    "BlockNumberDynamicJump0_jumpdest0",
-    "BlockNumberDynamicJumpi1_jumpdest",
-    "BlockNumberDynamicJumpiOutsideBoundary",
-    "DynamicJumpJD_DependsOnJumps1",
-    "log1MemExp",
-    "loop_stacklimit_1020", "loop_stacklimit_1021",
-    "jumpTo1InstructionafterJump", "sstore_load_2", "jumpi_at_the_end",
+    "gas0", "gas1",                  # GAS pushes the exact remaining gas
+    "jumpTo1InstructionafterJump",   # out-of-gas only under exact SSTORE
+    "sstore_load_2",                 # out-of-gas only under exact SSTORE
 }
 
 
@@ -84,7 +81,7 @@ def load_test_data():
                         id=f"{designation}-{test_name}",
                         marks=pytest.mark.skipif(
                             test_name in SKIPPED_TEST_NAMES,
-                            reason="unsupported feature (same skip set as reference)",
+                            reason="needs exact frontier-era gas metering",
                         ),
                     )
                 )
@@ -113,6 +110,11 @@ def test_vmtest(environment, pre_condition, action, gas_used, post_condition):
     laser_evm.open_states = [world_state]
     laser_evm.time = datetime.now()
 
+    current_number = (
+        int(environment["currentNumber"], 16)
+        if environment and "currentNumber" in environment
+        else None
+    )
     final_states = execute_message_call(
         laser_evm,
         callee_address=symbol_factory.BitVecVal(int(action["address"], 16), 256),
@@ -124,6 +126,7 @@ def test_vmtest(environment, pre_condition, action, gas_used, post_condition):
         gas_price=int(action["gasPrice"], 16),
         value=int(action["value"], 16),
         track_gas=True,
+        block_number=current_number,
     )
 
     if gas_used is not None and gas_used < int(environment["currentGasLimit"], 16):
