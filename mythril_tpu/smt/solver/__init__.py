@@ -275,10 +275,112 @@ class Optimize(BaseSolver):
 
 
 class IndependenceSolver(Solver):
-    """API-compatible stand-in for the reference's constraint-partitioning
-    solver (laser/smt/solver/independence_solver.py).
+    """Constraint-independence partitioning (reference:
+    laser/smt/solver/independence_solver.py): constraints are grouped
+    into buckets connected by shared free variables (transitive
+    closure), each bucket is checked on its own, and the per-bucket
+    models combine into one multi-env :class:`Model`.
 
-    Partitioning buys nothing for an assumption-based incremental CDCL
-    (the solver only touches clauses reachable from the assumptions), so
-    this delegates to :class:`Solver`; kept for interface parity.
+    With the assumption-based incremental CDCL the raw search win is
+    smaller than in the reference (cone-restricted decisions already
+    localize each query), but an UNSAT bucket short-circuits without
+    solving the others, and each bucket's check goes through the
+    context-level probe/model machinery on its smaller constraint set.
     """
+
+    def __init__(self):
+        super().__init__()
+        self._envs: List[T.EvalEnv] = []
+
+    @staticmethod
+    def _free_symbols(node: T.Node, cache: dict) -> frozenset:
+        """Ids of every free symbol under ``node``: bitvec/bool vars AND
+        array bases ('avar') AND uninterpreted functions ('uf').
+        Arrays/UFs must join the partition key — two constraints that
+        communicate only through a shared storage array are dependent
+        even with disjoint bitvec variables (the reference's
+        independence solver tracks arrays for the same reason,
+        independence_solver.py:24-44)."""
+        hit = cache.get(node.id)
+        if hit is not None:
+            return hit
+        out = set()
+        stack = [node]
+        seen = set()
+        while stack:
+            n = stack.pop()
+            if n.id in seen:
+                continue
+            seen.add(n.id)
+            sub = cache.get(n.id)
+            if sub is not None:
+                out |= sub
+                continue
+            if n.op in ("var", "bvar", "avar", "uf"):
+                out.add(n.id)
+            stack.extend(n.args)
+        result = frozenset(out)
+        cache[node.id] = result
+        return result
+
+    @classmethod
+    def _partition(cls, nodes: Sequence[T.Node]) -> List[List[T.Node]]:
+        """Union-find over constraints sharing free symbols."""
+        parent: dict = {}
+        symbol_cache: dict = {}
+
+        def find(x):
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        def union(a, b):
+            ra, rb = find(a), find(b)
+            if ra != rb:
+                parent[rb] = ra
+
+        closed: List[T.Node] = []  # no free symbols: one shared bucket
+        node_vars = []
+        for node in nodes:
+            free = cls._free_symbols(node, symbol_cache)
+            if not free:
+                closed.append(node)
+                node_vars.append(None)
+                continue
+            ids = sorted(free)
+            for symbol_id in ids:
+                parent.setdefault(symbol_id, symbol_id)
+            for symbol_id in ids[1:]:
+                union(ids[0], symbol_id)
+            node_vars.append(ids[0])
+        buckets: dict = {}
+        for node, rep in zip(nodes, node_vars):
+            if rep is None:
+                continue
+            buckets.setdefault(find(rep), []).append(node)
+        out = list(buckets.values())
+        if closed:
+            out.append(closed)
+        return out
+
+    def check(self, *extra) -> CheckResult:
+        nodes = self._nodes(extra)
+        self._envs = []
+        envs = []
+        for bucket in self._partition(nodes):
+            result, env = self._check_nodes(bucket)
+            if result is not sat:
+                return result  # any failed bucket fails the conjunction
+            envs.append(env)
+        self._envs = envs
+        return sat
+
+    def model(self) -> Model:
+        return Model(self._envs) if self._envs else Model()
+
+    def reset(self) -> None:
+        super().reset()
+        self._envs = []
+
+    pop = reset
