@@ -1,0 +1,25 @@
+# Container image for mythril-tpu (reference ships a Dockerfile built
+# around solc + z3; this build needs neither — the solver is in-repo
+# and contracts load from bytecode; install solc in a derived image if
+# you analyze .sol sources).
+FROM python:3.12-slim
+
+RUN apt-get update \
+  && apt-get install -y --no-install-recommends g++ \
+  && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/mythril-tpu
+COPY pyproject.toml ./
+COPY myth ./
+COPY mythril_tpu ./mythril_tpu
+COPY docs ./docs
+
+RUN pip install --no-cache-dir jax jaxlib numpy \
+  && pip install --no-cache-dir -e .
+
+# build the native CDCL ahead of time so first analysis is not slowed
+# by the on-import compile
+RUN python -c "from mythril_tpu.native import load; load()"
+
+ENTRYPOINT ["myth"]
+CMD ["help"]

@@ -337,6 +337,19 @@ class BatchedSatBackend:
                 (len(assumption_sets), num_vars + 1), np.int8
             )
             return [None] * len(assumption_sets)
+        from mythril_tpu.ops.device_health import backend_name
+        from mythril_tpu.ops.pallas_prop import pallas_enabled
+
+        if pallas_enabled() is None and backend_name() != "tpu":
+            # auto mode on a CPU-only host: a gather dispatch through
+            # the CPU jax backend costs more than the CDCL tail it
+            # replaces (measured +4-6s over the corpus) — skip the
+            # device entirely.  Tests reach this path by setting
+            # MYTHRIL_TPU_PALLAS explicitly.
+            self.last_assignments = np.zeros(
+                (len(assumption_sets), num_vars + 1), np.int8
+            )
+            return [None] * len(assumption_sets)
         if num_vars > MAX_GATHER_VARS:
             dispatch_stats.size_bailouts += 1
             self.last_assignments = np.zeros(
@@ -498,8 +511,22 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     # permanent; a failed probe is retried only after a new model lands
     # in recent_models (frontiers repeat constraint sets across rounds,
     # so re-probing measured ~20% of corpus wall-clock)
+    from mythril_tpu.support.model import peek_model_verdict
+
     for i, nodes in enumerate(node_sets):
-        if nodes is None or not getattr(args, "word_probing", True):
+        if nodes is None:
+            continue
+        if tuple(sorted(n.id for n in nodes)) in ctx.unsat_memo:
+            decided[i] = False  # permanent verdict (see BlastContext)
+            continue
+        # the per-query funnel may have solved this exact set already
+        # (frontier sets repeat across rounds); a cached verdict beats
+        # re-probing against the rotating recent-model set
+        cached = peek_model_verdict(constraint_sets[i])
+        if cached is not None:
+            decided[i] = cached
+            continue
+        if not getattr(args, "word_probing", True):
             continue
         if ctx.probe_with_memo(nodes) is not None:
             decided[i] = True

@@ -117,7 +117,12 @@ class BlastContext:
         # walk, orders of magnitude cheaper than a CDCL search
         self.recent_models: List[T.EvalEnv] = []
         self._freevar_cache: Dict[int, frozenset] = {}
-        self._cone_cache: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        # per-root cone memo: var -> (clause idx array, var array,
+        # var frozenset) — arrays serve cone() unions, the frozenset
+        # serves _cone_of_var walk absorption
+        self._cone_cache: Dict[
+            int, Tuple[np.ndarray, np.ndarray, frozenset]
+        ] = {}
         self._learnt_cursor = 0  # native clause index already absorbed
         self.absorbed_learnt_count = 0  # learnts folded into clauses_py
         # probe memo: constraint-set key -> EvalEnv (SAT verdicts are
@@ -125,6 +130,11 @@ class BlastContext:
         # when a new model lands in recent_models); shared by the batch
         # frontier pass and the per-query CDCL tail
         self.probe_memo: Dict[Tuple[int, ...], object] = {}
+        # constraint-set key -> True for proven-UNSAT sets; sound
+        # because the pool only ever gains definitional clauses, so an
+        # assumption set can never turn SAT later (dict for FIFO-order
+        # eviction, same cap policy as probe_memo)
+        self.unsat_memo: Dict[Tuple[int, ...], bool] = {}
         self.model_version = 0
         # clauses are mirrored into the native solver lazily: _clause
         # appends to a flat 0-separated literal buffer and check() ships
@@ -231,9 +241,13 @@ class BlastContext:
 
     def _cone_of_var(self, root_var: int):
         """Uncached single-root cone walk; returns (clause indices,
-        vars) as sorted numpy arrays.  Reuses memoized sub-cones."""
+        vars, var frozenset).  Reuses memoized sub-cones: their var
+        frozensets merge into the walk's seen-set at set speed (a
+        tolist() round-trip here dominated cold-walk time), their
+        clause arrays concatenate at the end."""
         seen_vars = set()
         seen_clauses = set()
+        clause_parts = []
         stack = [root_var]
         while stack:
             var = stack.pop()
@@ -242,8 +256,8 @@ class BlastContext:
             seen_vars.add(var)
             hit = self._cone_cache.get(var)
             if hit is not None:
-                seen_clauses.update(hit[0].tolist())
-                seen_vars.update(hit[1].tolist())
+                clause_parts.append(hit[0])
+                seen_vars |= hit[2]
                 continue
             for ci in self.def_clauses.get(var, ()):
                 if ci in seen_clauses:
@@ -253,11 +267,18 @@ class BlastContext:
                     w = abs(lit)
                     if w > 1 and w not in seen_vars:
                         stack.append(w)
-        clause_arr = np.fromiter(seen_clauses, dtype=np.int64, count=len(seen_clauses))
+        clause_parts.append(
+            np.fromiter(seen_clauses, dtype=np.int64, count=len(seen_clauses))
+        )
+        clause_arr = (
+            np.unique(np.concatenate(clause_parts))
+            if len(clause_parts) > 1
+            else np.sort(clause_parts[0])
+        )
+        var_frozen = frozenset(seen_vars)
         var_arr = np.fromiter(seen_vars, dtype=np.int64, count=len(seen_vars))
-        clause_arr.sort()
         var_arr.sort()
-        return clause_arr, var_arr
+        return clause_arr, var_arr, var_frozen
 
     def absorb_learnts(self, max_width: int = 8) -> int:
         """Pull clauses the native CDCL has learned since the last sync
@@ -695,6 +716,9 @@ class BlastContext:
             if c is T.TRUE:
                 continue
             nodes.append(c)
+        key = tuple(sorted(n.id for n in nodes))
+        if key in self.unsat_memo:
+            return SatSolver.UNSAT, None
         from mythril_tpu.support.support_args import args as _args
 
         if getattr(_args, "word_probing", True):
@@ -726,6 +750,16 @@ class BlastContext:
         self.flush_native()
         status = self.solver.solve(assumptions, conflict_budget, timeout_s)
         if status != SatSolver.SAT:
+            if status == SatSolver.UNSAT:
+                # permanent: assumptions UNSAT against a monotonically
+                # growing definitional pool can never turn SAT —
+                # frontier rounds repeat constraint sets and this skips
+                # their re-probe (negative probe memos expire per new
+                # model) and re-solve
+                if len(self.unsat_memo) >= PROBE_MEMO_CAP:
+                    for stale in list(self.unsat_memo)[: PROBE_MEMO_CAP // 4]:
+                        del self.unsat_memo[stale]
+                self.unsat_memo[key] = True
             return status, None
         env = self._extract_model()
         self._remember_model(env)

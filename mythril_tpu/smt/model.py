@@ -52,7 +52,13 @@ class Model:
         for env in self.envs:
             merged.variables.update(env.variables)
             for k, v in env.arrays.items():
-                merged.arrays.setdefault(k, {}).update(v)
+                if k in merged.arrays:
+                    merged.arrays[k].update(v)
+                else:
+                    # keep the table object itself: bucket-restricted
+                    # envs carry per-table defaults (T.DefaultTable)
+                    # that a plain-dict copy would lose
+                    merged.arrays[k] = v
             merged.ufs.update(env.ufs)
         return merged
 

@@ -121,10 +121,17 @@ def reset_blast_context() -> None:
     """Drop the CNF pool and the term-interner table (used between
     unrelated analyses and in tests).  Callers must not retain Expression
     wrappers across a reset — the interner forgets old nodes, so stale
-    wrappers would no longer compare identical to newly built terms."""
+    wrappers would no longer compare identical to newly built terms.
+
+    The global model cache is keyed by interner node ids, which restart
+    after a reset — clearing it here prevents a new analysis's terms
+    from aliasing a previous analysis's cached verdicts."""
     global _context
     _context = None
     T.reset_interner()
+    from mythril_tpu.support.model import clear_model_cache
+
+    clear_model_cache()
 
 
 class BaseSolver:
@@ -294,13 +301,13 @@ class IndependenceSolver(Solver):
 
     @staticmethod
     def _free_symbols(node: T.Node, cache: dict) -> frozenset:
-        """Ids of every free symbol under ``node``: bitvec/bool vars AND
-        array bases ('avar') AND uninterpreted functions ('uf').
-        Arrays/UFs must join the partition key — two constraints that
-        communicate only through a shared storage array are dependent
-        even with disjoint bitvec variables (the reference's
-        independence solver tracks arrays for the same reason,
-        independence_solver.py:24-44)."""
+        """(id, op) of every free symbol under ``node``: bitvec/bool
+        vars AND array bases ('avar') AND uninterpreted functions
+        ('uf').  Arrays/UFs must join the partition key — two
+        constraints that communicate only through a shared storage
+        array are dependent even with disjoint bitvec variables (the
+        reference's independence solver tracks arrays for the same
+        reason, independence_solver.py:24-44)."""
         hit = cache.get(node.id)
         if hit is not None:
             return hit
@@ -317,7 +324,7 @@ class IndependenceSolver(Solver):
                 out |= sub
                 continue
             if n.op in ("var", "bvar", "avar", "uf"):
-                out.add(n.id)
+                out.add((n.id, n.op))
             stack.extend(n.args)
         result = frozenset(out)
         cache[node.id] = result
@@ -348,7 +355,7 @@ class IndependenceSolver(Solver):
                 closed.append(node)
                 node_vars.append(None)
                 continue
-            ids = sorted(free)
+            ids = sorted(symbol_id for symbol_id, _ in free)
             for symbol_id in ids:
                 parent.setdefault(symbol_id, symbol_id)
             for symbol_id in ids[1:]:
@@ -368,13 +375,47 @@ class IndependenceSolver(Solver):
         nodes = self._nodes(extra)
         self._envs = []
         envs = []
+        symbol_cache: dict = {}
         for bucket in self._partition(nodes):
             result, env = self._check_nodes(bucket)
             if result is not sat:
                 return result  # any failed bucket fails the conjunction
-            envs.append(env)
+            envs.append(self._restrict(env, bucket, symbol_cache))
         self._envs = envs
         return sat
+
+    @classmethod
+    def _restrict(cls, env, bucket, symbol_cache):
+        """Keep only the bucket's own free symbols in its env: CDCL
+        model extraction decodes EVERY pool variable (unconstrained
+        ones read as 0), and Model._merged applies envs in bucket
+        order — an unrestricted later env would clobber an earlier
+        bucket's real assignments with zeros.
+
+        A probe env may satisfy its bucket through a non-zero
+        ``array_default`` (unwritten cells read as 0xFF); the merged
+        model has a single default, so each kept array table is wrapped
+        with the bucket env's own default to stay faithful."""
+        symbols = set()
+        for node in bucket:
+            symbols |= cls._free_symbols(node, symbol_cache)
+        own = {symbol_id for symbol_id, _ in symbols}
+        arrays = {}
+        for symbol_id, op in symbols:
+            if op != "avar":
+                continue
+            table = env.arrays.get(symbol_id, {})
+            if env.array_default:
+                table = T.DefaultTable(table, env.array_default)
+            arrays[symbol_id] = table
+        return T.EvalEnv(
+            variables={
+                k: v for k, v in env.variables.items() if k in own
+            },
+            arrays=arrays,
+            ufs={k: v for k, v in env.ufs.items() if k[0] in own},
+            array_default=env.array_default,
+        )
 
     def model(self) -> Model:
         return Model(self._envs) if self._envs else Model()

@@ -600,6 +600,23 @@ def apply_uf(func: Node, args: Iterable[Node]) -> Node:
 # ---------------------------------------------------------------------------
 
 
+class DefaultTable(dict):
+    """Array cell table carrying its own unwritten-cell default.
+
+    ``_eval_select`` falls back to the env-global ``array_default`` for
+    cells missing from a plain table; when envs from independently
+    solved constraint buckets are merged into one model, each bucket's
+    default must travel with its tables (IndependenceSolver._restrict).
+    """
+
+    def __init__(self, data, default):
+        super().__init__(data)
+        self.default = default
+
+    def get(self, key, default=None):
+        return super().get(key, self.default)
+
+
 class EvalEnv:
     """Environment for concrete evaluation.
 

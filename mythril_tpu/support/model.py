@@ -38,6 +38,45 @@ def _key_of(expr) -> int:
     return expr.raw.id if hasattr(expr, "raw") else id(expr)
 
 
+def _filter_and_key(constraints, minimize=(), maximize=(), solver_timeout=None):
+    """(concrete_constraints, cache_key) or (None, None) when a
+    constraint is literally false — the single construction point for
+    the funnel's cache key, shared by get_model and the read-only
+    peek so the two can never drift apart."""
+    concrete = []
+    for constraint in constraints:
+        if isinstance(constraint, bool):
+            if not constraint:
+                return None, None
+            continue  # literal True adds nothing
+        if is_false(constraint):
+            return None, None
+        concrete.append(constraint)
+    key = (
+        tuple(sorted({_key_of(c) for c in concrete})),
+        tuple(_key_of(m) for m in minimize),
+        tuple(_key_of(m) for m in maximize),
+        solver_timeout,
+    )
+    return concrete, key
+
+
+def peek_model_verdict(constraints: Sequence):
+    """True/False when this exact constraint set's sat verdict is
+    already cached, None otherwise — a read-only probe for the batch
+    frontier pass, so lanes whose per-query verdict the funnel has
+    already paid for are not re-probed or re-blasted."""
+    concrete, key = _filter_and_key(constraints)
+    if concrete is None:
+        return False  # literally-false constraint
+    hit = _cache.get(key)
+    if hit is _UNSAT:
+        return False
+    if hit is not None:
+        return True
+    return None
+
+
 def get_model(
     constraints: Sequence,
     minimize: Tuple = (),
@@ -46,27 +85,11 @@ def get_model(
     solver_timeout: int = None,
 ):
     """Return a Model for the constraints or raise UnsatError."""
-    simple_false = False
-    concrete = []
-    for constraint in constraints:
-        if isinstance(constraint, bool):
-            if not constraint:
-                simple_false = True
-                break
-            continue  # literal True adds nothing
-        if is_false(constraint):
-            simple_false = True
-            break
-        concrete.append(constraint)
-    if simple_false:
-        raise UnsatError
-
-    key = (
-        tuple(sorted({_key_of(c) for c in concrete})),
-        tuple(_key_of(m) for m in minimize),
-        tuple(_key_of(m) for m in maximize),
-        solver_timeout,
+    concrete, key = _filter_and_key(
+        constraints, minimize, maximize, solver_timeout
     )
+    if concrete is None:
+        raise UnsatError
     hit = _cache.get(key)
     if hit is _UNSAT:
         raise UnsatError

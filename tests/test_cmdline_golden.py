@@ -156,3 +156,35 @@ def test_report_formats_full_issue_set(filename, expected):
         assert f"SWC ID: {swc}" in markdown, f"markdown report missing SWC-{swc}"
     assert "Initial State" in text  # concretized exploit state is rendered
     assert markdown.startswith("#") or "##" in markdown
+
+
+# -- 4. statespace / graph smoke tests --------------------------------------
+# (reference: tests/statespace_test.py, tests/graph_test.py)
+
+
+@requires_corpus
+def test_graph_html_output(tmp_path):
+    out_file = tmp_path / "graph.html"
+    myth(
+        "analyze", "-f", os.path.join(INPUTS, "suicide.sol.o"),
+        *ANALYZE_FLAGS, "-g", str(out_file),
+    )
+    html = out_file.read_text()
+    assert "vis.Network" in html or "drawGraph" in html
+    assert "JUMPDEST" in html or "PUSH" in html  # disassembly labels
+
+
+@requires_corpus
+def test_statespace_json_output(tmp_path):
+    out_file = tmp_path / "statespace.json"
+    myth(
+        "analyze", "-f", os.path.join(INPUTS, "suicide.sol.o"),
+        *ANALYZE_FLAGS, "-j", str(out_file),
+    )
+    payload = json.loads(out_file.read_text())
+    assert payload["nodes"], "statespace must record nodes"
+    assert isinstance(payload["edges"], list)
+    sample = payload["nodes"][0] if isinstance(payload["nodes"], list) else (
+        next(iter(payload["nodes"].values()))
+    )
+    assert "states" in sample or "code" in sample or "id" in sample

@@ -95,6 +95,9 @@ def test_batch_check_states_routes_through_mesh(monkeypatch):
     from mythril_tpu.support.support_args import args
 
     monkeypatch.setattr(args, "device_min_lanes", 2)
+    # explicit opt-in: auto mode skips the device on non-TPU backends,
+    # "off" selects the gather/mesh path with the dense kernel disabled
+    monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
     dispatch_stats.reset()
 
     lanes = []

@@ -466,3 +466,23 @@ def test_independence_solver_array_linked_buckets_unsat():
         y == 2,
     )
     assert solver.check() == unsat
+
+
+def test_independence_solver_model_not_clobbered(monkeypatch):
+    """Later buckets' envs must not overwrite earlier buckets' values:
+    unrestricted CDCL envs decode every pool variable (unconstrained
+    reads 0), and merged in bucket order the zero would clobber the
+    real assignment (review r2 finding).  Probing is disabled so envs
+    come from full CDCL extraction."""
+    from mythril_tpu.smt.solver import IndependenceSolver, sat
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setattr(args, "word_probing", False)
+    x = symbol_factory.BitVecSym("clob_x", 256)
+    a = symbol_factory.BitVecSym("clob_a", 256)
+    solver = IndependenceSolver()
+    solver.add(UGT(x, 100), ULT(x, 102), UGT(a, 5))
+    assert solver.check() == sat
+    model = solver.model()
+    assert model.eval(x).as_long() == 101
+    assert model.eval(a).as_long() > 5
