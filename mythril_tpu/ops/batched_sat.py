@@ -65,6 +65,9 @@ class DispatchStats:
         # True when the adaptive fuse disabled device dispatch for a
         # context after FUTILE_DISPATCH_FUSE zero-decision dispatches
         self.fused = False
+        # dispatch attempts skipped because auto mode found only a CPU
+        # jax backend (telemetry: explains zero dispatches on dev hosts)
+        self.cpu_auto_skips = 0
 
     def as_dict(self):
         return dict(self.__dict__)
@@ -340,12 +343,14 @@ class BatchedSatBackend:
         from mythril_tpu.ops.device_health import backend_name
         from mythril_tpu.ops.pallas_prop import pallas_enabled
 
-        if pallas_enabled() is None and backend_name() != "tpu":
+        if pallas_enabled() is None and backend_name() in (None, "cpu"):
             # auto mode on a CPU-only host: a gather dispatch through
             # the CPU jax backend costs more than the CDCL tail it
             # replaces (measured +4-6s over the corpus) — skip the
-            # device entirely.  Tests reach this path by setting
-            # MYTHRIL_TPU_PALLAS explicitly.
+            # device entirely.  Real accelerators (tpu/gpu) keep the
+            # path; tests reach it on CPU by setting MYTHRIL_TPU_PALLAS
+            # explicitly.
+            dispatch_stats.cpu_auto_skips += 1
             self.last_assignments = np.zeros(
                 (len(assumption_sets), num_vars + 1), np.int8
             )

@@ -19,6 +19,7 @@ Bit order convention: bits[0] is the LSB.  Literal 1 is constant TRUE
 """
 
 import logging
+from array import array
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -117,12 +118,9 @@ class BlastContext:
         # walk, orders of magnitude cheaper than a CDCL search
         self.recent_models: List[T.EvalEnv] = []
         self._freevar_cache: Dict[int, frozenset] = {}
-        # per-root cone memo: var -> (clause idx array, var array,
-        # var frozenset) — arrays serve cone() unions, the frozenset
-        # serves _cone_of_var walk absorption
-        self._cone_cache: Dict[
-            int, Tuple[np.ndarray, np.ndarray, frozenset]
-        ] = {}
+        # per-root cone memo: var -> (clause idx array, var array);
+        # arrays serve both cone() unions and BFS absorption
+        self._cone_cache: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         self._learnt_cursor = 0  # native clause index already absorbed
         self.absorbed_learnt_count = 0  # learnts folded into clauses_py
         # probe memo: constraint-set key -> EvalEnv (SAT verdicts are
@@ -141,6 +139,11 @@ class BlastContext:
         # the whole batch in one ctypes crossing (add_clauses_flat) —
         # per-clause crossings were ~8% of corpus wall time
         self._pending_flat: List[int] = []
+        # flat CSR mirror of clauses_py literals for the vectorized cone
+        # BFS (_lits_csr): C-backed arrays, appended per clause
+        self._lits_store = array("i")
+        self._lits_indptr = array("q", [0])
+        self._csr_cursor = 0  # clauses_py rows already in the store
         # native model snapshot (int8, var-indexed) for the last SAT
         # verdict; lets model extraction run vectorized instead of one
         # ctypes call per bit
@@ -198,6 +201,25 @@ class BlastContext:
         self.pool_version += 1
         self.clause_count += 1
 
+    def _lits_csr(self):
+        """Zero-copy numpy views over a lazily synced flat-literal
+        store: (lits int32 view, indptr int64 view).  Row i of the CSR
+        is clauses_py[i]'s literals — the cone BFS gathers whole clause
+        batches without touching Python tuples.  The store syncs to the
+        clauses_py tail here (one tight batch loop per cone burst)
+        rather than per _clause call, which measurably taxed blasting."""
+        n = len(self.clauses_py)
+        if self._csr_cursor < n:
+            store = self._lits_store
+            indptr = self._lits_indptr
+            for clause in self.clauses_py[self._csr_cursor :]:
+                store.extend(clause)
+                indptr.append(len(store))
+            self._csr_cursor = n
+        lits = np.frombuffer(self._lits_store, dtype=np.int32)
+        indptr_view = np.frombuffer(self._lits_indptr, dtype=np.int64)
+        return lits, indptr_view
+
     def cone(self, root_lits: Sequence[int], need_clauses: bool = True):
         """(clause_indices, vars) of the defining cone of ``root_lits``.
 
@@ -241,44 +263,63 @@ class BlastContext:
 
     def _cone_of_var(self, root_var: int):
         """Uncached single-root cone walk; returns (clause indices,
-        vars, var frozenset).  Reuses memoized sub-cones: their var
-        frozensets merge into the walk's seen-set at set speed (a
-        tolist() round-trip here dominated cold-walk time), their
-        clause arrays concatenate at the end."""
-        seen_vars = set()
-        seen_clauses = set()
+        vars).  Level-synchronous BFS: per level, the
+        frontier's defining clause ids come from the def_clauses index
+        (Python dict, cheap) and their literals are gathered in one
+        vectorized CSR pass (_lits_csr) — iterating clause tuples in
+        Python dominated cold-walk time.  Memoized sub-cones absorb by
+        marking their whole var set seen and appending their clause
+        arrays."""
+        lits_flat, indptr = self._lits_csr()
+        num_vars = self.solver.num_vars + 1
+        seen_vars = np.zeros(num_vars, dtype=bool)
+        seen_clauses = np.zeros(len(self.clauses_py), dtype=bool)
         clause_parts = []
-        stack = [root_var]
-        while stack:
-            var = stack.pop()
-            if var in seen_vars:
-                continue
-            seen_vars.add(var)
-            hit = self._cone_cache.get(var)
-            if hit is not None:
-                clause_parts.append(hit[0])
-                seen_vars |= hit[2]
-                continue
-            for ci in self.def_clauses.get(var, ()):
-                if ci in seen_clauses:
+        frontier = [root_var]
+        while frontier:
+            clause_ids: List[int] = []
+            for var in frontier:
+                if var >= num_vars or seen_vars[var]:
                     continue
-                seen_clauses.add(ci)
-                for lit in self.clauses_py[ci]:
-                    w = abs(lit)
-                    if w > 1 and w not in seen_vars:
-                        stack.append(w)
-        clause_parts.append(
-            np.fromiter(seen_clauses, dtype=np.int64, count=len(seen_clauses))
-        )
+                seen_vars[var] = True
+                hit = self._cone_cache.get(var)
+                if hit is not None:
+                    clause_parts.append(hit[0])
+                    cached_vars = hit[1]
+                    seen_vars[cached_vars[cached_vars < num_vars]] = True
+                    continue
+                clause_ids.extend(self.def_clauses.get(var, ()))
+            if not clause_ids:
+                break
+            batch = np.fromiter(clause_ids, dtype=np.int64, count=len(clause_ids))
+            batch = np.unique(batch)
+            batch = batch[~seen_clauses[batch]]
+            if batch.size == 0:
+                break
+            seen_clauses[batch] = True
+            starts = indptr[batch]
+            lens = indptr[batch + 1] - starts
+            total = int(lens.sum())
+            if total == 0:
+                break
+            offsets = np.concatenate(([0], np.cumsum(lens)[:-1]))
+            flat_index = (
+                np.repeat(starts, lens)
+                + np.arange(total)
+                - np.repeat(offsets, lens)
+            )
+            reached = np.abs(lits_flat[flat_index].astype(np.int64))
+            reached = np.unique(reached)
+            reached = reached[(reached > 1) & (reached < num_vars)]
+            frontier = reached[~seen_vars[reached]].tolist()
+        clause_parts.append(np.nonzero(seen_clauses)[0])
         clause_arr = (
             np.unique(np.concatenate(clause_parts))
             if len(clause_parts) > 1
-            else np.sort(clause_parts[0])
+            else clause_parts[0]
         )
-        var_frozen = frozenset(seen_vars)
-        var_arr = np.fromiter(seen_vars, dtype=np.int64, count=len(seen_vars))
-        var_arr.sort()
-        return clause_arr, var_arr, var_frozen
+        var_arr = np.nonzero(seen_vars)[0]
+        return clause_arr, var_arr
 
     def absorb_learnts(self, max_width: int = 8) -> int:
         """Pull clauses the native CDCL has learned since the last sync

@@ -54,11 +54,14 @@ class Model:
             for k, v in env.arrays.items():
                 if k in merged.arrays:
                     merged.arrays[k].update(v)
+                elif isinstance(v, T.DefaultTable):
+                    # copy preserving the per-table unwritten-cell
+                    # default (bucket-restricted probe envs rely on
+                    # it); never alias the source env's table — the
+                    # update branch above mutates in place
+                    merged.arrays[k] = T.DefaultTable(v, v.default)
                 else:
-                    # keep the table object itself: bucket-restricted
-                    # envs carry per-table defaults (T.DefaultTable)
-                    # that a plain-dict copy would lose
-                    merged.arrays[k] = v
+                    merged.arrays[k] = dict(v)
             merged.ufs.update(env.ufs)
         return merged
 
