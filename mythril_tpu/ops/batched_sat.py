@@ -27,6 +27,7 @@ Sharding: the lane axis is data-parallel; ``parallel.mesh`` shards
 """
 
 import logging
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -41,6 +42,8 @@ MAX_GATHER_VARS = 8192     # to the CDCL tail outright (see check_assumption_set
 MAX_LEARNT_EXEMPTION = 8192  # absorbed-learnt budget exemption cap
 FUTILE_DISPATCH_FUSE = 3   # consecutive zero-decision dispatches before
                            # the device is skipped for the context
+SLOW_DISPATCH_FUSE_S = 10.0  # a single zero-decision dispatch slower than
+                             # this trips the fuse immediately
 
 
 class DispatchStats:
@@ -584,11 +587,13 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     # retry what just failed — batched conflict detection is the win.
     # With probing ablated (--mode noprobe) the premise fails, so the
     # kernel keeps its model search.
+    dispatch_began = time.monotonic()
     verdicts = backend.check_assumption_sets(
         ctx,
         [assumption_sets[i] for i in rep_indices],
         walksat=not getattr(args, "word_probing", True),
     )
+    dispatch_elapsed = time.monotonic() - dispatch_began
     # attribution counters tally only real device (or interpret-mode
     # kernel) passes — a bail-out to the CDCL tail is not a dispatch
     engaged = getattr(backend, "device_engaged", False)
@@ -638,13 +643,26 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
             backend.futile_dispatches = 0
         else:
             backend.futile_dispatches += 1
+            slow = dispatch_elapsed > SLOW_DISPATCH_FUSE_S
+            if slow:
+                # one slow zero-yield dispatch (a cold kernel compile
+                # or a struggling tunnel) is already worse than the
+                # whole CDCL tail — don't wait for two more
+                backend.futile_dispatches = FUTILE_DISPATCH_FUSE
             if backend.futile_dispatches >= FUTILE_DISPATCH_FUSE:
                 backend.fused_generation = ctx.generation
                 dispatch_stats.fused = True
-                log.info(
-                    "device dispatch fused off: %d consecutive "
-                    "zero-decision dispatches", backend.futile_dispatches,
-                )
+                if slow:
+                    log.info(
+                        "device dispatch fused off: zero-decision "
+                        "dispatch took %.1fs", dispatch_elapsed,
+                    )
+                else:
+                    log.info(
+                        "device dispatch fused off: %d consecutive "
+                        "zero-decision dispatches",
+                        backend.futile_dispatches,
+                    )
     return decided
 
 

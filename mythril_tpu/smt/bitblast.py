@@ -272,31 +272,33 @@ class BlastContext:
         arrays."""
         lits_flat, indptr = self._lits_csr()
         num_vars = self.solver.num_vars + 1
-        seen_vars = np.zeros(num_vars, dtype=bool)
-        seen_clauses = np.zeros(len(self.clauses_py), dtype=bool)
+        # seen-sets stay Python sets so a small cone costs O(cone), not
+        # O(pool) (full-pool bool masks made many-small-cones workloads
+        # quadratic in pool size); only the per-level literal gather is
+        # vectorized over the CSR
+        seen_vars = set()
+        seen_clauses = set()
         clause_parts = []
         frontier = [root_var]
         while frontier:
             clause_ids: List[int] = []
             for var in frontier:
-                if var >= num_vars or seen_vars[var]:
+                if var >= num_vars or var in seen_vars:
                     continue
-                seen_vars[var] = True
+                seen_vars.add(var)
                 hit = self._cone_cache.get(var)
                 if hit is not None:
                     clause_parts.append(hit[0])
-                    cached_vars = hit[1]
-                    seen_vars[cached_vars[cached_vars < num_vars]] = True
+                    seen_vars.update(hit[1].tolist())
                     continue
                 clause_ids.extend(self.def_clauses.get(var, ()))
-            if not clause_ids:
+            fresh = [ci for ci in clause_ids if ci not in seen_clauses]
+            if not fresh:
                 break
-            batch = np.fromiter(clause_ids, dtype=np.int64, count=len(clause_ids))
-            batch = np.unique(batch)
-            batch = batch[~seen_clauses[batch]]
-            if batch.size == 0:
-                break
-            seen_clauses[batch] = True
+            seen_clauses.update(fresh)
+            batch = np.unique(
+                np.fromiter(fresh, dtype=np.int64, count=len(fresh))
+            )
             starts = indptr[batch]
             lens = indptr[batch + 1] - starts
             total = int(lens.sum())
@@ -311,14 +313,13 @@ class BlastContext:
             reached = np.abs(lits_flat[flat_index].astype(np.int64))
             reached = np.unique(reached)
             reached = reached[(reached > 1) & (reached < num_vars)]
-            frontier = reached[~seen_vars[reached]].tolist()
-        clause_parts.append(np.nonzero(seen_clauses)[0])
-        clause_arr = (
-            np.unique(np.concatenate(clause_parts))
-            if len(clause_parts) > 1
-            else clause_parts[0]
+            frontier = [v for v in reached.tolist() if v not in seen_vars]
+        clause_parts.append(
+            np.fromiter(seen_clauses, dtype=np.int64, count=len(seen_clauses))
         )
-        var_arr = np.nonzero(seen_vars)[0]
+        clause_arr = np.unique(np.concatenate(clause_parts))
+        var_arr = np.fromiter(seen_vars, dtype=np.int64, count=len(seen_vars))
+        var_arr.sort()
         return clause_arr, var_arr
 
     def absorb_learnts(self, max_width: int = 8) -> int:

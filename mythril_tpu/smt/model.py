@@ -40,6 +40,7 @@ class ModelValue:
 class Model:
     def __init__(self, envs: Optional[List[T.EvalEnv]] = None):
         self.envs = envs or [T.EvalEnv()]
+        self._merged_cache: Optional[T.EvalEnv] = None
 
     @property
     def env(self) -> T.EvalEnv:
@@ -48,6 +49,11 @@ class Model:
     def _merged(self) -> T.EvalEnv:
         if len(self.envs) == 1:
             return self.envs[0]
+        # envs are fixed at construction and tables are copied below,
+        # so the merge is computed once (concretization evaluates many
+        # expressions against one model)
+        if self._merged_cache is not None:
+            return self._merged_cache
         merged = T.EvalEnv()
         for env in self.envs:
             merged.variables.update(env.variables)
@@ -63,6 +69,7 @@ class Model:
                 else:
                     merged.arrays[k] = dict(v)
             merged.ufs.update(env.ufs)
+        self._merged_cache = merged
         return merged
 
     def eval(self, expression, model_completion: bool = False) -> ModelValue:
