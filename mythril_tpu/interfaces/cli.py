@@ -668,11 +668,24 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
         path = os.path.dirname(os.path.realpath(__file__))
         sys.argv.remove("--epic")
         # re-run ourselves piped through the rainbow pager; arguments are
-        # quoted so paths with spaces/metacharacters survive the shell
+        # quoted so paths with spaces/metacharacters survive the shell,
+        # and the re-exec goes through the interpreter explicitly (when
+        # invoked as `python3 myth ...`, argv[0] alone is not on PATH).
+        # A PATH-installed console script arrives as a bare name in
+        # argv[0] — resolve it first (the stub is a python script, so
+        # interpreter + resolved path still works).
+        import shutil
+
+        interpreter = shlex.quote(sys.executable or "python3")
+        argv0 = sys.argv[0]
+        if not os.path.exists(argv0):
+            argv0 = shutil.which(argv0) or argv0
         command = (
-            " ".join(shlex.quote(arg) for arg in sys.argv)
+            interpreter
+            + " "
+            + " ".join(shlex.quote(arg) for arg in [argv0] + sys.argv[1:])
             + " | "
-            + shlex.quote(sys.executable or "python3")
+            + interpreter
             + " "
             + shlex.quote(os.path.join(path, "epic.py"))
         )

@@ -188,3 +188,11 @@ def test_statespace_json_output(tmp_path):
         next(iter(payload["nodes"].values()))
     )
     assert "states" in sample or "code" in sample or "id" in sample
+
+
+def test_epic_reexec_pipes_through_pager():
+    """--epic re-executes the CLI through the rainbow pager; the
+    re-exec must go through the interpreter explicitly (invoked as
+    `python3 myth ...`, argv[0] alone is not on PATH)."""
+    out = myth("--epic", "version")
+    assert "Mythril-TPU version" in out
