@@ -44,6 +44,8 @@ FUTILE_DISPATCH_FUSE = 3   # consecutive zero-decision dispatches before
                            # the device is skipped for the context
 SLOW_DISPATCH_FUSE_S = 10.0  # a single zero-decision dispatch slower than
                              # this trips the fuse immediately
+FUSE_RETRY_PERIOD = 8   # fused contexts re-probe the device every N
+MAX_FUSE_RETRIES = 3    # eligible rounds, at most this many times
 
 
 class DispatchStats:
@@ -300,6 +302,9 @@ class BatchedSatBackend:
         self.futile_dispatches = 0
         self.futile_ctx_generation = -1
         self.fused_generation = -1
+        self.fused_skips = 0   # rounds skipped since the fuse blew
+        self.fuse_retries = 0  # periodic retry dispatches spent
+        self.fuse_was_slow = False  # fuse tripped by one slow dispatch
         # True iff the last check_assumption_sets actually ran a device
         # (or interpret-mode kernel) pass — telemetry keys off this so
         # bail-outs don't inflate the attribution counters
@@ -577,11 +582,26 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     if backend.futile_ctx_generation != ctx.generation:
         backend.futile_ctx_generation = ctx.generation
         backend.futile_dispatches = 0
+        backend.fused_skips = 0
+        backend.fuse_retries = 0
+        backend.fuse_was_slow = False
         dispatch_stats.fused = False  # stat mirrors the re-armed fuse
     if backend.fused_generation == ctx.generation:
         # adaptive fuse blown: earlier dispatches in this context kept
-        # deciding nothing, so the frontier goes straight to the tail
-        return decided
+        # deciding nothing, so the frontier goes straight to the tail —
+        # but the workload shape changes as execution advances (e.g.
+        # SAT-heavy dispatch-tree rounds give way to dead-path guard
+        # rounds that batched BCP kills in bulk), so a bounded number
+        # of periodic retry dispatches probe whether the device has
+        # started paying; a deciding retry re-arms the fuse fully.
+        backend.fused_skips += 1
+        if (
+            backend.fuse_was_slow  # each retry could stall 10s+ again
+            or backend.fuse_retries >= MAX_FUSE_RETRIES
+            or backend.fused_skips % FUSE_RETRY_PERIOD != 0
+        ):
+            return decided
+        backend.fuse_retries += 1
     # BCP-only when the host probe ran: it already harvested every lane
     # its candidate models could satisfy, so device WalkSAT sweeps would
     # retry what just failed — batched conflict detection is the win.
@@ -641,6 +661,16 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         # latency for it in this context.
         if device_decided:
             backend.futile_dispatches = 0
+            if backend.fused_generation == ctx.generation:
+                # a retry paid off: the workload shape changed, re-arm
+                # fully (including the retry budget — each productive
+                # phase earns the next fuse its own retries)
+                backend.fused_generation = -1
+                backend.fused_skips = 0
+                backend.fuse_retries = 0
+                dispatch_stats.fused = False
+                log.info("device dispatch re-armed: retry decided %d lanes",
+                         device_decided)
         else:
             backend.futile_dispatches += 1
             slow = dispatch_elapsed > SLOW_DISPATCH_FUSE_S
@@ -649,10 +679,14 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
                 # or a struggling tunnel) is already worse than the
                 # whole CDCL tail — don't wait for two more
                 backend.futile_dispatches = FUTILE_DISPATCH_FUSE
+                backend.fuse_was_slow = True
+            already_fused = backend.fused_generation == ctx.generation
             if backend.futile_dispatches >= FUTILE_DISPATCH_FUSE:
                 backend.fused_generation = ctx.generation
                 dispatch_stats.fused = True
-                if slow:
+                if already_fused:
+                    log.debug("fuse retry dispatch yielded nothing")
+                elif slow:
                     log.info(
                         "device dispatch fused off: zero-decision "
                         "dispatch took %.1fs", dispatch_elapsed,

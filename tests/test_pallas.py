@@ -256,3 +256,50 @@ def test_futile_dispatch_fuse(monkeypatch):
     BS.batch_check_states([[y == 1], [y == 2]])
     assert BS.dispatch_stats.dispatches == fused_count + 1
     assert backend.fused_generation != ctx2.generation
+
+
+def test_fuse_retry_rearms_on_decision(monkeypatch):
+    """A fused context re-probes the device every FUSE_RETRY_PERIOD
+    eligible rounds; a retry that decides lanes re-arms the fuse (the
+    workload shape changes as execution advances — SAT-heavy dispatch
+    rounds give way to dead-path guard rounds BCP kills in bulk)."""
+    monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
+    from mythril_tpu.ops import batched_sat as BS
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setattr(args, "device_min_lanes", 2)
+    monkeypatch.setattr(args, "word_probing", False)
+    backend = BS.get_backend()
+    mode = {"deciding": False}
+
+    def fake_check(self, ctx, sets, walksat=True):
+        self.device_engaged = True
+        self.last_assignments = np.zeros(
+            (len(sets), ctx.solver.num_vars + 1), np.int8
+        )
+        if mode["deciding"]:
+            return [False] * len(sets)  # device UNSAT for every lane
+        return [None] * len(sets)
+
+    monkeypatch.setattr(
+        BS.BatchedSatBackend, "check_assumption_sets", fake_check
+    )
+    reset_blast_context()
+    ctx = get_blast_context()
+    x = symbol_factory.BitVecSym("retry_x", 16)
+    sets = [[x == 1], [x == 2]]
+    for _ in range(BS.FUTILE_DISPATCH_FUSE):
+        BS.batch_check_states(sets)
+    assert backend.fused_generation == ctx.generation
+    fused_count = BS.dispatch_stats.dispatches
+
+    # rounds 1..7 after the fuse stay skipped; the 8th retries
+    mode["deciding"] = True
+    for i in range(BS.FUSE_RETRY_PERIOD - 1):
+        BS.batch_check_states(sets)
+        assert BS.dispatch_stats.dispatches == fused_count, f"round {i}"
+    verdicts = BS.batch_check_states(sets)  # retry dispatch
+    assert BS.dispatch_stats.dispatches == fused_count + 1
+    assert verdicts == [False, False]
+    assert backend.fused_generation != ctx.generation  # re-armed
+    assert BS.dispatch_stats.fused is False
