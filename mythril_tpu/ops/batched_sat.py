@@ -579,6 +579,7 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         lane_of.append(lane)
 
     backend = get_backend()
+    fuse_retry_attempt = False
     if backend.futile_ctx_generation != ctx.generation:
         backend.futile_ctx_generation = ctx.generation
         backend.futile_dispatches = 0
@@ -602,6 +603,7 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         ):
             return decided
         backend.fuse_retries += 1
+        fuse_retry_attempt = True
     # BCP-only when the host probe ran: it already harvested every lane
     # its candidate models could satisfy, so device WalkSAT sweeps would
     # retry what just failed — batched conflict detection is the win.
@@ -617,6 +619,10 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     # attribution counters tally only real device (or interpret-mode
     # kernel) passes — a bail-out to the CDCL tail is not a dispatch
     engaged = getattr(backend, "device_engaged", False)
+    if fuse_retry_attempt and not engaged:
+        # the retry never reached a device (size caps / health bailout)
+        # — refund it, the device was not actually re-probed
+        backend.fuse_retries -= 1
     if engaged:
         dispatch_stats.dispatches += 1
         dispatch_stats.lanes += len(rep_indices)

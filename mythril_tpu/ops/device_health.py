@@ -33,9 +33,87 @@ _verdict: Optional[bool] = None
 _backend_name: Optional[str] = None
 
 
+def _subprocess_preprobe(timeout_s: float) -> bool:
+    """Backend discovery + a tiny computation in a KILLABLE subprocess.
+
+    The threaded in-process probe below leaves a zombie thread behind
+    when the tunnel wedges, and that thread keeps contending the GIL
+    from inside the runtime for the rest of the process (measured: a
+    corpus bench went 28s -> 90s with a wedged tunnel).  A subprocess
+    is killed outright on timeout, so the parent never touches jax
+    in-process unless the device answered moments ago."""
+    import subprocess
+    import sys
+
+    # a cpu-backend subprocess answers from the backend name alone (no
+    # jit — dev hosts without an accelerator should not pay a compile);
+    # accelerators must complete a tiny computation end to end
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "backend = jax.default_backend()\n"
+        "print(backend)\n"
+        "if backend != 'cpu':\n"
+        "    print(int(jax.jit(jnp.sum)(jnp.arange(128, dtype=jnp.int32))"
+        ".block_until_ready()))\n"
+    )
+    env = dict(os.environ)
+    if "JAX_COMPILATION_CACHE_DIR" not in env:
+        # mirror configure_jax's persistent cache so the pre-probe's
+        # compile is cached (and cached reloads don't eat the deadline)
+        env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            ".jax_cache",
+        )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        log.warning(
+            "accelerator pre-probe did not answer within %.0fs; "
+            "falling back to the native CPU solver", timeout_s,
+        )
+        return False
+    except Exception as e:  # noqa: BLE001 — any failure means "bad"
+        log.warning("accelerator pre-probe failed (%s)", e)
+        return False
+    if proc.returncode != 0:
+        tail = proc.stderr.strip().splitlines()[-1:]
+        log.warning(
+            "accelerator pre-probe exited %d (%s)",
+            proc.returncode, tail[0] if tail else "",
+        )
+        return False
+    lines = proc.stdout.split()
+    if not lines:
+        return False
+    if lines[0] == "cpu":
+        return True
+    return len(lines) >= 2 and lines[-1] == "8128"
+
+
 def _probe() -> bool:
     global _backend_name
+    import time as _time
+
     timeout_s = float(os.environ.get("MYTHRIL_TPU_HEALTH_TIMEOUT", "60"))
+    began = _time.monotonic()
+    if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
+        # a pinned-CPU process has no tunnel to wedge on — only
+        # accelerator platforms go through the killable pre-probe
+        if not _subprocess_preprobe(timeout_s):
+            return False
+    # device answered from a clean process moments ago: the in-process
+    # init below should complete quickly.  The join deadline deducts
+    # the pre-probe's share so the worst-case stall stays bounded by
+    # MYTHRIL_TPU_HEALTH_TIMEOUT overall (floor guards the healthy
+    # path, whose compile the subprocess just cached).
+    timeout_s = max(15.0, timeout_s - (_time.monotonic() - began))
     result = {}
 
     def run():
