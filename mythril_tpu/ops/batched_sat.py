@@ -8,18 +8,20 @@ Design (idiomatic XLA, no data-dependent Python control flow inside jit):
   matrix in HBM; per-lane state is only the assignment vector
   ``[B, V+1]`` in {-1 (false), 0 (unknown), +1 (true)}.
 
-- One jitted step = full Boolean constraint propagation to fixpoint
-  (``lax.while_loop`` over a vectorized clause scan + scatter-max of
-  forced literals), then one randomized decision per undecided lane.
-  Conflicts discovered with *zero decisions taken* are sound UNSAT
-  verdicts (propagation from a clause subset cannot create false
-  conflicts).  Completed assignments are verified on the host against
-  the original term constraints before being trusted as SAT — so
-  clauses wider than K may be dropped from the device pool without
-  soundness loss.
+- One jitted solve = a full batched **DPLL search** (``lax.while_loop``
+  around a vectorized clause scan): unit propagation by scatter-max of
+  forced literals, dynamic DLIS decisions, per-lane trail levels and
+  decision stacks, chronological backtracking on conflict.  UNSAT
+  verdicts are sound both from a zero-decision BCP conflict and from an
+  exhausted search (a clause *subset* being unsatisfiable under the
+  lane's assumptions makes the full pool unsatisfiable under them).
+  Completed assignments are verified on the host against the original
+  term constraints before being trusted as SAT — so clauses wider than
+  K may be dropped from the device pool without soundness loss.
 
-- Lanes that neither conflict immediately nor verify within the probe
-  budget fall through to the native CDCL (the authoritative tail).
+- Lanes that exhaust the step or decision budget fall through to the
+  native CDCL (the authoritative tail); lanes the device refutes leave
+  their assumption nogood in the pool (cross-dispatch learning).
 
 Sharding: the lane axis is data-parallel; ``parallel.mesh`` shards
 ``[B, V+1]`` across devices while the clause pool is replicated
@@ -35,8 +37,8 @@ import numpy as np
 log = logging.getLogger(__name__)
 
 MAX_CLAUSE_WIDTH = 8  # wider clauses stay CPU-only (soundness preserved)
-PROPAGATE_ITERS = 256  # BCP fixpoint cap per decision round
-DECISION_ROUNDS = 24  # probing depth before handing the lane to CDCL
+GATHER_STEPS = 768     # DPLL sweep budget (one clause scan per step)
+GATHER_DECISIONS = 256  # decision-stack depth before bailing to CDCL
 MAX_GATHER_CLAUSES = 8192  # beyond this the full-pool gather probe loses
 MAX_GATHER_VARS = 8192     # to the CDCL tail outright (see check_assumption_sets)
 MAX_LEARNT_EXEMPTION = 8192  # absorbed-learnt budget exemption cap
@@ -137,6 +139,12 @@ class DevicePool:
         self.dropped = dropped
         self.consumed = len(clauses_py)
         self.filled = real_rows
+        # vars with no occurrence in any retained row (bucket padding,
+        # vars whose defining clauses were too wide): callers preassign
+        # them so the DPLL never spends decisions completing them
+        self.used = np.zeros(self.num_vars + 1, dtype=bool)
+        occurring = np.abs(self.lits_np[:real_rows]).ravel()
+        self.used[occurring[occurring <= self.num_vars]] = True
 
     def append(self, new_clauses: Sequence[Tuple[int, ...]], num_vars: int) -> bool:
         """Reflect a pool delta in-place when it fits the existing
@@ -160,6 +168,8 @@ class DevicePool:
                 self.filled : self.filled + len(rows)
             ].set(block)
             self.filled += len(rows)
+            occurring = np.abs(block).ravel()
+            self.used[occurring[occurring <= self.num_vars]] = True
         self.consumed += len(new_clauses)
         return True
 
@@ -167,36 +177,55 @@ class DevicePool:
 def build_solve_lane(
     num_vars: int,
     reduce_hook=None,
-    propagate_iters: int = PROPAGATE_ITERS,
-    decision_rounds: int = DECISION_ROUNDS,
+    max_steps: int = GATHER_STEPS,
+    max_decisions: int = GATHER_DECISIONS,
 ):
-    """Build the per-lane gather-style solve function (traceable).
+    """Build the per-lane gather-style DPLL solve function (traceable).
 
     ``solve_lane(lits[C,K], assign[V+1], key) -> (assign', status)``
-    with status 0 = undecided, 2 = conflict-without-decision (sound
-    UNSAT).  This single definition backs both the single-chip jit path
-    (``make_solve_step``) and the mesh-sharded path
-    (parallel/mesh.py), which passes a ``reduce_hook(pos, neg,
-    conflict)`` merging forced-literal votes and conflict flags across
-    clause shards (psum over the ``cp`` mesh axis).
+    with status 0 = undecided (budget exhausted), 1 = complete
+    satisfying assignment for the device clause subset (the host must
+    verify it against the original terms — wide clauses are dropped
+    from the gather pool), 2 = sound UNSAT (BCP conflict at zero
+    decisions, or a DPLL search that exhausted both phases of every
+    decision — sound under clause subsets, since a subset being
+    unsatisfiable under the lane's assumptions makes the full pool
+    unsatisfiable under them).
+
+    The search is chronological DPLL: trail levels per variable, an
+    explicit decision stack, dynamic DLIS decisions (the free variable
+    with the most open-clause occurrences, majority polarity), and
+    backtracking to the deepest unflipped decision on conflict.  One
+    step = one clause scan; everything lives in a single
+    ``lax.while_loop`` so the whole search is one XLA program.
+
+    This single definition backs both the single-chip jit path
+    (``make_solve_step``) and the mesh-sharded path (parallel/mesh.py),
+    which passes a ``reduce_hook(pos, neg, conflict, spos, sneg)``
+    merging forced-literal votes, conflict flags and decision scores
+    across clause shards (psum over the ``cp`` mesh axis); the merged
+    quantities are identical on every clause shard, so all replicas of
+    a lane take the same decisions and stay in lockstep.
     """
     jax, jnp = _require_jax()
 
     V1 = num_vars + 1
+    D = max(1, min(max_decisions, V1))
 
     def clause_scan(lits, assign_lane):
         # lit value: +1 sat, -1 false, 0 unknown; padding counts false
         var_idx = jnp.abs(lits)                       # [C, K]
         vals = jnp.sign(lits) * assign_lane[var_idx]  # [C, K]
         is_real = lits != 0
+        real_row = jnp.any(is_real, axis=1)
         sat = jnp.any((vals > 0) & is_real, axis=1)           # [C]
         num_unknown = jnp.sum((vals == 0) & is_real, axis=1)  # [C]
-        all_false = jnp.all((vals < 0) | ~is_real, axis=1) & jnp.any(
-            is_real, axis=1
-        )
+        all_false = jnp.all((vals < 0) | ~is_real, axis=1) & real_row
         conflict = jnp.any(all_false)
+        unsat_yet = (~sat) & real_row
         # unit clauses: exactly one unknown literal and not satisfied
-        unit = (~sat) & (num_unknown == 1)
+        unit = unsat_yet & (num_unknown == 1)
+        open_c = unsat_yet & (num_unknown > 1)
         unknown_here = (vals == 0) & is_real
         # the single unknown literal of each unit clause
         forced_lit = jnp.sum(
@@ -208,71 +237,114 @@ def build_solve_lane(
         forced_neg = jnp.zeros(V1, dtype=jnp.int32).at[
             jnp.where(forced_lit < 0, -forced_lit, 0)
         ].max(jnp.where(forced_lit < 0, 1, 0))
-        return forced_pos, forced_neg, conflict
-
-    def propagate(lits, assign_lane):
-        def body(carry):
-            assign_lane, _, _, i = carry
-            pos, neg, conflict = clause_scan(lits, assign_lane)
-            if reduce_hook is not None:
-                pos, neg, conflict = reduce_hook(pos, neg, conflict)
-            # contradictory forcing is also a conflict
-            conflict = conflict | jnp.any((pos * neg)[1:] > 0)
-            delta = jnp.sign(pos - neg).astype(jnp.int8)
-            new_assign = jnp.where(
-                assign_lane == 0, delta, assign_lane
-            ).astype(jnp.int8)
-            progressed = jnp.any(new_assign != assign_lane)
-            return (new_assign, conflict, progressed, i + 1)
-
-        def cond(carry):
-            _, conflict, progressed, i = carry
-            return (~conflict) & progressed & (i < propagate_iters)
-
-        assign_lane, conflict, _, _ = jax.lax.while_loop(
-            cond, body, (assign_lane, False, True, 0)
+        # decision scores: unknown occurrences in open clauses, split by
+        # polarity (scatter-add over the literal matrix)
+        open_unknown = unknown_here & open_c[:, None]
+        spos = jnp.zeros(V1, dtype=jnp.int32).at[var_idx].add(
+            (open_unknown & (lits > 0)).astype(jnp.int32)
         )
-        return assign_lane, conflict
-
-    def decide(assign_lane, key):
-        # lowest-index unassigned variable (input bits are allocated
-        # before the gates that consume them), random phase
-        unassigned = (assign_lane == 0).at[0].set(False)
-        any_open = jnp.any(unassigned)
-        var = jnp.argmax(unassigned)  # first True
-        phase = jnp.where(
-            jax.random.bernoulli(key), jnp.int8(1), jnp.int8(-1)
+        sneg = jnp.zeros(V1, dtype=jnp.int32).at[var_idx].add(
+            (open_unknown & (lits < 0)).astype(jnp.int32)
         )
-        return (
-            jnp.where(
-                any_open, assign_lane.at[var].set(phase), assign_lane
-            ),
-            any_open,
-        )
+        return forced_pos, forced_neg, conflict, spos, sneg
 
     def solve_lane(lits, assign_lane, key):
-        # round 0: pure propagation — conflict here is sound UNSAT
-        assign_lane, conflict0 = propagate(lits, assign_lane)
+        del key  # deterministic search; kept for API stability
+        idx = jnp.arange(V1)
+        didx = jnp.arange(D)  # slot l holds decision level l+1
 
-        def round_body(i, carry):
-            assign_lane, done = carry
-            subkey = jax.random.fold_in(key, i)
-            new_assign, any_open = decide(assign_lane, subkey)
-            new_assign, conflict = propagate(lits, new_assign)
-            # On conflict, revert the round (no learning): a later round
-            # may pick the opposite phase.  Lanes are never "complete"
-            # (the clause pool is shared, so foreign vars stay open);
-            # SAT detection happens on the host by evaluating the
-            # original terms under the propagated partial assignment.
-            new_done = done | ~any_open
-            keep = jnp.where(conflict | done, assign_lane, new_assign)
-            return (keep, new_done)
+        def body(carry):
+            assign, lvl, dvar, dphase, dflip, depth, status, step = carry
+            pos, neg, conflict, spos, sneg = clause_scan(lits, assign)
+            if reduce_hook is not None:
+                pos, neg, conflict, spos, sneg = reduce_hook(
+                    pos, neg, conflict, spos, sneg
+                )
+            free = (assign == 0) & (idx > 1)  # col 1 = TRUE anchor
+            force_pos = (pos > 0) & free
+            force_neg = (neg > 0) & free
+            conflict = conflict | jnp.any(force_pos & force_neg)
+            has_force = jnp.any(force_pos | force_neg)
+            open_any = jnp.any(free)
+            active = status == 0
 
-        assign_lane, _ = jax.lax.fori_loop(
-            0, decision_rounds, round_body, (assign_lane, conflict0)
+            # conflict: backtrack to the deepest unflipped decision
+            unflipped = (didx < depth) & (~dflip)
+            Lm = jnp.max(jnp.where(unflipped, didx + 1, 0))  # 0 = none
+            unsat_now = active & conflict & (Lm == 0)
+            do_bt = active & conflict & (Lm > 0)
+            bslot = jnp.maximum(Lm - 1, 0)
+            bvar = dvar[bslot]
+            bphase = -dphase[bslot]
+            A1 = jnp.where(
+                do_bt & (assign != 0) & (lvl >= Lm), 0, assign
+            ).astype(jnp.int8)
+            A1 = jnp.where(do_bt & (idx == bvar), bphase, A1).astype(
+                jnp.int8
+            )
+            lvl1 = jnp.where(do_bt & (idx == bvar), Lm, lvl)
+            popped = do_bt & (didx >= Lm)
+            at_b = do_bt & (didx == bslot)
+            dvar1 = jnp.where(popped, 0, dvar)
+            dphase1 = jnp.where(
+                popped, 0, jnp.where(at_b, bphase, dphase)
+            ).astype(jnp.int8)
+            dflip1 = jnp.where(popped, False, jnp.where(at_b, True, dflip))
+            depth1 = jnp.where(do_bt, Lm, depth)
+
+            # quiet + forced: assign all forced literals at this level
+            do_force = active & (~conflict) & has_force
+            assigned_now = do_force & (force_pos | force_neg)
+            delta = jnp.where(force_pos, 1, -1).astype(jnp.int8)
+            A2 = jnp.where(assigned_now, delta, A1).astype(jnp.int8)
+            lvl2 = jnp.where(assigned_now, depth, lvl1)
+
+            # quiet + open: decide (dynamic DLIS var + polarity)
+            want = active & (~conflict) & (~has_force) & open_any
+            can = depth < D
+            do_dec = want & can
+            bail = want & (~can)
+            score = jnp.where(free, spos + sneg + 1, -1)
+            var = jnp.argmax(score)
+            phase = jnp.where(spos[var] >= sneg[var], 1, -1).astype(
+                jnp.int8
+            )
+            ndepth = depth + 1
+            A3 = jnp.where(do_dec & (idx == var), phase, A2).astype(
+                jnp.int8
+            )
+            lvl3 = jnp.where(do_dec & (idx == var), ndepth, lvl2)
+            at_new = do_dec & (didx == depth)
+            dvar2 = jnp.where(at_new, var, dvar1)
+            dphase2 = jnp.where(at_new, phase, dphase1).astype(jnp.int8)
+            dflip2 = jnp.where(at_new, False, dflip1)
+            depth2 = jnp.where(do_dec, ndepth, depth1)
+
+            # quiet + complete: SAT candidate
+            done_sat = active & (~conflict) & (~has_force) & (~open_any)
+            status1 = jnp.where(unsat_now, 2, status)
+            status1 = jnp.where(done_sat, 1, status1)
+            status1 = jnp.where(bail, 3, status1)  # 3 = budget-bailed
+            return (A3, lvl3, dvar2, dphase2, dflip2, depth2, status1,
+                    step + 1)
+
+        def cond(carry):
+            return (carry[6] == 0) & (carry[7] < max_steps)
+
+        init = (
+            assign_lane,
+            jnp.zeros(V1, dtype=jnp.int32),
+            jnp.zeros(D, dtype=jnp.int32),
+            jnp.zeros(D, dtype=jnp.int8),
+            jnp.zeros(D, dtype=bool),
+            jnp.int32(0),
+            jnp.int32(0),
+            jnp.int32(0),
         )
-        status = jnp.where(conflict0, 2, 0)
-        return assign_lane, status
+        out = jax.lax.while_loop(cond, body, init)
+        assign, status = out[0], out[6]
+        status = jnp.where(status == 3, 0, status)  # bailed = undecided
+        return assign, status
 
     return solve_lane
 
@@ -311,7 +383,7 @@ class BatchedSatBackend:
         self.device_engaged = False
 
     def check_assumption_sets(
-        self, ctx, assumption_sets: List[List[int]], walksat: bool = True
+        self, ctx, assumption_sets: List[List[int]], search: bool = True
     ) -> List[Optional[bool]]:
         """For each assumption set over ctx's clause pool return
         True (verified SAT candidate assignment), False (sound UNSAT), or
@@ -320,7 +392,7 @@ class BatchedSatBackend:
         The returned SAT verdicts are *candidates*: the caller must
         verify the model against the original constraints (we only
         guarantee consistency with the device-resident clause subset).
-        ``walksat=False`` keeps dense dispatches BCP-only (see
+        ``search=False`` keeps dispatches BCP-only (see
         PallasSatBackend.check_assumption_sets).
         """
         from mythril_tpu.ops.pallas_prop import get_pallas_backend
@@ -329,10 +401,10 @@ class BatchedSatBackend:
         pallas = get_pallas_backend()
         if pallas.available_for(ctx):
             # fused MXU kernels over the per-call cone: dense incidence
-            # matmuls, BCP + WalkSAT, no clause-width cap.  None means
+            # matmuls, batched DPLL, no clause-width cap.  None means
             # the cone exceeded the dense caps — gather path below.
             dense = pallas.check_assumption_sets(
-                ctx, assumption_sets, walksat=walksat
+                ctx, assumption_sets, search=search
             )
             if dense is not None:
                 results, assignments = dense
@@ -415,6 +487,11 @@ class BatchedSatBackend:
         batch = len(assumption_sets)
         V1 = self.pool.num_vars + 1
         assign = np.zeros((batch, V1), dtype=np.int8)
+        # vars absent from every retained clause (bucket padding, vars
+        # defined only by dropped wide clauses) are preassigned so the
+        # DPLL never spends decisions completing them; assumptions below
+        # overwrite where they refer to such a var
+        assign[:, ~self.pool.used] = 1
         assign[:, 1] = 1  # constant-TRUE anchor
         for lane, assumptions in enumerate(assumption_sets):
             for lit in assumptions:
@@ -604,16 +681,15 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
             return decided
         backend.fuse_retries += 1
         fuse_retry_attempt = True
-    # BCP-only when the host probe ran: it already harvested every lane
-    # its candidate models could satisfy, so device WalkSAT sweeps would
-    # retry what just failed — batched conflict detection is the win.
-    # With probing ablated (--mode noprobe) the premise fails, so the
-    # kernel keeps its model search.
+    # Full DPLL search always: unlike the round-2 WalkSAT (which only
+    # retried the models the host probe had just failed), the decision
+    # search explores assignments the probe never saw, so it stays on
+    # even for probe-filtered residues — that residue is exactly where
+    # the device must pay.
     dispatch_began = time.monotonic()
     verdicts = backend.check_assumption_sets(
         ctx,
         [assumption_sets[i] for i in rep_indices],
-        walksat=not getattr(args, "word_probing", True),
     )
     dispatch_elapsed = time.monotonic() - dispatch_began
     # attribution counters tally only real device (or interpret-mode
@@ -637,7 +713,13 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         verdict = verdicts[lane]
         if verdict is False:
             decided[i] = False
+            # device UNSAT is permanent (the pool only gains implied
+            # clauses): memoize the verdict and learn the assumption
+            # nogood so the CDCL and future dispatches inherit the
+            # refutation — the cross-dispatch learning channel
+            ctx.note_unsat(node_sets[i])
             if first_for_lane:
+                ctx.learn_nogood(assumption_sets[rep_indices[lane]])
                 dispatch_stats.unsat += 1
                 device_decided += 1
             continue
@@ -655,6 +737,9 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         decided[i] = True if ok else None
         if first_for_lane:
             if ok:
+                # a verified device model serves future host probes the
+                # same way a CDCL model would
+                ctx._remember_model(env)
                 dispatch_stats.sat_verified += 1
                 device_decided += 1
             else:

@@ -1,4 +1,4 @@
-"""Fused Pallas TPU kernels for batched SAT: cone-restricted BCP + WalkSAT.
+"""Fused Pallas TPU kernels for batched SAT: cone-restricted DPLL.
 
 The gather-style step in :mod:`ops.batched_sat` reads ``assign[|lit|]``
 per clause literal — irregular access the VPU handles but the MXU
@@ -10,39 +10,57 @@ cannot.  This module reformulates clause evaluation as dense
 - With the assignment ``A[b, v] ∈ {-1, 0, +1}`` (f32):
     ``true_cnt  = relu(A)·Pᵀ + relu(-A)·Nᵀ``   (satisfied literals)
     ``false_cnt = relu(-A)·Pᵀ + relu(A)·Nᵀ``   (falsified literals)
-  A clause is a conflict when ``false_cnt == width``, and a *unit* when
-  unsatisfied with exactly one unknown literal; forced variables and
-  WalkSAT flip scores come back through the transposed products — the
-  scatter step is also a matmul.  Counts are exact: 0/1 bf16 products
-  accumulate in f32 (``preferred_element_type``) without rounding below
-  2^24.
+  A clause is a conflict when ``false_cnt == width``, a *unit* when
+  unsatisfied with exactly one unknown literal, and *open* when
+  unsatisfied with several unknowns; forced variables and decision
+  scores come back through the transposed products — the scatter step
+  is also a matmul.  Counts are exact: 0/1 bf16 products accumulate in
+  f32 (``preferred_element_type``) without rounding below 2^24.
 
-Two lessons are baked into the shape of this file (measured on the
-embedded corpus, see git history):
+Around one such sweep per step, the jitted control loop runs a full
+**batched DPLL search** — the round-3 upgrade over the earlier
+BCP+WalkSAT kernel whose telemetry showed it deciding nothing on real
+EVM workloads:
 
-1. **Sweep the cone, not the pool.**  The blast context's clause pool
-   grows monotonically over a whole contract analysis (tens of
-   thousands of clauses), but one feasibility query only constrains its
-   *defining cone* — usually a few hundred clauses.  Sweeping the full
-   pool made each device call stream ~1 GB of incidence matrix per BCP
-   iteration.  ``BlastContext.cone()`` extracts the per-batch cone on
-   the host and the dense matrices are built over remapped cone
+- per-lane trail levels ``lvl[b, v]`` and an explicit decision stack
+  (``dvar/dphase/dflip [b, d]``) live in device memory;
+- when a sweep reports no conflict and no forced literal, the lane
+  *decides*: the free variable appearing in the most open clauses,
+  with the majority polarity over those clauses (dynamic DLIS);
+- a conflict backtracks chronologically: pop to the deepest unflipped
+  decision, unassign every variable at or above that level, re-assert
+  the flipped phase — classic DPLL, which terminates and is *complete*
+  over the dispatched clause set;
+- a conflict with no unflipped decision left is a sound UNSAT verdict
+  even under decisions (the cone clauses are a subset of the pool, and
+  a subset being unsatisfiable under the lane's assumptions makes the
+  full pool unsatisfiable under them);
+- a lane with no conflict, no forcing and no free variable holds a
+  complete satisfying assignment for the cone — a SAT *candidate* the
+  host verifies against the original terms before trusting.
+
+Everything is mask-vectorized over lanes (one lane backtracks while a
+sibling decides, in the same fused step), so the whole search runs as
+one ``lax.while_loop`` of MXU sweeps — no host round-trips between
+decisions.
+
+Two lessons from earlier rounds are baked into the shape of this file:
+
+1. **Sweep the cone, not the pool.**  One feasibility query constrains
+   only its defining cone — usually a few hundred clauses of a pool of
+   tens of thousands.  ``BlastContext.cone()`` extracts the per-batch
+   cone on the host and the dense matrices are built over remapped cone
    variables, shrinking sweeps by orders of magnitude.
 
-2. **Complete assignments beat single-variable probes.**  Probing one
-   decision variable per round needs a full BCP fixpoint per probe and
-   almost never completes an assignment.  Instead, after one BCP
-   fixpoint (sound UNSAT detection), lanes are *completed* with random
-   phases and improved by batched WalkSAT: one sweep per round scores
-   every variable by its unsatisfied-clause count, and the best-scoring
-   free variable per lane is flipped.  A lane whose cone has zero
-   unsatisfied clauses is a SAT candidate; the host verifies it against
-   the original terms before trusting it.
+2. **Decisions, not probes.**  Measured in round 2: EVM-derived cones
+   are WalkSAT-resistant (model guessing decides ~0 lanes) and BCP
+   alone conflicts only on trivially dead paths.  Real verdicts need
+   the search tree.
 
-Soundness contract (same as the gather path): UNSAT only from a BCP
-conflict with zero decisions (every pool clause holds globally, so a
-conflict under a clause subset is real); SAT only after host-side
-verification of the concrete model.  Undecided lanes fall back to the
+Soundness contract: UNSAT only from (a) a BCP conflict with zero
+decisions or (b) an exhausted DPLL search — both sound under clause
+subsets; SAT only after host-side verification of the concrete model.
+Undecided lanes (step or decision budget exhausted) fall back to the
 native CDCL.
 
 Reference counterpart: this whole file replaces serial
@@ -72,19 +90,20 @@ MAX_CELLS_DENSE = 1 << 22    # 4M cells = 32 MB for the four matrices
 MAX_VARS_DENSE_TPU = 1 << 14
 MAX_CLAUSES_DENSE_TPU = 1 << 17
 MAX_CELLS_DENSE_TPU = 1 << 26  # 64M cells = 512 MB of incidence data
-# WalkSAT only pays on cones it can complete models for; the TPU tier
-# raises the var ceiling (matmul sweeps are cheap there).  NOTE: the
-# frontier pipeline dispatches BCP-only (walksat=False), so these
-# ceilings apply to direct API/test callers that ask for model search.
-WALKSAT_MAX_VARS = 1024
-WALKSAT_MAX_VARS_TPU = 8192
 MAX_LANES = 64               # per-chunk cap, further shrunk for wide V
-# the [B,V] assignment + two forced-count outputs stay VMEM-resident
-# across all grid steps; cap their f32 footprint (~12*B*V bytes)
+# the [B,V] assignment/level planes stay VMEM-resident across all grid
+# steps; cap their footprint
 MAX_LANE_CELLS = 1 << 18
-PROPAGATE_ITERS = 256        # BCP fixpoint cap (loop exits on no-progress)
-WALK_ROUNDS = 48             # one sweep per round
-RESTART_EVERY = 12           # re-randomize stuck lanes every N rounds
+# DPLL budgets.  Each step costs one incidence sweep (8 matmuls), so
+# the step budget bounds dispatch latency; the decision budget bounds
+# the [B, D] stack planes.  Past DPLL_MAX_VARS the stack would be too
+# shallow to finish realistic searches — those cones run BCP-only
+# (decisions disabled, sound-UNSAT detection still on).
+DPLL_STEPS = 512
+DPLL_STEPS_INTERPRET = 192
+MAX_DECISIONS = 256
+DPLL_MAX_VARS = 8192
+DPLL_MAX_VARS_INTERPRET = 2048
 
 
 def pallas_enabled() -> Optional[bool]:
@@ -184,9 +203,10 @@ def _tile_c(C: int, V: int) -> int:
     return min(C, max(64, min(256, (1 << 19) // V)))
 
 
-def _make_bcp_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
+def _make_dpll_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
     """One full clause scan over a partial assignment, tiled over the
-    clause axis: returns forced-literal votes and conflict flags.
+    clause axis: returns forced-literal votes, conflict flags, and
+    open-clause participation scores (the dynamic decision heuristic).
 
     Grid step i streams tile i of P/N (and their transposes) HBM→VMEM,
     runs the incidence matmuls on the MXU, and accumulates into
@@ -203,7 +223,7 @@ def _make_bcp_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
 
     def kernel(
         p_ref, n_ref, pt_ref, nt_ref, w_ref, a_ref,
-        fpos_ref, fneg_ref, conf_ref,
+        fpos_ref, fneg_ref, conf_ref, spos_ref, sneg_ref,
     ):
         i = pl.program_id(0)
 
@@ -212,6 +232,8 @@ def _make_bcp_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
             fpos_ref[:] = jnp.zeros((B, V), dtype=jnp.float32)
             fneg_ref[:] = jnp.zeros((B, V), dtype=jnp.float32)
             conf_ref[:] = jnp.zeros((B, 1), dtype=jnp.float32)
+            spos_ref[:] = jnp.zeros((B, V), dtype=jnp.float32)
+            sneg_ref[:] = jnp.zeros((B, V), dtype=jnp.float32)
 
         P = p_ref[:]    # [TC, V]
         N = n_ref[:]
@@ -235,13 +257,25 @@ def _make_bcp_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
         real = width > 0.5
         all_false = real & (false_cnt > width - 0.5)
         unk_cnt = width - true_cnt - false_cnt
-        unit = (true_cnt < 0.5) & real & (unk_cnt > 0.5) & (unk_cnt < 1.5)
+        unsat_yet = (true_cnt < 0.5) & real
+        unit = unsat_yet & (unk_cnt > 0.5) & (unk_cnt < 1.5)
+        open_c = unsat_yet & (unk_cnt > 1.5)
         u = unit.astype(jnp.bfloat16)
+        o = open_c.astype(jnp.bfloat16)
         fpos_ref[:] += lax.dot_general(
             u, P, natural, preferred_element_type=jnp.float32
         )
         fneg_ref[:] += lax.dot_general(
             u, N, natural, preferred_element_type=jnp.float32
+        )
+        # decision scores: membership of each variable in open clauses,
+        # split by polarity (argmax picks the var, the majority polarity
+        # picks the phase)
+        spos_ref[:] += lax.dot_general(
+            o, P, natural, preferred_element_type=jnp.float32
+        )
+        sneg_ref[:] += lax.dot_general(
+            o, N, natural, preferred_element_type=jnp.float32
         )
         conf_ref[:] = jnp.maximum(
             conf_ref[:],
@@ -266,88 +300,15 @@ def _make_bcp_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
             pl.BlockSpec((B, V), full, memory_space=vm),
             pl.BlockSpec((B, V), full, memory_space=vm),
             pl.BlockSpec((B, 1), full, memory_space=vm),
+            pl.BlockSpec((B, V), full, memory_space=vm),
+            pl.BlockSpec((B, V), full, memory_space=vm),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((B, V), jnp.float32),
             jax.ShapeDtypeStruct((B, V), jnp.float32),
             jax.ShapeDtypeStruct((B, 1), jnp.float32),
-        ),
-        interpret=interpret,
-    )
-    return call
-
-
-def _make_walk_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
-    """One full clause scan over a *complete* assignment: returns per-var
-    unsatisfied-clause participation scores and per-lane unsat counts."""
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    natural = (((1,), (0,)), ((), ()))
-
-    def kernel(
-        p_ref, n_ref, pt_ref, nt_ref, w_ref, x_ref,
-        score_ref, nunsat_ref,
-    ):
-        i = pl.program_id(0)
-
-        @pl.when(i == 0)
-        def _init():
-            score_ref[:] = jnp.zeros((B, V), dtype=jnp.float32)
-            nunsat_ref[:] = jnp.zeros((B, 1), dtype=jnp.float32)
-
-        P = p_ref[:]
-        N = n_ref[:]
-        Pt = pt_ref[:]
-        Nt = nt_ref[:]
-        width = w_ref[:]
-        X = x_ref[:]
-
-        pos = jnp.maximum(X, 0.0).astype(jnp.bfloat16)
-        neg = jnp.maximum(-X, 0.0).astype(jnp.bfloat16)
-        false_cnt = lax.dot_general(
-            neg, Pt, natural, preferred_element_type=jnp.float32
-        ) + lax.dot_general(
-            pos, Nt, natural, preferred_element_type=jnp.float32
-        )  # [B, TC]
-        real = width > 0.5
-        unsat = real & (false_cnt > width - 0.5)
-        u = unsat.astype(jnp.bfloat16)
-        # every literal of an unsatisfied clause is falsified, so the
-        # flip score of a variable is simply its membership count
-        score_ref[:] += lax.dot_general(
-            u, P, natural, preferred_element_type=jnp.float32
-        ) + lax.dot_general(
-            u, N, natural, preferred_element_type=jnp.float32
-        )
-        nunsat_ref[:] += jnp.sum(
-            unsat.astype(jnp.float32), axis=1, keepdims=True
-        )
-
-    grid = (C // TC,)
-    vm = pltpu.VMEM
-    full = lambda i: (0, 0)  # noqa: E731
-    call = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((TC, V), lambda i: (i, 0), memory_space=vm),
-            pl.BlockSpec((TC, V), lambda i: (i, 0), memory_space=vm),
-            pl.BlockSpec((V, TC), lambda i: (0, i), memory_space=vm),
-            pl.BlockSpec((V, TC), lambda i: (0, i), memory_space=vm),
-            pl.BlockSpec((1, TC), lambda i: (0, i), memory_space=vm),
-            pl.BlockSpec((B, V), full, memory_space=vm),
-        ],
-        out_specs=(
-            pl.BlockSpec((B, V), full, memory_space=vm),
-            pl.BlockSpec((B, 1), full, memory_space=vm),
-        ),
-        out_shape=(
             jax.ShapeDtypeStruct((B, V), jnp.float32),
-            jax.ShapeDtypeStruct((B, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, V), jnp.float32),
         ),
         interpret=interpret,
     )
@@ -356,112 +317,132 @@ def _make_walk_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
 
 @functools.lru_cache(maxsize=16)
 def make_dense_solve(
-    C: int, V: int, B: int, rounds: int, interpret: bool
+    C: int, V: int, B: int, steps: int, interpret: bool,
+    max_decisions: int = MAX_DECISIONS,
 ):
-    """Build the solve function for fixed (clauses, vars, lanes) shapes.
+    """Build the DPLL solve function for fixed (clauses, vars, lanes).
 
     Returns fn(P[C,V]bf16, N[C,V]bf16, Pt[V,C]bf16, Nt[V,C]bf16,
-    width[1,C]f32, A0[B,V]f32, key) -> (A[B,V]f32, status[B,1]i32)
-    with status 2 = UNSAT (BCP conflict with zero decisions, sound),
-    1 = complete satisfying assignment for the device clause set (host
-    must verify against the original terms), 0 = undecided.  The clause
-    scans run as tiled Pallas kernels; the fixpoint/WalkSAT control
-    loop is plain lax around them (everything compiles to one XLA
-    program).
+    width[1,C]f32, A0[B,V]f32, key) -> (A[B,V]f32, status[B,1]i32,
+    lvl[B,V]i32) with status 2 = UNSAT (BCP conflict at zero decisions
+    OR exhausted search — both sound under clause subsets), 1 =
+    complete satisfying assignment for the device clause set (host must
+    verify against the original terms), 0 = undecided (budget).  The
+    clause scans run as tiled Pallas kernels; the DPLL control loop is
+    plain lax around them (everything compiles to one XLA program).
+
+    ``max_decisions=0`` disables the search (BCP-only, for cones past
+    the stack budget).  ``key`` is accepted for API stability; the
+    search is deterministic.
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     TC = _tile_c(C, V)
-    bcp_sweep = _make_bcp_sweep(C, V, B, TC, interpret)
-    walk_sweep = _make_walk_sweep(C, V, B, TC, interpret)
+    sweep = _make_dpll_sweep(C, V, B, TC, interpret)
+    D = max(1, min(max_decisions, V))  # stack planes ([B, D])
+    decisions_on = max_decisions > 0
 
     def solve(P, N, Pt, Nt, width, A0, key):
-        def propagate(A):
-            """BCP to fixpoint; conflicted lanes keep their A.
-            Masks are f32 0/1 (i1 loop carries don't lower cleanly)."""
-
-            def body(carry):
-                A, confl, _, i = carry
-                fpos, fneg, conf = bcp_sweep(P, N, Pt, Nt, width, A)
-                unassigned = A == 0.0
-                force_pos = (fpos > 0.5) & unassigned
-                force_neg = (fneg > 0.5) & unassigned
-                conflict_now = (conf > 0.5) | jnp.any(
-                    force_pos & force_neg, axis=1, keepdims=True
-                )
-                delta = jnp.where(force_pos, 1.0, 0.0) - jnp.where(
-                    force_neg, 1.0, 0.0
-                )
-                newA = jnp.where(unassigned, delta, A)
-                A2 = jnp.where(confl < 0.5, newA, A)
-                confl2 = jnp.maximum(
-                    confl, jnp.where(conflict_now, 1.0, 0.0)
-                )
-                progressed = jnp.any(A2 != A).astype(jnp.int32)
-                return A2, confl2, progressed, i + 1
-
-            def cond(carry):
-                _, _, progressed, i = carry
-                return (progressed > 0) & (i < PROPAGATE_ITERS)
-
-            A, confl, _, _ = lax.while_loop(
-                cond,
-                body,
-                (A, jnp.zeros((B, 1), dtype=jnp.float32), jnp.int32(1), 0),
-            )
-            return A, confl
-
-        A, conflict0 = propagate(A0)
-
+        del key
         col = lax.broadcasted_iota(jnp.int32, (B, V), 1)
-        free = (A == 0.0) & (col > 1)  # col 0 unused, col 1 = TRUE anchor
+        dcol = lax.broadcasted_iota(jnp.int32, (B, D), 1)  # slot l ↔ level l+1
 
-        def rademacher(k):
-            return jnp.where(
-                jax.random.bernoulli(k, shape=(B, V)), 1.0, -1.0
-            ).astype(jnp.float32)
-
-        X0 = jnp.where(free, rademacher(jax.random.fold_in(key, 0)), A)
-
-        def round_body(r, carry):
-            X, bestX, satisfied = carry
-            score, nunsat = walk_sweep(P, N, Pt, Nt, width, X)
-            now_sat = nunsat < 0.5
-            newly = now_sat & (satisfied < 0.5)
-            bestX = jnp.where(newly, X, bestX)
-            sat2 = jnp.maximum(satisfied, now_sat.astype(jnp.float32))
-            # flip the highest-scoring free variable (noise breaks ties)
-            noise = jax.random.uniform(
-                jax.random.fold_in(key, 2 * r + 1), (B, V)
+        def body(carry):
+            A, lvl, dvar, dphase, dflip, depth, status, step = carry
+            fpos, fneg, conf, spos, sneg = sweep(P, N, Pt, Nt, width, A)
+            free = (A == 0.0) & (col > 1)  # col 1 = constant-TRUE anchor
+            force_pos = (fpos > 0.5) & free
+            force_neg = (fneg > 0.5) & free
+            contra = jnp.any(force_pos & force_neg, axis=1, keepdims=True)
+            conflict = (conf > 0.5) | contra               # [B,1]
+            has_force = jnp.any(
+                force_pos | force_neg, axis=1, keepdims=True
             )
-            masked = jnp.where(free & (score > 0.5), score + noise, -1.0)
-            var = jnp.argmax(masked, axis=1)
-            flip = (col == var[:, None]) & (
-                jnp.max(masked, axis=1, keepdims=True) > 0.0
-            )
-            Xn = jnp.where(flip, -X, X)
-            # periodic restart: re-randomize free vars of stuck lanes
-            restart = (r % RESTART_EVERY) == (RESTART_EVERY - 1)
-            rand = rademacher(jax.random.fold_in(key, 2 * r + 2))
-            Xn = jnp.where(
-                jnp.logical_and(restart, free), rand, Xn
-            )
-            X2 = jnp.where(sat2 > 0.5, X, Xn)  # freeze satisfied lanes
-            return X2, bestX, sat2
+            open_any = jnp.any(free, axis=1, keepdims=True)
+            active = status == 0                           # [B,1]
 
-        _, bestX, satisfied = lax.fori_loop(
-            0, rounds, round_body, (X0, X0, jnp.zeros((B, 1), jnp.float32))
+            # --- conflict: backtrack to the deepest unflipped decision
+            held = dcol < depth                            # [B,D]
+            unflipped = held & (dflip < 0.5)
+            Lm = jnp.max(
+                jnp.where(unflipped, dcol + 1, 0), axis=1, keepdims=True
+            )                                              # [B,1], 0 = none
+            unsat_now = active & conflict & (Lm == 0)
+            do_bt = active & conflict & (Lm > 0)
+            bslot = jnp.maximum(Lm - 1, 0)
+            bvar = jnp.take_along_axis(dvar, bslot, axis=1)      # [B,1]
+            bphase = -jnp.take_along_axis(dphase, bslot, axis=1)
+            A1 = jnp.where(do_bt & (A != 0.0) & (lvl >= Lm), 0.0, A)
+            A1 = jnp.where(do_bt & (col == bvar), bphase, A1)
+            lvl1 = jnp.where(do_bt & (col == bvar), Lm, lvl)
+            popped = do_bt & (dcol >= Lm)                  # slots above Lm
+            at_b = do_bt & (dcol == bslot)
+            dvar1 = jnp.where(popped, 0, dvar)
+            dphase1 = jnp.where(popped, 0.0, jnp.where(at_b, bphase, dphase))
+            dflip1 = jnp.where(popped, 0.0, jnp.where(at_b, 1.0, dflip))
+            depth1 = jnp.where(do_bt, Lm, depth)
+
+            # --- no conflict, forced literals: assign them at this level
+            do_force = active & ~conflict & has_force
+            assigned_now = do_force & (force_pos | force_neg) & ~(
+                force_pos & force_neg
+            )
+            delta = jnp.where(force_pos, 1.0, -1.0)
+            A2 = jnp.where(assigned_now, delta, A1)
+            lvl2 = jnp.where(assigned_now, depth, lvl1)
+
+            # --- quiet and open: decide (dynamic DLIS var + polarity)
+            want = active & ~conflict & ~has_force & open_any
+            if decisions_on:
+                can = depth < D
+                do_dec = want & can
+                bail = want & ~can
+                score = jnp.where(free, spos + sneg + 1.0, -1.0)
+                var = jnp.argmax(score, axis=1)[:, None]   # [B,1]
+                sp = jnp.take_along_axis(spos, var, axis=1)
+                sn = jnp.take_along_axis(sneg, var, axis=1)
+                phase = jnp.where(sp >= sn, 1.0, -1.0)
+                ndepth = depth + 1
+                A3 = jnp.where(do_dec & (col == var), phase, A2)
+                lvl3 = jnp.where(do_dec & (col == var), ndepth, lvl2)
+                at_new = do_dec & (dcol == depth)
+                dvar2 = jnp.where(at_new, var, dvar1)
+                dphase2 = jnp.where(at_new, phase, dphase1)
+                dflip2 = jnp.where(at_new, 0.0, dflip1)
+                depth2 = jnp.where(do_dec, ndepth, depth1)
+            else:
+                bail = want
+                A3, lvl3 = A2, lvl2
+                dvar2, dphase2, dflip2, depth2 = dvar1, dphase1, dflip1, depth1
+
+            # --- quiet and complete: SAT candidate
+            done_sat = active & ~conflict & ~has_force & ~open_any
+
+            status1 = jnp.where(unsat_now, 2, status)
+            status1 = jnp.where(done_sat, 1, status1)
+            status1 = jnp.where(bail, 3, status1)  # 3 = budget-bailed
+            return (A3, lvl3, dvar2, dphase2, dflip2, depth2, status1,
+                    step + 1)
+
+        def cond(carry):
+            status, step = carry[6], carry[7]
+            return jnp.any(status == 0) & (step < steps)
+
+        init = (
+            A0,
+            jnp.zeros((B, V), dtype=jnp.int32),
+            jnp.zeros((B, D), dtype=jnp.int32),
+            jnp.zeros((B, D), dtype=jnp.float32),
+            jnp.zeros((B, D), dtype=jnp.float32),
+            jnp.zeros((B, 1), dtype=jnp.int32),
+            jnp.zeros((B, 1), dtype=jnp.int32),
+            jnp.int32(0),
         )
-
-        status = jnp.where(
-            conflict0 > 0.5,
-            2,
-            jnp.where(satisfied > 0.5, 1, 0),
-        ).astype(jnp.int32)
-        outA = jnp.where(satisfied > 0.5, bestX, A)
-        return outA, status
+        A, lvl, _, _, _, _, status, _ = lax.while_loop(cond, body, init)
+        status = jnp.where(status == 3, 0, status)  # bailed = undecided
+        return A, status, lvl
 
     return jax.jit(solve)
 
@@ -482,17 +463,14 @@ class PallasSatBackend:
         return pallas_enabled() is not False
 
     def check_assumption_sets(
-        self, ctx, assumption_sets: List[List[int]], walksat: bool = True
+        self, ctx, assumption_sets: List[List[int]], search: bool = True
     ) -> Optional[Tuple[List[Optional[bool]], np.ndarray]]:
         """None when the per-call cone exceeds the dense caps (the
         caller falls through to the gather backend).
 
-        ``walksat=False`` runs BCP-only: the frontier pipeline passes
-        it because its lanes are pre-filtered by the host word probe —
-        the SAT lanes WalkSAT could crack are already gone, so sweeps
-        would only burn kernel time (measured: EVM-derived cones are
-        WalkSAT-resistant; batched conflict detection is where the
-        device pays)."""
+        ``search=False`` disables the DPLL decision stack (BCP-only
+        sweeps, sound UNSAT detection still on); it is also disabled
+        automatically for cones past the stack budget."""
         from mythril_tpu.ops.device_health import probe_completed
 
         # once the health probe has run its verdict is cached, so the
@@ -567,24 +545,31 @@ class PallasSatBackend:
         V = pool.V
         statuses = np.zeros(batch, dtype=np.int32)
         chunk_lanes = max(8, min(MAX_LANES, MAX_LANE_CELLS // V))
+        steps = DPLL_STEPS_INTERPRET if interpret else DPLL_STEPS
+        search_ceiling = (
+            DPLL_MAX_VARS_INTERPRET if interpret else DPLL_MAX_VARS
+        )
+        decisions = MAX_DECISIONS if (search and V <= search_ceiling) else 0
         for start in range(0, batch, chunk_lanes):
             chunk = assumption_sets[start : start + chunk_lanes]
             B = max(8, _bucket(len(chunk), floor=8))
             A0 = np.zeros((B, V), dtype=np.float32)
             A0[:, 1] = 1.0  # constant-TRUE anchor
+            # bucket-padding columns occur in no clause; preassign them
+            # so the DPLL never spends decisions completing them
+            A0[:, num_cone_vars + 1:] = 1.0
+            # pad lanes likewise fully assigned, or they would keep the
+            # while_loop searching after every real lane decided
+            A0[len(chunk):, :] = 1.0
             for lane, lits in enumerate(chunk):
                 for lit in lits:
                     A0[lane, remap[abs(lit)]] = 1.0 if lit > 0 else -1.0
             self._seed += 1
             key = jax.random.PRNGKey(self._seed)
-            # WalkSAT only pays on small cones (it must satisfy every
-            # cone clause to produce a candidate; past ~1k vars the hit
-            # rate is ~0) — larger cones run BCP-only for sound UNSAT,
-            # the host probe having already harvested the easy SAT lanes
-            walk_ceiling = WALKSAT_MAX_VARS if interpret else WALKSAT_MAX_VARS_TPU
-            rounds = WALK_ROUNDS if (walksat and V <= walk_ceiling) else 0
-            step = make_dense_solve(pool.C, V, B, rounds, interpret)
-            A, st = step(
+            step = make_dense_solve(
+                pool.C, V, B, steps, interpret, decisions
+            )
+            A, st, _lvl = step(
                 pool.P, pool.N, pool.Pt, pool.Nt, pool.width,
                 jnp.asarray(A0), key,
             )

@@ -22,11 +22,12 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
-# smaller budgets than the single-chip defaults: every BCP iteration
-# costs a psum over cp, so the sharded path leans on the CDCL tail
-# sooner rather than paying collective latency for deep probe rounds
-PROPAGATE_ITERS = 64
-DECISION_ROUNDS = 8
+# DPLL budgets for the sharded path: every sweep costs a psum over cp,
+# so the step budget trades collective latency against search depth.
+# Enough to complete full-pool assignments on dryrun/test-scale pools;
+# production frontiers lean on the CDCL tail past this.
+MAX_STEPS = 1536
+MAX_DECISIONS = 384
 
 
 _mesh_cache = None
@@ -63,9 +64,13 @@ def make_sharded_solve(mesh, num_vars: int):
     """Jitted sharded solve: lits[C,K] sharded over cp rows, assign
     [B,V+1] sharded over dp, keys[B,2] over dp.
 
-    The BCP/probe core is ops.batched_sat.build_solve_lane; this wrapper
-    only supplies the cross-shard reduce (psum of forced-literal votes
-    and conflict flags over the clause axis) and the shard_map layout.
+    The DPLL core is ops.batched_sat.build_solve_lane; this wrapper
+    only supplies the cross-shard reduce (psum of forced-literal votes,
+    conflict flags and decision scores over the clause axis) and the
+    shard_map layout.  The psum-merged quantities are identical on
+    every clause shard, so all replicas of a lane take the same
+    decisions and backtracks — the search stays in lockstep across cp
+    with no further synchronization.
     """
     import jax
     import jax.numpy as jnp
@@ -78,17 +83,19 @@ def make_sharded_solve(mesh, num_vars: int):
 
     from mythril_tpu.ops.batched_sat import build_solve_lane
 
-    def reduce_over_cp(pos, neg, conflict):
+    def reduce_over_cp(pos, neg, conflict, spos, sneg):
         pos = jax.lax.psum(pos, "cp")
         neg = jax.lax.psum(neg, "cp")
         conflict = jax.lax.psum(conflict.astype(jnp.int32), "cp") > 0
-        return pos, neg, conflict
+        spos = jax.lax.psum(spos, "cp")
+        sneg = jax.lax.psum(sneg, "cp")
+        return pos, neg, conflict, spos, sneg
 
     solve_lane = build_solve_lane(
         num_vars,
         reduce_hook=reduce_over_cp,
-        propagate_iters=PROPAGATE_ITERS,
-        decision_rounds=DECISION_ROUNDS,
+        max_steps=MAX_STEPS,
+        max_decisions=MAX_DECISIONS,
     )
 
     def solve_shard(lits_shard, assign_shard, keys_shard):
@@ -122,8 +129,11 @@ def sharded_frontier_solve(
     batch = assign.shape[0]
     pad_lanes = (-batch) % dp
     if pad_lanes:
+        # pad lanes fully assigned: an all-open lane would keep the
+        # data-dependent DPLL loop (and its per-sweep psum) running a
+        # full-pool search after every real lane finished
         assign = np.concatenate(
-            [assign, np.zeros((pad_lanes, assign.shape[1]), np.int8)]
+            [assign, np.ones((pad_lanes, assign.shape[1]), np.int8)]
         )
     pad_rows = (-lits.shape[0]) % cp
     if pad_rows:

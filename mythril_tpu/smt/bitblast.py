@@ -348,6 +348,45 @@ class BlastContext:
             self.absorbed_learnt_count += len(clauses)
         return len(clauses)
 
+    def note_unsat(self, nodes: Sequence[T.Node]) -> None:
+        """Memoize a (sound) UNSAT verdict for a constraint-node set —
+        permanent, because the pool only ever gains implied/definitional
+        clauses, so an assumption set can never turn SAT later."""
+        key = tuple(sorted(n.id for n in nodes))
+        if len(self.unsat_memo) >= PROBE_MEMO_CAP:
+            for stale in list(self.unsat_memo)[: PROBE_MEMO_CAP // 4]:
+                del self.unsat_memo[stale]
+        self.unsat_memo[key] = True
+
+    def learn_nogood(self, assumption_lits: Sequence[int]) -> None:
+        """Record a device-refuted assumption set as a pool clause.
+
+        If ``pool ∧ a1 ∧ … ∧ ak`` is UNSAT (proved by the device DPLL),
+        then ``(¬a1 ∨ … ∨ ¬ak)`` is implied by the pool — adding it
+        preserves equisatisfiability and lets both the native CDCL and
+        later device dispatches refute related queries without
+        re-searching.  This is the learned-clause channel flowing
+        device → pool (the reverse of :meth:`absorb_learnts`).
+        """
+        lits = tuple(sorted({-l for l in assumption_lits}))
+        if not lits or len(lits) > 12:
+            return  # wide nogoods add scan cost for little pruning
+        if TRUE_LIT in lits:
+            return  # trivially satisfied
+        key = ("nogood", lits)
+        if key in self.gate_cache:
+            return
+        self.gate_cache[key] = TRUE_LIT
+        index = len(self.clauses_py)
+        self.clauses_py.append(lits)
+        self._pending_flat.extend(lits)
+        self._pending_flat.append(0)
+        owner = max(abs(l) for l in lits)
+        if owner > 1:
+            self.def_clauses.setdefault(owner, []).append(index)
+        self.pool_version += 1
+        self.absorbed_learnt_count += 1
+
     def new_lit(self) -> int:
         return self.solver.new_var()
 
@@ -793,15 +832,9 @@ class BlastContext:
         status = self.solver.solve(assumptions, conflict_budget, timeout_s)
         if status != SatSolver.SAT:
             if status == SatSolver.UNSAT:
-                # permanent: assumptions UNSAT against a monotonically
-                # growing definitional pool can never turn SAT —
-                # frontier rounds repeat constraint sets and this skips
-                # their re-probe (negative probe memos expire per new
-                # model) and re-solve
-                if len(self.unsat_memo) >= PROBE_MEMO_CAP:
-                    for stale in list(self.unsat_memo)[: PROBE_MEMO_CAP // 4]:
-                        del self.unsat_memo[stale]
-                self.unsat_memo[key] = True
+                # permanent memo: frontier rounds repeat constraint sets
+                # and this skips their re-probe and re-solve
+                self.note_unsat(nodes)
             return status, None
         env = self._extract_model()
         self._remember_model(env)
