@@ -182,7 +182,7 @@ def build_solve_lane(
 ):
     """Build the per-lane gather-style DPLL solve function (traceable).
 
-    ``solve_lane(lits[C,K], assign[V+1], key) -> (assign', status)``
+    ``solve_lane(lits[C,K], assign[V+1]) -> (assign', status)``
     with status 0 = undecided (budget exhausted), 1 = complete
     satisfying assignment for the device clause subset (the host must
     verify it against the original terms — wide clauses are dropped
@@ -248,8 +248,7 @@ def build_solve_lane(
         )
         return forced_pos, forced_neg, conflict, spos, sneg
 
-    def solve_lane(lits, assign_lane, key):
-        del key  # deterministic search; kept for API stability
+    def solve_lane(lits, assign_lane):
         idx = jnp.arange(V1)
         didx = jnp.arange(D)  # slot l holds decision level l+1
 
@@ -310,10 +309,18 @@ def build_solve_lane(
                 jnp.int8
             )
             ndepth = depth + 1
-            A3 = jnp.where(do_dec & (idx == var), phase, A2).astype(
-                jnp.int8
-            )
-            lvl3 = jnp.where(do_dec & (idx == var), ndepth, lvl2)
+            # don't-care cascade: free vars in no open clause have every
+            # containing clause satisfied (no units exist in the decide
+            # branch), so any phase is safe — assign them in bulk at the
+            # new level (they pop with it on backtrack)
+            dontcare = free & (spos + sneg == 0)
+            newly = do_dec & (dontcare | (idx == var))
+            A3 = jnp.where(
+                newly,
+                jnp.where(idx == var, phase, jnp.int8(1)),
+                A2,
+            ).astype(jnp.int8)
+            lvl3 = jnp.where(newly, ndepth, lvl2)
             at_new = do_dec & (didx == depth)
             dvar2 = jnp.where(at_new, var, dvar1)
             dphase2 = jnp.where(at_new, phase, dphase1).astype(jnp.int8)
@@ -351,10 +358,10 @@ def build_solve_lane(
 
 def make_solve_step(num_vars: int):
     """Jitted single-chip lockstep solve over the whole lane batch:
-    fn(lits[C,K], assign[B,V+1], keys[B,2]) -> (assign', status[B])."""
+    fn(lits[C,K], assign[B,V+1]) -> (assign', status[B])."""
     jax, _ = _require_jax()
 
-    batched = jax.vmap(build_solve_lane(num_vars), in_axes=(None, 0, 0))
+    batched = jax.vmap(build_solve_lane(num_vars), in_axes=(None, 0))
     return jax.jit(batched)
 
 
@@ -365,7 +372,6 @@ class BatchedSatBackend:
         self.pool = DevicePool()
         self.pool_generation = -1  # BlastContext.generation of the pool
         self._step_cache: Dict[int, object] = {}
-        self._seed = 0
         # adaptive fuse: consecutive engaged dispatches that decided
         # zero lanes; past the threshold the device is skipped for the
         # rest of this blast context (paying kernel-dispatch latency
@@ -499,7 +505,6 @@ class BatchedSatBackend:
                 if var < V1:
                     assign[lane, var] = 1 if lit > 0 else -1
 
-        self._seed += 1
         self.device_engaged = True
         if len(jax.devices()) > 1:
             # multi-chip: lanes ride the dp axis, the clause pool is
@@ -512,7 +517,6 @@ class BatchedSatBackend:
 
             final_assign, status = sharded_frontier_solve(
                 get_mesh(), self.pool.lits_np, assign,
-                seed=self._seed,
             )
             dispatch_stats.mesh_dispatches += 1
         else:
@@ -520,11 +524,8 @@ class BatchedSatBackend:
             if step is None:
                 step = make_solve_step(self.pool.num_vars)
                 self._step_cache = {self.pool.num_vars: step}
-            keys = jax.random.split(
-                jax.random.PRNGKey(self._seed), batch
-            )
             final_assign, status = step(
-                self.pool.lits, jnp.asarray(assign), keys
+                self.pool.lits, jnp.asarray(assign)
             )
         status = np.asarray(status)
         final_assign = np.asarray(final_assign)

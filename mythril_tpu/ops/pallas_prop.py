@@ -96,12 +96,17 @@ MAX_LANES = 64               # per-chunk cap, further shrunk for wide V
 MAX_LANE_CELLS = 1 << 18
 # DPLL budgets.  Each step costs one incidence sweep (8 matmuls), so
 # the step budget bounds dispatch latency; the decision budget bounds
-# the [B, D] stack planes.  Past DPLL_MAX_VARS the stack would be too
-# shallow to finish realistic searches — those cones run BCP-only
-# (decisions disabled, sound-UNSAT detection still on).
-DPLL_STEPS = 512
+# the [B, D] stack planes.  Calibrated on the captured scale-scenario
+# dispatch (10.5k cone clauses / 3.2k vars, 8 lanes): completion takes
+# ~1.7-2k sweeps and ~700 decisions with the don't-care cascade — the
+# TPU budget doubles that for headroom; the while_loop exits early on
+# decided batches, so the budget is a cap, not a cost.  Past
+# DPLL_MAX_VARS the stack would be too shallow to finish realistic
+# searches — those cones run BCP-only (decisions disabled, sound-UNSAT
+# detection still on).
+DPLL_STEPS = 4096
 DPLL_STEPS_INTERPRET = 192
-MAX_DECISIONS = 256
+MAX_DECISIONS = 1024
 DPLL_MAX_VARS = 8192
 DPLL_MAX_VARS_INTERPRET = 2048
 
@@ -174,27 +179,66 @@ class DenseClausePool:
         )
 
     def refresh(self, clauses_py: Sequence[Tuple[int, ...]], num_vars: int):
-        import jax.numpy as jnp
-
         C = _bucket(max(1, len(clauses_py)))
         V = _bucket(num_vars + 1)
-        P = np.zeros((C, V), dtype=np.float32)
-        N = np.zeros((C, V), dtype=np.float32)
+        # host ships only literal coordinates (a few hundred KB); the
+        # [C, V] incidence planes (hundreds of MB at the TPU tier) are
+        # scatter-built on device — building them as host numpy and
+        # uploading four dense copies dominated dispatch latency
+        pos_r, pos_c, neg_r, neg_c = [], [], [], []
         width = np.zeros((1, C), dtype=np.float32)
         for c, clause in enumerate(clauses_py):
             for lit in clause:
                 if lit > 0:
-                    P[c, lit] = 1.0
+                    pos_r.append(c)
+                    pos_c.append(lit)
                 else:
-                    N[c, -lit] = 1.0
+                    neg_r.append(c)
+                    neg_c.append(-lit)
             width[0, c] = len(clause)
-        self.P = jnp.asarray(P, dtype=jnp.bfloat16)
-        self.N = jnp.asarray(N, dtype=jnp.bfloat16)
-        self.Pt = jnp.asarray(P.T.copy(), dtype=jnp.bfloat16)
-        self.Nt = jnp.asarray(N.T.copy(), dtype=jnp.bfloat16)
-        self.width = jnp.asarray(width)
+        build = _make_incidence_builder(
+            C, V,
+            _bucket(max(1, len(pos_r)), floor=256),
+            _bucket(max(1, len(neg_r)), floor=256),
+        )
+        self.P, self.N, self.Pt, self.Nt, self.width = build(
+            _pad_coords(pos_r, build.n_pos),
+            _pad_coords(pos_c, build.n_pos),
+            _pad_coords(neg_r, build.n_neg),
+            _pad_coords(neg_c, build.n_neg),
+            width,
+        )
         self.num_vars = V - 1
         self.C, self.V = C, V
+
+
+def _pad_coords(values: List[int], size: int) -> np.ndarray:
+    """Pad a coordinate list to its bucket with (0, 0) writes — cell
+    (0, 0) is row 0 x column 0, and column 0 is never a variable, so a
+    spurious 1 there never changes counts (A[:, 0] stays 0) and forced
+    votes/scores for column 0 are masked off by ``col > 1``."""
+    arr = np.zeros(size, dtype=np.int32)
+    arr[: len(values)] = values
+    return arr
+
+
+@functools.lru_cache(maxsize=32)
+def _make_incidence_builder(C: int, V: int, n_pos: int, n_neg: int):
+    """Jitted device-side incidence build for fixed shapes: scatter the
+    literal coordinates into bf16 [C, V] planes and materialize the
+    transposes on device."""
+    import jax
+    import jax.numpy as jnp
+
+    def build(pos_r, pos_c, neg_r, neg_c, width):
+        P = jnp.zeros((C, V), dtype=jnp.bfloat16).at[pos_r, pos_c].set(1)
+        N = jnp.zeros((C, V), dtype=jnp.bfloat16).at[neg_r, neg_c].set(1)
+        return P, N, P.T, N.T, jnp.asarray(width)
+
+    fn = jax.jit(build)
+    fn.n_pos = n_pos
+    fn.n_neg = n_neg
+    return fn
 
 
 def _tile_c(C: int, V: int) -> int:
@@ -203,10 +247,15 @@ def _tile_c(C: int, V: int) -> int:
     return min(C, max(64, min(256, (1 << 19) // V)))
 
 
-def _make_dpll_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
+def _make_dpll_sweep(
+    C: int, V: int, B: int, TC: int, interpret: bool, scores: bool
+):
     """One full clause scan over a partial assignment, tiled over the
-    clause axis: returns forced-literal votes, conflict flags, and
-    open-clause participation scores (the dynamic decision heuristic).
+    clause axis: returns forced-literal votes, conflict flags, and —
+    when ``scores`` — open-clause participation scores (the dynamic
+    decision heuristic).  BCP-only callers skip the two score matmuls
+    and their [B, V] accumulators entirely (they run on the largest
+    cone tier, which can least afford waste).
 
     Grid step i streams tile i of P/N (and their transposes) HBM→VMEM,
     runs the incidence matmuls on the MXU, and accumulates into
@@ -221,10 +270,11 @@ def _make_dpll_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
 
     natural = (((1,), (0,)), ((), ()))  # [M,K] x [K,N] -> [M,N]
 
-    def kernel(
-        p_ref, n_ref, pt_ref, nt_ref, w_ref, a_ref,
-        fpos_ref, fneg_ref, conf_ref, spos_ref, sneg_ref,
-    ):
+    def kernel(p_ref, n_ref, pt_ref, nt_ref, w_ref, a_ref, *out_refs):
+        if scores:
+            fpos_ref, fneg_ref, conf_ref, spos_ref, sneg_ref = out_refs
+        else:
+            fpos_ref, fneg_ref, conf_ref = out_refs
         i = pl.program_id(0)
 
         @pl.when(i == 0)
@@ -232,8 +282,9 @@ def _make_dpll_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
             fpos_ref[:] = jnp.zeros((B, V), dtype=jnp.float32)
             fneg_ref[:] = jnp.zeros((B, V), dtype=jnp.float32)
             conf_ref[:] = jnp.zeros((B, 1), dtype=jnp.float32)
-            spos_ref[:] = jnp.zeros((B, V), dtype=jnp.float32)
-            sneg_ref[:] = jnp.zeros((B, V), dtype=jnp.float32)
+            if scores:
+                spos_ref[:] = jnp.zeros((B, V), dtype=jnp.float32)
+                sneg_ref[:] = jnp.zeros((B, V), dtype=jnp.float32)
 
         P = p_ref[:]    # [TC, V]
         N = n_ref[:]
@@ -259,24 +310,25 @@ def _make_dpll_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
         unk_cnt = width - true_cnt - false_cnt
         unsat_yet = (true_cnt < 0.5) & real
         unit = unsat_yet & (unk_cnt > 0.5) & (unk_cnt < 1.5)
-        open_c = unsat_yet & (unk_cnt > 1.5)
         u = unit.astype(jnp.bfloat16)
-        o = open_c.astype(jnp.bfloat16)
         fpos_ref[:] += lax.dot_general(
             u, P, natural, preferred_element_type=jnp.float32
         )
         fneg_ref[:] += lax.dot_general(
             u, N, natural, preferred_element_type=jnp.float32
         )
-        # decision scores: membership of each variable in open clauses,
-        # split by polarity (argmax picks the var, the majority polarity
-        # picks the phase)
-        spos_ref[:] += lax.dot_general(
-            o, P, natural, preferred_element_type=jnp.float32
-        )
-        sneg_ref[:] += lax.dot_general(
-            o, N, natural, preferred_element_type=jnp.float32
-        )
+        if scores:
+            # decision scores: membership of each variable in open
+            # clauses, split by polarity (argmax picks the var, the
+            # majority polarity picks the phase)
+            open_c = unsat_yet & (unk_cnt > 1.5)
+            o = open_c.astype(jnp.bfloat16)
+            spos_ref[:] += lax.dot_general(
+                o, P, natural, preferred_element_type=jnp.float32
+            )
+            sneg_ref[:] += lax.dot_general(
+                o, N, natural, preferred_element_type=jnp.float32
+            )
         conf_ref[:] = jnp.maximum(
             conf_ref[:],
             jnp.any(all_false, axis=1, keepdims=True).astype(jnp.float32),
@@ -285,6 +337,14 @@ def _make_dpll_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
     grid = (C // TC,)
     vm = pltpu.VMEM
     full = lambda i: (0, 0)  # noqa: E731 — revisit the same block
+    plane = pl.BlockSpec((B, V), full, memory_space=vm)
+    flag = pl.BlockSpec((B, 1), full, memory_space=vm)
+    plane_shape = jax.ShapeDtypeStruct((B, V), jnp.float32)
+    flag_shape = jax.ShapeDtypeStruct((B, 1), jnp.float32)
+    out_specs = (plane, plane, flag) + ((plane, plane) if scores else ())
+    out_shape = (plane_shape, plane_shape, flag_shape) + (
+        (plane_shape, plane_shape) if scores else ()
+    )
     call = pl.pallas_call(
         kernel,
         grid=grid,
@@ -296,20 +356,8 @@ def _make_dpll_sweep(C: int, V: int, B: int, TC: int, interpret: bool):
             pl.BlockSpec((1, TC), lambda i: (0, i), memory_space=vm),
             pl.BlockSpec((B, V), full, memory_space=vm),
         ],
-        out_specs=(
-            pl.BlockSpec((B, V), full, memory_space=vm),
-            pl.BlockSpec((B, V), full, memory_space=vm),
-            pl.BlockSpec((B, 1), full, memory_space=vm),
-            pl.BlockSpec((B, V), full, memory_space=vm),
-            pl.BlockSpec((B, V), full, memory_space=vm),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((B, V), jnp.float32),
-            jax.ShapeDtypeStruct((B, V), jnp.float32),
-            jax.ShapeDtypeStruct((B, 1), jnp.float32),
-            jax.ShapeDtypeStruct((B, V), jnp.float32),
-            jax.ShapeDtypeStruct((B, V), jnp.float32),
-        ),
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )
     return call
@@ -323,35 +371,37 @@ def make_dense_solve(
     """Build the DPLL solve function for fixed (clauses, vars, lanes).
 
     Returns fn(P[C,V]bf16, N[C,V]bf16, Pt[V,C]bf16, Nt[V,C]bf16,
-    width[1,C]f32, A0[B,V]f32, key) -> (A[B,V]f32, status[B,1]i32,
-    lvl[B,V]i32) with status 2 = UNSAT (BCP conflict at zero decisions
-    OR exhausted search — both sound under clause subsets), 1 =
-    complete satisfying assignment for the device clause set (host must
-    verify against the original terms), 0 = undecided (budget).  The
-    clause scans run as tiled Pallas kernels; the DPLL control loop is
-    plain lax around them (everything compiles to one XLA program).
+    width[1,C]f32, A0[B,V]f32) -> (A[B,V]f32, status[B,1]i32) with
+    status 2 = UNSAT (BCP conflict at zero decisions OR exhausted
+    search — both sound under clause subsets), 1 = complete satisfying
+    assignment for the device clause set (host must verify against the
+    original terms), 0 = undecided (budget).  The clause scans run as
+    tiled Pallas kernels; the DPLL control loop is plain lax around
+    them (everything compiles to one XLA program).  The search is
+    deterministic.
 
     ``max_decisions=0`` disables the search (BCP-only, for cones past
-    the stack budget).  ``key`` is accepted for API stability; the
-    search is deterministic.
+    the stack budget) and skips the score matmuls in the sweep.
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     TC = _tile_c(C, V)
-    sweep = _make_dpll_sweep(C, V, B, TC, interpret)
-    D = max(1, min(max_decisions, V))  # stack planes ([B, D])
     decisions_on = max_decisions > 0
+    sweep = _make_dpll_sweep(C, V, B, TC, interpret, decisions_on)
+    D = max(1, min(max_decisions, V))  # stack planes ([B, D])
 
-    def solve(P, N, Pt, Nt, width, A0, key):
-        del key
+    def solve(P, N, Pt, Nt, width, A0):
         col = lax.broadcasted_iota(jnp.int32, (B, V), 1)
         dcol = lax.broadcasted_iota(jnp.int32, (B, D), 1)  # slot l ↔ level l+1
 
         def body(carry):
             A, lvl, dvar, dphase, dflip, depth, status, step = carry
-            fpos, fneg, conf, spos, sneg = sweep(P, N, Pt, Nt, width, A)
+            if decisions_on:
+                fpos, fneg, conf, spos, sneg = sweep(P, N, Pt, Nt, width, A)
+            else:
+                fpos, fneg, conf = sweep(P, N, Pt, Nt, width, A)
             free = (A == 0.0) & (col > 1)  # col 1 = constant-TRUE anchor
             force_pos = (fpos > 0.5) & free
             force_neg = (fneg > 0.5) & free
@@ -405,8 +455,19 @@ def make_dense_solve(
                 sn = jnp.take_along_axis(sneg, var, axis=1)
                 phase = jnp.where(sp >= sn, 1.0, -1.0)
                 ndepth = depth + 1
-                A3 = jnp.where(do_dec & (col == var), phase, A2)
-                lvl3 = jnp.where(do_dec & (col == var), ndepth, lvl2)
+                # don't-care cascade: a free var in NO open clause has
+                # every containing clause already satisfied (no units or
+                # conflicts exist in the decide branch), so any phase is
+                # safe — assign them all in bulk at the new level (they
+                # pop with it on backtrack).  EVM cones are mostly
+                # don't-cares once the constrained core is satisfied;
+                # without this, completion costs one decision per var.
+                dontcare = free & (spos + sneg < 0.5)
+                newly = do_dec & (dontcare | (col == var))
+                A3 = jnp.where(
+                    newly, jnp.where(col == var, phase, 1.0), A2
+                )
+                lvl3 = jnp.where(newly, ndepth, lvl2)
                 at_new = do_dec & (dcol == depth)
                 dvar2 = jnp.where(at_new, var, dvar1)
                 dphase2 = jnp.where(at_new, phase, dphase1)
@@ -440,9 +501,9 @@ def make_dense_solve(
             jnp.zeros((B, 1), dtype=jnp.int32),
             jnp.int32(0),
         )
-        A, lvl, _, _, _, _, status, _ = lax.while_loop(cond, body, init)
+        A, _, _, _, _, _, status, _ = lax.while_loop(cond, body, init)
         status = jnp.where(status == 3, 0, status)  # bailed = undecided
-        return A, status, lvl
+        return A, status
 
     return jax.jit(solve)
 
@@ -451,9 +512,6 @@ class PallasSatBackend:
     """Drives the fused kernels over per-call cone problems; same verdict
     contract as BatchedSatBackend (False = sound UNSAT, None = host
     verifies the returned assignment or falls back to CDCL)."""
-
-    def __init__(self):
-        self._seed = 0
 
     def available_for(self, ctx) -> bool:
         # only the cheap forced-off check: the full availability probe
@@ -507,7 +565,6 @@ class PallasSatBackend:
         if not _use_pallas():
             return None  # unhealthy device / CPU backend not forced
 
-        import jax
         import jax.numpy as jnp
 
         from mythril_tpu.ops import configure_jax
@@ -564,14 +621,12 @@ class PallasSatBackend:
             for lane, lits in enumerate(chunk):
                 for lit in lits:
                     A0[lane, remap[abs(lit)]] = 1.0 if lit > 0 else -1.0
-            self._seed += 1
-            key = jax.random.PRNGKey(self._seed)
             step = make_dense_solve(
                 pool.C, V, B, steps, interpret, decisions
             )
-            A, st, _lvl = step(
+            A, st = step(
                 pool.P, pool.N, pool.Pt, pool.Nt, pool.width,
-                jnp.asarray(A0), key,
+                jnp.asarray(A0),
             )
             n = len(chunk)
             A_host = np.asarray(A, dtype=np.float32)[:n]

@@ -62,7 +62,7 @@ def build_mesh(n_devices: int = None, dp: int = None, cp: int = None):
 
 def make_sharded_solve(mesh, num_vars: int):
     """Jitted sharded solve: lits[C,K] sharded over cp rows, assign
-    [B,V+1] sharded over dp, keys[B,2] over dp.
+    [B,V+1] sharded over dp.
 
     The DPLL core is ops.batched_sat.build_solve_lane; this wrapper
     only supplies the cross-shard reduce (psum of forced-literal votes,
@@ -98,15 +98,15 @@ def make_sharded_solve(mesh, num_vars: int):
         max_decisions=MAX_DECISIONS,
     )
 
-    def solve_shard(lits_shard, assign_shard, keys_shard):
+    def solve_shard(lits_shard, assign_shard):
         # vmap over the local lanes; clause shard shared per device
-        return jax.vmap(solve_lane, in_axes=(None, 0, 0))(
-            lits_shard, assign_shard, keys_shard
+        return jax.vmap(solve_lane, in_axes=(None, 0))(
+            lits_shard, assign_shard
         )
 
     specs = dict(
         mesh=mesh,
-        in_specs=(P("cp", None), P("dp", None), P("dp")),
+        in_specs=(P("cp", None), P("dp", None)),
         out_specs=(P("dp", None), P("dp")),
     )
     try:  # jax >= 0.8 renamed the replication-check toggle
@@ -117,7 +117,7 @@ def make_sharded_solve(mesh, num_vars: int):
 
 
 def sharded_frontier_solve(
-    mesh, lits: np.ndarray, assign: np.ndarray, seed: int = 0
+    mesh, lits: np.ndarray, assign: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Solve a frontier batch on the mesh; pads lanes to the dp size and
     clause rows to the cp size."""
@@ -140,7 +140,6 @@ def sharded_frontier_solve(
         lits = np.concatenate(
             [lits, np.zeros((pad_rows, lits.shape[1]), np.int32)]
         )
-    keys = jax.random.split(jax.random.PRNGKey(seed), assign.shape[0])
     cache_key = (id(mesh), assign.shape[1] - 1)
     solve = _solve_cache.get(cache_key)
     if solve is None:
@@ -148,7 +147,7 @@ def sharded_frontier_solve(
         _solve_cache.clear()  # one live shape per mesh is enough
         _solve_cache[cache_key] = solve
     final_assign, status = solve(
-        jnp.asarray(lits), jnp.asarray(assign), keys
+        jnp.asarray(lits), jnp.asarray(assign)
     )
     return (
         np.asarray(final_assign)[:batch],

@@ -163,6 +163,10 @@ class BlastContext:
         # extract the cone of influence of a query instead of sweeping
         # the whole pool (ops/pallas_prop.py).
         self.def_clauses: Dict[int, List[int]] = {}
+        # device-learned nogoods as (clause index, sorted var array):
+        # appended to any cone whose var set covers them (cached cones
+        # never re-walk, so def_clauses alone cannot deliver them)
+        self.nogoods: List[Tuple[int, np.ndarray]] = []
 
     # ------------------------------------------------------------------
     # gates
@@ -254,12 +258,30 @@ class BlastContext:
             empty = np.empty(0, dtype=np.int64)
             return empty, empty
         if len(clause_parts) == 1:
-            return clause_parts[0], var_parts[0]
-        clause_union = (
-            np.unique(np.concatenate(clause_parts)) if need_clauses
-            else np.empty(0, dtype=np.int64)
-        )
-        return clause_union, np.unique(np.concatenate(var_parts))
+            cone_vars = var_parts[0]
+            clause_union = clause_parts[0]
+        else:
+            cone_vars = np.unique(np.concatenate(var_parts))
+            clause_union = (
+                np.unique(np.concatenate(clause_parts)) if need_clauses
+                else np.empty(0, dtype=np.int64)
+            )
+        if need_clauses and self.nogoods and cone_vars.size:
+            # nogoods whose vars the cone covers prune it; cached cones
+            # never re-walk, so they are appended here per call
+            extra = [
+                np.int64(index) for index, ngvars in self.nogoods
+                if ngvars.size and np.all(
+                    cone_vars[np.searchsorted(
+                        cone_vars, ngvars
+                    ).clip(max=cone_vars.size - 1)] == ngvars
+                )
+            ]
+            if extra:
+                clause_union = np.unique(np.concatenate(
+                    [clause_union, np.asarray(extra, dtype=np.int64)]
+                ))
+        return clause_union, cone_vars
 
     def _cone_of_var(self, root_var: int):
         """Uncached single-root cone walk; returns (clause indices,
@@ -384,6 +406,15 @@ class BlastContext:
         owner = max(abs(l) for l in lits)
         if owner > 1:
             self.def_clauses.setdefault(owner, []).append(index)
+        # per-root cones are memoized permanently, so a nogood indexed
+        # only under def_clauses would never reach already-walked cones
+        # (exactly the repeated queries it should prune) — register it
+        # for the subset-append in cone()
+        self.nogoods.append(
+            (index, np.fromiter(
+                sorted({abs(l) for l in lits}), dtype=np.int64
+            ))
+        )
         self.pool_version += 1
         self.absorbed_learnt_count += 1
 

@@ -52,8 +52,9 @@ def test_dense_pool_shapes():
     pool.refresh(ctx.clauses_py, ctx.solver.num_vars)
     assert pool.C >= len(ctx.clauses_py)
     assert pool.V >= ctx.solver.num_vars + 1
-    # every literal accounted for exactly once across P/N
-    total = float(pool.P.sum() + pool.N.sum())
+    # every literal accounted for exactly once across P/N (column 0 is
+    # the scrap cell for coordinate padding — never a real variable)
+    total = float(pool.P[:, 1:].sum() + pool.N[:, 1:].sum())
     assert total == sum(len(c) for c in ctx.clauses_py)
 
 
@@ -137,9 +138,9 @@ def test_differential_random_cnf_vs_cdcl():
         A0[:, 1] = 1.0
         A0[:, num_vars + 2:] = 1.0  # bucket padding: preassigned
         step = make_dense_solve(pool.C, pool.V, B, 96, True)
-        A, st, _lvl = step(
+        A, st = step(
             pool.P, pool.N, pool.Pt, pool.Nt, pool.width,
-            jnp.asarray(A0), jax.random.PRNGKey(trial),
+            jnp.asarray(A0),
         )
         status = int(np.asarray(st)[0, 0])
         truths.append(truth)
@@ -191,9 +192,9 @@ def test_dpll_decides_where_bcp_cannot():
         A0[:, 1] = 1.0
         A0[:, num_vars + 1:] = 1.0  # bucket padding: preassigned
         step = make_dense_solve(pool.C, pool.V, B, 192, True)
-        A, st, _ = step(
+        A, st = step(
             pool.P, pool.N, pool.Pt, pool.Nt, pool.width,
-            jnp.asarray(A0), jax.random.PRNGKey(0),
+            jnp.asarray(A0),
         )
         status = int(np.asarray(st)[0, 0])
         assert status == want, f"want {want}, got {status}"
@@ -220,9 +221,9 @@ def test_wide_clauses_not_dropped():
     A0 = np.zeros((B, pool.V), dtype=np.float32)
     A0[:, 1] = 1.0
     step = make_dense_solve(pool.C, pool.V, B, 4, True)
-    _, st, _ = step(
+    _, st = step(
         pool.P, pool.N, pool.Pt, pool.Nt, pool.width,
-        jnp.asarray(A0), jax.random.PRNGKey(0),
+        jnp.asarray(A0),
     )
     assert int(np.asarray(st)[0, 0]) == 2
 
@@ -278,7 +279,7 @@ def test_futile_dispatch_fuse(monkeypatch):
     # force "engaged but nothing decided" outcomes without a device:
     # all-None verdicts with an all-zero assignment that cannot verify
     # against the lanes below (x == i+1 is false under x = 0)
-    def fake_check(self, ctx, sets, walksat=True):
+    def fake_check(self, ctx, sets, search=True):
         self.device_engaged = True
         self.last_assignments = np.zeros(
             (len(sets), ctx.solver.num_vars + 1), np.int8
@@ -326,7 +327,7 @@ def test_fuse_retry_rearms_on_decision(monkeypatch):
     backend = BS.get_backend()
     mode = {"deciding": False}
 
-    def fake_check(self, ctx, sets, walksat=True):
+    def fake_check(self, ctx, sets, search=True):
         self.device_engaged = True
         self.last_assignments = np.zeros(
             (len(sets), ctx.solver.num_vars + 1), np.int8
