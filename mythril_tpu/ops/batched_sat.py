@@ -75,6 +75,9 @@ class DispatchStats:
         # dispatch attempts skipped because auto mode found only a CPU
         # jax backend (telemetry: explains zero dispatches on dev hosts)
         self.cpu_auto_skips = 0
+        # total DPLL sweeps the dense kernel ran (wall-clock breakdown:
+        # device solve time ≈ sweeps x per-sweep cost for the shape)
+        self.device_sweeps = 0
 
     def as_dict(self):
         return dict(self.__dict__)

@@ -79,17 +79,17 @@ import numpy as np
 log = logging.getLogger(__name__)
 
 # Per-call dense cone caps: V and C are bucketed powers of two; the
-# four bf16 incidence matrices cost 8*C*V bytes of HBM.  Two tiers:
+# two bf16 incidence planes cost 4*C*V bytes of HBM.  Two tiers:
 # the small tier is what CPU interpret mode (tests, degraded hosts)
 # can chew through; a real TPU gets matrices sized for its HBM/MXU —
 # wide frontiers over medium cones (the lockstep north star) only fit
 # the large tier.
 MAX_VARS_DENSE = 4096
 MAX_CLAUSES_DENSE = 1 << 15
-MAX_CELLS_DENSE = 1 << 22    # 4M cells = 32 MB for the four matrices
+MAX_CELLS_DENSE = 1 << 22    # 4M cells = 16 MB for the two planes
 MAX_VARS_DENSE_TPU = 1 << 14
 MAX_CLAUSES_DENSE_TPU = 1 << 17
-MAX_CELLS_DENSE_TPU = 1 << 26  # 64M cells = 512 MB of incidence data
+MAX_CELLS_DENSE_TPU = 1 << 26  # 64M cells = 256 MB of incidence data
 MAX_LANES = 64               # per-chunk cap, further shrunk for wide V
 # the [B,V] assignment/level planes stay VMEM-resident across all grid
 # steps; cap their footprint
@@ -109,6 +109,14 @@ DPLL_STEPS_INTERPRET = 192
 MAX_DECISIONS = 1024
 DPLL_MAX_VARS = 8192
 DPLL_MAX_VARS_INTERPRET = 2048
+# chunked decisions: after DPLL_SINGLE_WINDOW single-var levels, each
+# level assigns the top-K scoring free vars at once.  A conflict that
+# backtracks into a bulk level taints the lane — its exhaustion is no
+# longer a refutation (the discarded companions' phases were never
+# explored), so tainted lanes can claim SAT (host-verified) but report
+# undecided instead of UNSAT.  Completion sweeps drop ~K-fold.
+DPLL_SINGLE_WINDOW = 8
+DPLL_BULK_K = 16
 
 
 def pallas_enabled() -> Optional[bool]:
@@ -155,12 +163,26 @@ class DenseClausePool:
     def __init__(self):
         self.P = None       # [C, V] bf16 on device
         self.N = None
-        self.Pt = None      # [V, C] bf16 (transpose shipped from host)
-        self.Nt = None
         self.width = None   # [1, C] f32
         self.num_vars = 0   # V - 1 usable ids (column == var id)
         self.C = 0
         self.V = 0
+
+    @staticmethod
+    def fits_lane(C: int, V: int, tpu: bool = False) -> bool:
+        """Caps for ONE lane of the per-lane batched layout (already
+        bucketed shapes); the chunker bounds total [B, C, V] cells."""
+        if tpu:
+            return (
+                C <= MAX_CLAUSES_DENSE_TPU
+                and V <= MAX_VARS_DENSE_TPU
+                and C * V * 8 <= MAX_CELLS_DENSE_TPU * 4
+            )
+        return (
+            C <= MAX_CLAUSES_DENSE
+            and V <= MAX_VARS_DENSE
+            and C * V * 8 <= MAX_CELLS_DENSE * 4
+        )
 
     @staticmethod
     def fits(num_clauses: int, num_vars: int, tpu: bool = False) -> bool:
@@ -201,7 +223,7 @@ class DenseClausePool:
             _bucket(max(1, len(pos_r)), floor=256),
             _bucket(max(1, len(neg_r)), floor=256),
         )
-        self.P, self.N, self.Pt, self.Nt, self.width = build(
+        self.P, self.N, self.width = build(
             _pad_coords(pos_r, build.n_pos),
             _pad_coords(pos_c, build.n_pos),
             _pad_coords(neg_r, build.n_neg),
@@ -225,15 +247,14 @@ def _pad_coords(values: List[int], size: int) -> np.ndarray:
 @functools.lru_cache(maxsize=32)
 def _make_incidence_builder(C: int, V: int, n_pos: int, n_neg: int):
     """Jitted device-side incidence build for fixed shapes: scatter the
-    literal coordinates into bf16 [C, V] planes and materialize the
-    transposes on device."""
+    literal coordinates into bf16 [C, V] planes."""
     import jax
     import jax.numpy as jnp
 
     def build(pos_r, pos_c, neg_r, neg_c, width):
         P = jnp.zeros((C, V), dtype=jnp.bfloat16).at[pos_r, pos_c].set(1)
         N = jnp.zeros((C, V), dtype=jnp.bfloat16).at[neg_r, neg_c].set(1)
-        return P, N, P.T, N.T, jnp.asarray(width)
+        return P, N, jnp.asarray(width)
 
     fn = jax.jit(build)
     fn.n_pos = n_pos
@@ -242,9 +263,11 @@ def _make_incidence_builder(C: int, V: int, n_pos: int, n_neg: int):
 
 
 def _tile_c(C: int, V: int) -> int:
-    """Clause-tile height: keep 4 bf16 tiles of [TC, V] under ~4 MB.
-    Never exceeds C (both are powers of two, so TC always divides C)."""
-    return min(C, max(64, min(256, (1 << 19) // V)))
+    """Clause-tile height: keep the two bf16 tiles of [TC, V] under a
+    few MB of VMEM.  Floor 128: the width row's block is [1, TC] and
+    Mosaic requires the last block dim be a multiple of 128.  Never
+    exceeds C (both are powers of two, so TC always divides C)."""
+    return min(C, max(128, min(256, (1 << 19) // V)))
 
 
 def _make_dpll_sweep(
@@ -269,8 +292,12 @@ def _make_dpll_sweep(
     from jax.experimental.pallas import tpu as pltpu
 
     natural = (((1,), (0,)), ((), ()))  # [M,K] x [K,N] -> [M,N]
+    # contract the V axes of [B,V] x [TC,V] -> [B,TC]: the same P/N
+    # tiles serve both matmul directions, so the kernel streams two
+    # incidence planes instead of four (the sweep is HBM-bound)
+    by_v = (((1,), (1,)), ((), ()))
 
-    def kernel(p_ref, n_ref, pt_ref, nt_ref, w_ref, a_ref, *out_refs):
+    def kernel(p_ref, n_ref, w_ref, a_ref, *out_refs):
         if scores:
             fpos_ref, fneg_ref, conf_ref, spos_ref, sneg_ref = out_refs
         else:
@@ -288,22 +315,20 @@ def _make_dpll_sweep(
 
         P = p_ref[:]    # [TC, V]
         N = n_ref[:]
-        Pt = pt_ref[:]  # [V, TC]
-        Nt = nt_ref[:]
         width = w_ref[:]  # [1, TC]
         A = a_ref[:]      # [B, V]
 
         pos = jnp.maximum(A, 0.0).astype(jnp.bfloat16)
         neg = jnp.maximum(-A, 0.0).astype(jnp.bfloat16)
         true_cnt = lax.dot_general(
-            pos, Pt, natural, preferred_element_type=jnp.float32
+            pos, P, by_v, preferred_element_type=jnp.float32
         ) + lax.dot_general(
-            neg, Nt, natural, preferred_element_type=jnp.float32
+            neg, N, by_v, preferred_element_type=jnp.float32
         )  # [B, TC]
         false_cnt = lax.dot_general(
-            neg, Pt, natural, preferred_element_type=jnp.float32
+            neg, P, by_v, preferred_element_type=jnp.float32
         ) + lax.dot_general(
-            pos, Nt, natural, preferred_element_type=jnp.float32
+            pos, N, by_v, preferred_element_type=jnp.float32
         )
         real = width > 0.5
         all_false = real & (false_cnt > width - 0.5)
@@ -351,8 +376,6 @@ def _make_dpll_sweep(
         in_specs=[
             pl.BlockSpec((TC, V), lambda i: (i, 0), memory_space=vm),
             pl.BlockSpec((TC, V), lambda i: (i, 0), memory_space=vm),
-            pl.BlockSpec((V, TC), lambda i: (0, i), memory_space=vm),
-            pl.BlockSpec((V, TC), lambda i: (0, i), memory_space=vm),
             pl.BlockSpec((1, TC), lambda i: (0, i), memory_space=vm),
             pl.BlockSpec((B, V), full, memory_space=vm),
         ],
@@ -363,45 +386,33 @@ def _make_dpll_sweep(
     return call
 
 
-@functools.lru_cache(maxsize=16)
-def make_dense_solve(
-    C: int, V: int, B: int, steps: int, interpret: bool,
-    max_decisions: int = MAX_DECISIONS,
-):
-    """Build the DPLL solve function for fixed (clauses, vars, lanes).
+def _dpll_solve_loop(sweep, B, V, steps, max_decisions):
+    """Shared DPLL control loop around a sweep callable.
 
-    Returns fn(P[C,V]bf16, N[C,V]bf16, Pt[V,C]bf16, Nt[V,C]bf16,
-    width[1,C]f32, A0[B,V]f32) -> (A[B,V]f32, status[B,1]i32) with
-    status 2 = UNSAT (BCP conflict at zero decisions OR exhausted
-    search — both sound under clause subsets), 1 = complete satisfying
-    assignment for the device clause set (host must verify against the
-    original terms), 0 = undecided (budget).  The clause scans run as
-    tiled Pallas kernels; the DPLL control loop is plain lax around
-    them (everything compiles to one XLA program).  The search is
-    deterministic.
-
-    ``max_decisions=0`` disables the search (BCP-only, for cones past
-    the stack budget) and skips the score matmuls in the sweep.
+    ``sweep(P, N, width, A)`` returns (fpos, fneg, conf[, spos, sneg])
+    as [B, V] / [B, 1] planes; the loop is agnostic to how the clause
+    scan is realized (tiled Pallas kernel over a shared [C, V] pool, or
+    batched XLA dots over per-lane [B, C, V] planes).
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    TC = _tile_c(C, V)
     decisions_on = max_decisions > 0
-    sweep = _make_dpll_sweep(C, V, B, TC, interpret, decisions_on)
     D = max(1, min(max_decisions, V))  # stack planes ([B, D])
 
-    def solve(P, N, Pt, Nt, width, A0):
+    def solve(P, N, width, A0):
         col = lax.broadcasted_iota(jnp.int32, (B, V), 1)
         dcol = lax.broadcasted_iota(jnp.int32, (B, D), 1)  # slot l ↔ level l+1
+        krow = jnp.arange(DPLL_BULK_K)[None, :]            # [1, K]
 
         def body(carry):
-            A, lvl, dvar, dphase, dflip, depth, status, step = carry
+            (A, lvl, dvar, dphase, dflip, dbulk, depth, status, taint,
+             step) = carry
             if decisions_on:
-                fpos, fneg, conf, spos, sneg = sweep(P, N, Pt, Nt, width, A)
+                fpos, fneg, conf, spos, sneg = sweep(P, N, width, A)
             else:
-                fpos, fneg, conf = sweep(P, N, Pt, Nt, width, A)
+                fpos, fneg, conf = sweep(P, N, width, A)
             free = (A == 0.0) & (col > 1)  # col 1 = constant-TRUE anchor
             force_pos = (fpos > 0.5) & free
             force_neg = (fneg > 0.5) & free
@@ -429,66 +440,109 @@ def make_dense_solve(
             lvl1 = jnp.where(do_bt & (col == bvar), Lm, lvl)
             popped = do_bt & (dcol >= Lm)                  # slots above Lm
             at_b = do_bt & (dcol == bslot)
+            # flipping (or popping) a bulk level discards its companion
+            # branches unexplored: the lane's exhaustion is no longer a
+            # refutation
+            bulk_popped = jnp.any(
+                popped & (dbulk > 0.5), axis=1, keepdims=True
+            ) | (jnp.take_along_axis(dbulk, bslot, axis=1) > 0.5)
+            taint1 = jnp.where(do_bt & bulk_popped, 1.0, taint)
             dvar1 = jnp.where(popped, 0, dvar)
             dphase1 = jnp.where(popped, 0.0, jnp.where(at_b, bphase, dphase))
             dflip1 = jnp.where(popped, 0.0, jnp.where(at_b, 1.0, dflip))
+            dbulk1 = jnp.where(popped | at_b, 0.0, dbulk)
             depth1 = jnp.where(do_bt, Lm, depth)
 
             # --- no conflict, forced literals: assign them at this level
+            # (they are implied by pre-sweep assignments, so they belong
+            # to the pre-decision level even when a decision is fused
+            # into the same sweep below)
             do_force = active & ~conflict & has_force
-            assigned_now = do_force & (force_pos | force_neg) & ~(
-                force_pos & force_neg
-            )
+            forced = force_pos | force_neg
+            assigned_now = do_force & forced & ~(force_pos & force_neg)
             delta = jnp.where(force_pos, 1.0, -1.0)
             A2 = jnp.where(assigned_now, delta, A1)
             lvl2 = jnp.where(assigned_now, depth, lvl1)
 
-            # --- quiet and open: decide (dynamic DLIS var + polarity)
-            want = active & ~conflict & ~has_force & open_any
+            # --- decide at BCP quiescence (dynamic DLIS vars +
+            # polarity).  Measured on the captured scale dispatch:
+            # fusing decisions into forcing sweeps (speculating on
+            # stale scores mid-propagation) *increased* total sweeps
+            # ~2.5x through conflict/redo churn — classic alternation
+            # wins even though carry chains ripple one level per sweep.
+            want = active & ~conflict & open_any & ~has_force
             if decisions_on:
                 can = depth < D
+                in_bulk = depth >= DPLL_SINGLE_WINDOW       # [B,1]
                 do_dec = want & can
                 bail = want & ~can
-                score = jnp.where(free, spos + sneg + 1.0, -1.0)
-                var = jnp.argmax(score, axis=1)[:, None]   # [B,1]
-                sp = jnp.take_along_axis(spos, var, axis=1)
-                sn = jnp.take_along_axis(sneg, var, axis=1)
-                phase = jnp.where(sp >= sn, 1.0, -1.0)
+                score = jnp.where(
+                    free & ~forced, spos + sneg + 1.0, -1.0
+                )
+                vals, idxs = lax.top_k(score, DPLL_BULK_K)  # [B,K]
+                # single-var levels inside the refutation window keep
+                # exhaustion sound; past it, levels take the top-K vars
+                # at once (taint handles the lost refutation power)
+                keep = (vals > 0.0) & ((krow == 0) | in_bulk)
+                any_kept = jnp.any(keep, axis=1, keepdims=True)
+                do_dec = do_dec & any_kept
+                chosen = jnp.any(
+                    (col[:, :, None] == idxs[:, None, :])
+                    & keep[:, None, :],
+                    axis=2,
+                )                                           # [B,V]
+                ph_full = jnp.where(spos >= sneg, 1.0, -1.0)
+                primary = idxs[:, :1]
+                phase = jnp.take_along_axis(ph_full, primary, axis=1)
+                # a level is "bulk" (taints on backtrack) only when it
+                # takes >= 2 genuinely-constrained vars (score >= 2);
+                # don't-care companions (score == 1) provably cannot
+                # affect any open clause, so flipping just the primary
+                # remains a valid refutation of the level
+                real_keep = keep & (vals > 1.5)
+                is_bulk = (
+                    jnp.sum(real_keep.astype(jnp.int32), axis=1,
+                            keepdims=True) > 1
+                ).astype(jnp.float32)
                 ndepth = depth + 1
                 # don't-care cascade: a free var in NO open clause has
-                # every containing clause already satisfied (no units or
-                # conflicts exist in the decide branch), so any phase is
-                # safe — assign them all in bulk at the new level (they
+                # every containing clause already satisfied, so any
+                # phase is safe — assign them all at the new level (they
                 # pop with it on backtrack).  EVM cones are mostly
                 # don't-cares once the constrained core is satisfied;
                 # without this, completion costs one decision per var.
-                dontcare = free & (spos + sneg < 0.5)
-                newly = do_dec & (dontcare | (col == var))
+                dontcare = free & ~forced & (spos + sneg < 0.5)
+                newly = do_dec & (dontcare | chosen)
                 A3 = jnp.where(
-                    newly, jnp.where(col == var, phase, 1.0), A2
+                    newly, jnp.where(chosen, ph_full, 1.0), A2
                 )
                 lvl3 = jnp.where(newly, ndepth, lvl2)
                 at_new = do_dec & (dcol == depth)
-                dvar2 = jnp.where(at_new, var, dvar1)
+                dvar2 = jnp.where(at_new, primary, dvar1)
                 dphase2 = jnp.where(at_new, phase, dphase1)
                 dflip2 = jnp.where(at_new, 0.0, dflip1)
+                dbulk2 = jnp.where(at_new, is_bulk, dbulk1)
                 depth2 = jnp.where(do_dec, ndepth, depth1)
             else:
                 bail = want
                 A3, lvl3 = A2, lvl2
                 dvar2, dphase2, dflip2, depth2 = dvar1, dphase1, dflip1, depth1
+                dbulk2 = dbulk1
 
             # --- quiet and complete: SAT candidate
             done_sat = active & ~conflict & ~has_force & ~open_any
 
-            status1 = jnp.where(unsat_now, 2, status)
+            # tainted exhaustion is NOT a refutation — report undecided
+            status1 = jnp.where(
+                unsat_now, jnp.where(taint1 > 0.5, 3, 2), status
+            )
             status1 = jnp.where(done_sat, 1, status1)
             status1 = jnp.where(bail, 3, status1)  # 3 = budget-bailed
-            return (A3, lvl3, dvar2, dphase2, dflip2, depth2, status1,
-                    step + 1)
+            return (A3, lvl3, dvar2, dphase2, dflip2, dbulk2, depth2,
+                    status1, taint1, step + 1)
 
         def cond(carry):
-            status, step = carry[6], carry[7]
+            status, step = carry[7], carry[9]
             return jnp.any(status == 0) & (step < steps)
 
         init = (
@@ -497,15 +551,142 @@ def make_dense_solve(
             jnp.zeros((B, D), dtype=jnp.int32),
             jnp.zeros((B, D), dtype=jnp.float32),
             jnp.zeros((B, D), dtype=jnp.float32),
+            jnp.zeros((B, D), dtype=jnp.float32),
             jnp.zeros((B, 1), dtype=jnp.int32),
             jnp.zeros((B, 1), dtype=jnp.int32),
+            jnp.zeros((B, 1), dtype=jnp.float32),
             jnp.int32(0),
         )
-        A, _, _, _, _, _, status, _ = lax.while_loop(cond, body, init)
+        out = lax.while_loop(cond, body, init)
+        A, status, steps_used = out[0], out[7], out[9]
         status = jnp.where(status == 3, 0, status)  # bailed = undecided
-        return A, status
+        return A, status, steps_used
 
     return jax.jit(solve)
+
+
+
+@functools.lru_cache(maxsize=16)
+def make_dense_solve(
+    C: int, V: int, B: int, steps: int, interpret: bool,
+    max_decisions: int = MAX_DECISIONS,
+):
+    """Build the DPLL solve function for fixed (clauses, vars, lanes).
+
+    Returns fn(P[C,V]bf16, N[C,V]bf16, width[1,C]f32, A0[B,V]f32)
+    -> (A[B,V]f32, status[B,1]i32, steps_used i32) with
+    status 2 = UNSAT (BCP conflict at zero decisions OR exhausted
+    search — both sound under clause subsets), 1 = complete satisfying
+    assignment for the device clause set (host must verify against the
+    original terms), 0 = undecided (budget).  The clause scans run as
+    tiled Pallas kernels; the DPLL control loop is plain lax around
+    them (everything compiles to one XLA program).  The search is
+    deterministic.
+
+    ``max_decisions=0`` disables the search (BCP-only, for cones past
+    the stack budget) and skips the score matmuls in the sweep.
+    """
+    TC = _tile_c(C, V)
+    sweep = _make_dpll_sweep(C, V, B, TC, interpret, max_decisions > 0)
+    return _dpll_solve_loop(sweep, B, V, steps, max_decisions)
+
+
+@functools.lru_cache(maxsize=16)
+def make_batched_solve(
+    C: int, V: int, B: int, steps: int,
+    max_decisions: int = MAX_DECISIONS,
+):
+    """Per-lane-cone DPLL: each lane owns its own remapped incidence
+    planes ``P/N [B, C, V]`` and the sweeps are *batched* matmuls.
+
+    Frontier batches are usually block-diagonal — sibling queries share
+    a prefix, but across functions/guards the cones are disjoint — so a
+    union-cone dense matrix wastes most of its cells (and the HBM
+    bandwidth to stream them) on cross-lane zeros.  Remapping each lane
+    into its own compact variable space makes total sweep data
+    ``Σ C_l·V_l`` instead of ``(Σ C_l)·(Σ V_l)``: measured 16x less on
+    a 16-lane disjoint-guard dispatch.  Plain jnp/lax (XLA lowers
+    batched dots onto the MXU and handles the streaming); the DPLL
+    control flow is identical to ``make_dense_solve``.
+
+    Returns fn(P[B,C,V]bf16, N[B,C,V]bf16, width[B,C]f32, A0[B,V]f32)
+    -> (A[B,V]f32, status[B,1]i32, steps_used i32).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    D = max(1, min(max_decisions, V))
+    decisions_on = max_decisions > 0
+    # lhs [B,V] x rhs [B,C,V], contract V, batch B -> [B,C]
+    by_v = (((1,), (2,)), ((0,), (0,)))
+    # lhs [B,C] x rhs [B,C,V], contract C, batch B -> [B,V]
+    by_c = (((1,), (1,)), ((0,), (0,)))
+
+    def sweep(P, N, width, A):
+        pos = jnp.maximum(A, 0.0).astype(jnp.bfloat16)
+        neg = jnp.maximum(-A, 0.0).astype(jnp.bfloat16)
+        true_cnt = lax.dot_general(
+            pos, P, by_v, preferred_element_type=jnp.float32
+        ) + lax.dot_general(
+            neg, N, by_v, preferred_element_type=jnp.float32
+        )  # [B, C]
+        false_cnt = lax.dot_general(
+            neg, P, by_v, preferred_element_type=jnp.float32
+        ) + lax.dot_general(
+            pos, N, by_v, preferred_element_type=jnp.float32
+        )
+        real = width > 0.5
+        all_false = real & (false_cnt > width - 0.5)
+        unk_cnt = width - true_cnt - false_cnt
+        unsat_yet = (true_cnt < 0.5) & real
+        unit = unsat_yet & (unk_cnt > 0.5) & (unk_cnt < 1.5)
+        u = unit.astype(jnp.bfloat16)
+        fpos = lax.dot_general(
+            u, P, by_c, preferred_element_type=jnp.float32
+        )
+        fneg = lax.dot_general(
+            u, N, by_c, preferred_element_type=jnp.float32
+        )
+        conf = jnp.any(all_false, axis=1, keepdims=True).astype(
+            jnp.float32
+        )
+        if decisions_on:
+            open_c = unsat_yet & (unk_cnt > 1.5)
+            o = open_c.astype(jnp.bfloat16)
+            spos = lax.dot_general(
+                o, P, by_c, preferred_element_type=jnp.float32
+            )
+            sneg = lax.dot_general(
+                o, N, by_c, preferred_element_type=jnp.float32
+            )
+            return fpos, fneg, conf, spos, sneg
+        return fpos, fneg, conf
+
+    return _dpll_solve_loop(sweep, B, V, steps, max_decisions)
+
+
+@functools.lru_cache(maxsize=32)
+def _make_lane_incidence_builder(B: int, C: int, V: int, n_pos: int,
+                                 n_neg: int):
+    """Jitted device-side per-lane incidence build: scatter (lane, row,
+    col) coordinates into bf16 [B, C, V] planes."""
+    import jax
+    import jax.numpy as jnp
+
+    def build(pos_l, pos_r, pos_c, neg_l, neg_r, neg_c, width):
+        P = jnp.zeros((B, C, V), dtype=jnp.bfloat16).at[
+            pos_l, pos_r, pos_c
+        ].set(1)
+        N = jnp.zeros((B, C, V), dtype=jnp.bfloat16).at[
+            neg_l, neg_r, neg_c
+        ].set(1)
+        return P, N, jnp.asarray(width)
+
+    fn = jax.jit(build)
+    fn.n_pos = n_pos
+    fn.n_neg = n_neg
+    return fn
 
 
 class PallasSatBackend:
@@ -517,14 +698,25 @@ class PallasSatBackend:
         # only the cheap forced-off check: the full availability probe
         # (device_ok/backend_name) can cold-start the TPU client, so it
         # runs inside check_assumption_sets AFTER the host-side cone
-        # fits() gate has shown a dispatch is even possible
+        # layout gate has shown a dispatch is even possible
         return pallas_enabled() is not False
 
     def check_assumption_sets(
         self, ctx, assumption_sets: List[List[int]], search: bool = True
     ) -> Optional[Tuple[List[Optional[bool]], np.ndarray]]:
-        """None when the per-call cone exceeds the dense caps (the
-        caller falls through to the gather backend).
+        """None when no dense layout fits the caps (the caller falls
+        through to the gather backend).
+
+        Two layouts compete per dispatch, picked by estimated streamed
+        cells:
+
+        - **union**: one [C, V] pool over the union cone, all lanes
+          sweep it together — wins when lanes share most of their cone
+          (sibling forks of one path);
+        - **per-lane batched**: each lane remapped into its own compact
+          space, planes [B, C_max, V_max], batched matmuls — wins when
+          cones are mostly disjoint (frontiers spanning functions or
+          contracts), where the union matrix is block-diagonal zeros.
 
         ``search=False`` disables the DPLL decision stack (BCP-only
         sweeps, sound UNSAT detection still on); it is also disabled
@@ -533,39 +725,46 @@ class PallasSatBackend:
 
         # once the health probe has run its verdict is cached, so the
         # availability check is cheap — rejecting here skips the cone
-        # union + remap work on hosts where the device is known-unusable
+        # work entirely on hosts where the device is known-unusable
         if probe_completed() and not _use_pallas():
             return None
-        # host-side cone extraction over the union of all lanes' roots
-        # FIRST: the fits() verdict needs no device, and initializing
-        # the backend (a cold TPU tunnel client costs ~7 s) would be
-        # pure waste for cones the dense kernel can never take
-        all_lits = sorted({l for lits in assumption_sets for l in lits})
-        clause_idx, cone_vars = ctx.cone(all_lits)
-        # size gate before paying for the remap dict: the remap is
-        # exactly anchor + cone vars (every assumption var is a cone
-        # root), and the TPU tier is the largest any backend offers —
-        # failing it here means no backend can take the dispatch, with
-        # zero backend-init cost
-        cone_var_count = 1 + len(cone_vars)
-        if not DenseClausePool.fits(len(clause_idx), cone_var_count, tpu=True):
+        # host-side cone extraction FIRST: the layout/fits verdict needs
+        # no device, and initializing the backend (a cold TPU tunnel
+        # client costs ~7 s) would be pure waste for impossible cones
+        lane_cones = [ctx.cone(lits) for lits in assumption_sets]
+        batch = len(assumption_sets)
+        union_ci = np.unique(np.concatenate(
+            [ci for ci, _ in lane_cones]
+        )) if lane_cones else np.empty(0, np.int64)
+        union_cv = np.unique(np.concatenate(
+            [cv for _, cv in lane_cones]
+        )) if lane_cones else np.empty(0, np.int64)
+        union_C = _bucket(max(1, len(union_ci)))
+        union_V = _bucket(len(union_cv) + 2)
+        max_C = _bucket(max(1, max(len(ci) for ci, _ in lane_cones)))
+        max_V = _bucket(2 + max(len(cv) for _, cv in lane_cones))
+        B_bucket = max(8, _bucket(batch, floor=8))
+
+        union_chunks = -(-batch // max(
+            1, min(MAX_LANES, MAX_LANE_CELLS // union_V)
+        ))
+        est_union = union_C * union_V * union_chunks
+        est_batched = B_bucket * max_C * max_V
+        union_ok = DenseClausePool.fits(
+            len(union_ci), len(union_cv) + 1, tpu=True
+        )
+        batched_ok = DenseClausePool.fits_lane(
+            max_C, max_V, tpu=True
+        )
+        if not union_ok and not batched_ok:
             log.debug(
-                "cone too large for dense kernel (%d clauses, %d vars)",
-                len(clause_idx), cone_var_count,
+                "no dense layout fits (union %dx%d, per-lane %dx%d)",
+                union_C, union_V, max_C, max_V,
             )
             return None  # caller falls through to the gather backend
-        # every assumption var is a cone root, so the remap is exactly
-        # anchor + cone vars — the lower bound above was the exact count
-        remap = {1: 1}
-        for var in cone_vars.tolist():  # already sorted
-            if var not in remap:
-                remap[var] = len(remap) + 1
-        num_cone_vars = len(remap)
 
         if not _use_pallas():
             return None  # unhealthy device / CPU backend not forced
-
-        import jax.numpy as jnp
 
         from mythril_tpu.ops import configure_jax
         from mythril_tpu.ops.device_health import backend_name
@@ -575,12 +774,52 @@ class PallasSatBackend:
         # deadline (a direct jax.default_backend() here could be the
         # process's first backend init and hang on a wedged tunnel)
         interpret = backend_name() != "tpu"
-        if interpret and not DenseClausePool.fits(
-            len(clause_idx), num_cone_vars, tpu=False
-        ):
+        if interpret:
             # only a real TPU chews through the large tier; interpret
             # mode (tests, degraded hosts) keeps the small caps
-            return None
+            union_ok = union_ok and DenseClausePool.fits(
+                len(union_ci), len(union_cv) + 1, tpu=False
+            )
+            batched_ok = batched_ok and DenseClausePool.fits_lane(
+                max_C, max_V, tpu=False
+            )
+            if not union_ok and not batched_ok:
+                return None
+
+        use_batched = batched_ok and (
+            not union_ok or est_batched < est_union
+        )
+        if use_batched:
+            statuses, assignments = self._solve_batched(
+                ctx, assumption_sets, lane_cones, max_C, max_V,
+                interpret, search,
+            )
+        else:
+            statuses, assignments = self._solve_union(
+                ctx, assumption_sets, union_ci, union_cv, interpret,
+                search,
+            )
+        results: List[Optional[bool]] = [
+            False if statuses[i] == 2 else None for i in range(batch)
+        ]
+        return results, assignments
+
+    def _solve_union(
+        self, ctx, assumption_sets, clause_idx, cone_vars, interpret,
+        search,
+    ):
+        """Union-cone layout: one shared [C, V] incidence pool."""
+        import jax.numpy as jnp
+
+        from mythril_tpu.ops.batched_sat import dispatch_stats
+
+        # every assumption var is a cone root, so the remap is exactly
+        # anchor + cone vars
+        remap = {1: 1}
+        for var in cone_vars.tolist():  # already sorted
+            if var not in remap:
+                remap[var] = len(remap) + 1
+        num_cone_vars = len(remap)
         batch = len(assumption_sets)
         orig_v1 = ctx.solver.num_vars + 1
         assignments = np.zeros((batch, orig_v1), dtype=np.int8)
@@ -624,10 +863,10 @@ class PallasSatBackend:
             step = make_dense_solve(
                 pool.C, V, B, steps, interpret, decisions
             )
-            A, st = step(
-                pool.P, pool.N, pool.Pt, pool.Nt, pool.width,
-                jnp.asarray(A0),
+            A, st, steps_used = step(
+                pool.P, pool.N, pool.width, jnp.asarray(A0),
             )
+            dispatch_stats.device_sweeps += int(steps_used)
             n = len(chunk)
             A_host = np.asarray(A, dtype=np.float32)[:n]
             statuses[start : start + n] = np.asarray(st)[:n, 0]
@@ -637,11 +876,99 @@ class PallasSatBackend:
                 assignments[start + lane, inverse[1:num_cone_vars + 1]] = (
                     signs[lane, 1 : num_cone_vars + 1]
                 )
+        return statuses, assignments
 
-        results: List[Optional[bool]] = [
-            False if statuses[i] == 2 else None for i in range(batch)
-        ]
-        return results, assignments
+    def _solve_batched(
+        self, ctx, assumption_sets, lane_cones, max_C, max_V, interpret,
+        search,
+    ):
+        """Per-lane-cone layout: [B, C, V] planes, batched matmuls."""
+        import jax.numpy as jnp
+
+        from mythril_tpu.ops.batched_sat import dispatch_stats
+
+        batch = len(assumption_sets)
+        orig_v1 = ctx.solver.num_vars + 1
+        assignments = np.zeros((batch, orig_v1), dtype=np.int8)
+        assignments[:, 1] = 1
+        statuses = np.zeros(batch, dtype=np.int32)
+
+        cells = max_C * max_V
+        chunk_lanes = max(
+            1, min(MAX_LANES, (MAX_CELLS_DENSE_TPU * 2) // cells)
+        )
+        steps = DPLL_STEPS_INTERPRET if interpret else DPLL_STEPS
+        search_ceiling = (
+            DPLL_MAX_VARS_INTERPRET if interpret else DPLL_MAX_VARS
+        )
+        decisions = (
+            MAX_DECISIONS if (search and max_V <= search_ceiling) else 0
+        )
+        for start in range(0, batch, chunk_lanes):
+            chunk = assumption_sets[start : start + chunk_lanes]
+            chunk_cones = lane_cones[start : start + chunk_lanes]
+            B = max(8, _bucket(len(chunk), floor=8))
+            A0 = np.zeros((B, max_V), dtype=np.float32)
+            A0[:, 1] = 1.0
+            A0[len(chunk):, :] = 1.0  # pad lanes fully assigned
+            width = np.zeros((B, max_C), dtype=np.float32)
+            pos_l, pos_r, pos_c = [], [], []
+            neg_l, neg_r, neg_c = [], [], []
+            inverses = []
+            for lane, (lits, (ci, cv)) in enumerate(
+                zip(chunk, chunk_cones)
+            ):
+                remap = {1: 1}
+                for var in cv.tolist():
+                    if var not in remap:
+                        remap[var] = len(remap) + 1
+                inverse = np.zeros(len(remap) + 1, dtype=np.int64)
+                for var, colx in remap.items():
+                    inverse[colx] = var
+                inverses.append(inverse)
+                A0[lane, len(remap) + 1:] = 1.0  # per-lane padding cols
+                for row, cix in enumerate(ci.tolist()):
+                    clause = ctx.clauses_py[cix]
+                    width[lane, row] = len(clause)
+                    for lit in clause:
+                        if lit > 0:
+                            pos_l.append(lane)
+                            pos_r.append(row)
+                            pos_c.append(remap[lit])
+                        else:
+                            neg_l.append(lane)
+                            neg_r.append(row)
+                            neg_c.append(remap[-lit])
+                for lit in lits:
+                    A0[lane, remap[abs(lit)]] = 1.0 if lit > 0 else -1.0
+            build = _make_lane_incidence_builder(
+                B, max_C, max_V,
+                _bucket(max(1, len(pos_l)), floor=256),
+                _bucket(max(1, len(neg_l)), floor=256),
+            )
+            P, N, W = build(
+                _pad_coords(pos_l, build.n_pos),
+                _pad_coords(pos_r, build.n_pos),
+                _pad_coords(pos_c, build.n_pos),
+                _pad_coords(neg_l, build.n_neg),
+                _pad_coords(neg_r, build.n_neg),
+                _pad_coords(neg_c, build.n_neg),
+                width,
+            )
+            step = make_batched_solve(max_C, max_V, B, steps, decisions)
+            A, st, steps_used = step(P, N, W, jnp.asarray(A0))
+            dispatch_stats.device_sweeps += int(steps_used)
+            n = len(chunk)
+            A_host = np.asarray(A, dtype=np.float32)[:n]
+            statuses[start : start + n] = np.asarray(st)[:n, 0]
+            signs = np.sign(A_host).astype(np.int8)
+            for lane in range(n):
+                inverse = inverses[lane]
+                ncols = len(inverse) - 1
+                assignments[start + lane, inverse[1:]] = (
+                    signs[lane, 1 : ncols + 1]
+                )
+        return statuses, assignments
 
 
 _pallas_backend: Optional[PallasSatBackend] = None

@@ -138,9 +138,8 @@ def test_differential_random_cnf_vs_cdcl():
         A0[:, 1] = 1.0
         A0[:, num_vars + 2:] = 1.0  # bucket padding: preassigned
         step = make_dense_solve(pool.C, pool.V, B, 96, True)
-        A, st = step(
-            pool.P, pool.N, pool.Pt, pool.Nt, pool.width,
-            jnp.asarray(A0),
+        A, st, _steps = step(
+            pool.P, pool.N, pool.width, jnp.asarray(A0),
         )
         status = int(np.asarray(st)[0, 0])
         truths.append(truth)
@@ -192,9 +191,8 @@ def test_dpll_decides_where_bcp_cannot():
         A0[:, 1] = 1.0
         A0[:, num_vars + 1:] = 1.0  # bucket padding: preassigned
         step = make_dense_solve(pool.C, pool.V, B, 192, True)
-        A, st = step(
-            pool.P, pool.N, pool.Pt, pool.Nt, pool.width,
-            jnp.asarray(A0),
+        A, st, _steps = step(
+            pool.P, pool.N, pool.width, jnp.asarray(A0),
         )
         status = int(np.asarray(st)[0, 0])
         assert status == want, f"want {want}, got {status}"
@@ -221,9 +219,8 @@ def test_wide_clauses_not_dropped():
     A0 = np.zeros((B, pool.V), dtype=np.float32)
     A0[:, 1] = 1.0
     step = make_dense_solve(pool.C, pool.V, B, 4, True)
-    _, st = step(
-        pool.P, pool.N, pool.Pt, pool.Nt, pool.width,
-        jnp.asarray(A0),
+    _, st, _steps = step(
+        pool.P, pool.N, pool.width, jnp.asarray(A0),
     )
     assert int(np.asarray(st)[0, 0]) == 2
 
@@ -358,3 +355,67 @@ def test_fuse_retry_rearms_on_decision(monkeypatch):
     assert verdicts == [False, False]
     assert backend.fused_generation != ctx.generation  # re-armed
     assert BS.dispatch_stats.fused is False
+
+
+def test_bulk_completion_deep_sat_never_unsat():
+    """Planted-satisfiable instances deep enough to leave the single-var
+    window (> DPLL_SINGLE_WINDOW constrained decisions) exercise bulk
+    levels and their taint bookkeeping: the kernel must never report
+    UNSAT for a satisfiable instance, and a completion must satisfy
+    every clause."""
+    import jax.numpy as jnp
+
+    from mythril_tpu.ops.pallas_prop import DPLL_SINGLE_WINDOW
+
+    rng = random.Random(99)
+    for trial in range(4):
+        num_vars = 40
+        planted = {
+            v: rng.choice([True, False]) for v in range(2, num_vars + 2)
+        }
+        clauses = []
+        for _ in range(140):
+            picks = rng.sample(sorted(planted), 3)
+            lits = [
+                v if rng.random() < 0.5 else -v for v in picks
+            ]
+            # force at least one literal true under the planted model
+            w = picks[0]
+            lits[0] = w if planted[w] else -w
+            clauses.append(tuple(lits))
+        pool = DenseClausePool()
+        pool.refresh(clauses, num_vars + 2)
+        B = 8
+        A0 = np.zeros((B, pool.V), dtype=np.float32)
+        A0[:, 1] = 1.0
+        A0[:, num_vars + 2:] = 1.0
+        step = make_dense_solve(pool.C, pool.V, B, 192, True)
+        A, st, _ = step(pool.P, pool.N, pool.width, jnp.asarray(A0))
+        status = int(np.asarray(st)[0, 0])
+        assert status != 2, f"trial {trial}: UNSAT claimed on SAT instance"
+        if status == 1:
+            signs = np.sign(np.asarray(A))[0]
+            for clause in clauses:
+                assert any(
+                    signs[abs(l)] == (1 if l > 0 else -1) for l in clause
+                ), f"trial {trial}: bulk completion violates {clause}"
+    assert num_vars > 2 * DPLL_SINGLE_WINDOW  # instances leave the window
+
+
+def test_unsat_within_single_window_still_refutes():
+    """An unsatisfiable core whose refutation fits the single-var window
+    must still produce the sound status 2 — the taint machinery may
+    only downgrade refutations that crossed a bulk level."""
+    import jax.numpy as jnp
+
+    # (a|b)(a|-b)(-a|b)(-a|-b): refuted with one decision + one flip
+    clauses = [(2, 3), (2, -3), (-2, 3), (-2, -3)]
+    pool = DenseClausePool()
+    pool.refresh(clauses, 4)
+    B = 8
+    A0 = np.zeros((B, pool.V), dtype=np.float32)
+    A0[:, 1] = 1.0
+    A0[:, 4:] = 1.0
+    step = make_dense_solve(pool.C, pool.V, B, 64, True)
+    _, st, _ = step(pool.P, pool.N, pool.width, jnp.asarray(A0))
+    assert int(np.asarray(st)[0, 0]) == 2
