@@ -210,14 +210,19 @@ class DenseClausePool:
         pos_r, pos_c, neg_r, neg_c = [], [], [], []
         width = np.zeros((1, C), dtype=np.float32)
         for c, clause in enumerate(clauses_py):
-            for lit in clause:
+            lits = set(clause)
+            if any(-l in lits for l in lits):
+                continue  # tautology: always satisfied, width stays 0
+            for lit in lits:
                 if lit > 0:
                     pos_r.append(c)
                     pos_c.append(lit)
                 else:
                     neg_r.append(c)
                     neg_c.append(-lit)
-            width[0, c] = len(clause)
+            # the incidence cell collapses duplicates, so width must
+            # count UNIQUE literals or conflicts/units are missed
+            width[0, c] = len(lits)
         build = _make_incidence_builder(
             C, V,
             _bucket(max(1, len(pos_r)), floor=256),
@@ -728,6 +733,8 @@ class PallasSatBackend:
         # work entirely on hosts where the device is known-unusable
         if probe_completed() and not _use_pallas():
             return None
+        if not assumption_sets:
+            return [], np.zeros((0, ctx.solver.num_vars + 1), np.int8)
         # host-side cone extraction FIRST: the layout/fits verdict needs
         # no device, and initializing the backend (a cold TPU tunnel
         # client costs ~7 s) would be pure waste for impossible cones
@@ -894,9 +901,15 @@ class PallasSatBackend:
         statuses = np.zeros(batch, dtype=np.int32)
 
         cells = max_C * max_V
-        chunk_lanes = max(
-            1, min(MAX_LANES, (MAX_CELLS_DENSE_TPU * 2) // cells)
+        budget_cells = 2 * (
+            MAX_CELLS_DENSE if interpret else MAX_CELLS_DENSE_TPU
         )
+        lanes_budget = max(1, budget_cells // cells)
+        # floor to a power of two so the bucketed B never exceeds the
+        # budget the chunk was sized for
+        chunk_lanes = 1
+        while chunk_lanes * 2 <= min(MAX_LANES, lanes_budget):
+            chunk_lanes *= 2
         steps = DPLL_STEPS_INTERPRET if interpret else DPLL_STEPS
         search_ceiling = (
             DPLL_MAX_VARS_INTERPRET if interpret else DPLL_MAX_VARS
@@ -907,7 +920,7 @@ class PallasSatBackend:
         for start in range(0, batch, chunk_lanes):
             chunk = assumption_sets[start : start + chunk_lanes]
             chunk_cones = lane_cones[start : start + chunk_lanes]
-            B = max(8, _bucket(len(chunk), floor=8))
+            B = _bucket(len(chunk), floor=min(8, chunk_lanes))
             A0 = np.zeros((B, max_V), dtype=np.float32)
             A0[:, 1] = 1.0
             A0[len(chunk):, :] = 1.0  # pad lanes fully assigned
@@ -928,9 +941,11 @@ class PallasSatBackend:
                 inverses.append(inverse)
                 A0[lane, len(remap) + 1:] = 1.0  # per-lane padding cols
                 for row, cix in enumerate(ci.tolist()):
-                    clause = ctx.clauses_py[cix]
-                    width[lane, row] = len(clause)
-                    for lit in clause:
+                    clause_lits = set(ctx.clauses_py[cix])
+                    if any(-l in clause_lits for l in clause_lits):
+                        continue  # tautology: width stays 0 (inert row)
+                    width[lane, row] = len(clause_lits)
+                    for lit in clause_lits:
                         if lit > 0:
                             pos_l.append(lane)
                             pos_r.append(row)

@@ -244,14 +244,26 @@ class BlastContext:
         """
         clause_parts = []
         var_parts = []
+        fresh_roots = []
         for root in root_lits:
             var = abs(root)
             if var <= 1:
                 continue
             cached = self._cone_cache.get(var)
             if cached is None:
-                cached = self._cone_of_var(var)
-                self._cone_cache[var] = cached
+                fresh_roots.append(var)
+                continue
+            clause_parts.append(cached[0])
+            var_parts.append(cached[1])
+        # every fresh root gets a complete cached cone: queries share
+        # their prefix constraints, so the cold walk amortizes across
+        # the whole analysis.  (A delta-walk variant — fresh roots
+        # walked against pre-absorbed sibling cones and left uncached —
+        # was measured 2-3x SLOWER end-to-end: uncached roots re-walk
+        # on every later query that shares them.)
+        for var in fresh_roots:
+            cached = self._cone_of_var(var)
+            self._cone_cache[var] = cached
             clause_parts.append(cached[0])
             var_parts.append(cached[1])
         if not clause_parts:
@@ -297,11 +309,26 @@ class BlastContext:
         # seen-sets stay Python sets so a small cone costs O(cone), not
         # O(pool) (full-pool bool masks made many-small-cones workloads
         # quadratic in pool size); only the per-level literal gather is
-        # vectorized over the CSR
+        # vectorized over the CSR.  Absorbed cached sub-cones are NOT
+        # splatted into the set (a 50k-var cached cone costs 50k set
+        # inserts per absorption, which dominated cold walks on
+        # deep-term workloads) — they are kept as sorted arrays and
+        # frontier candidates are screened against them vectorized.
+        from bisect import bisect_left
+
         seen_vars = set()
+        absorbed_vars: List[np.ndarray] = []
         seen_clauses = set()
         clause_parts = []
         frontier = [root_var]
+
+        def in_absorbed(v: int) -> bool:
+            for arr in absorbed_vars:
+                i = bisect_left(arr, v)
+                if i < len(arr) and arr[i] == v:
+                    return True
+            return False
+
         while frontier:
             clause_ids: List[int] = []
             for var in frontier:
@@ -311,13 +338,36 @@ class BlastContext:
                 hit = self._cone_cache.get(var)
                 if hit is not None:
                     clause_parts.append(hit[0])
-                    seen_vars.update(hit[1].tolist())
+                    absorbed_vars.append(hit[1])
+                    if len(absorbed_vars) > 8:
+                        # keep membership screening O(log n): merge
+                        # instead of scanning many arrays per literal
+                        absorbed_vars = [
+                            np.unique(np.concatenate(absorbed_vars))
+                        ]
                     continue
                 clause_ids.extend(self.def_clauses.get(var, ()))
             fresh = [ci for ci in clause_ids if ci not in seen_clauses]
             if not fresh:
                 break
             seen_clauses.update(fresh)
+            if len(fresh) < 48:
+                # deep terms walk hundreds of small levels (mux/carry
+                # chains): per-level numpy dispatch overhead dominates
+                # there, so small levels iterate the clause tuples
+                # directly
+                nxt = []
+                for ci in fresh:
+                    for lit in self.clauses_py[ci]:
+                        v = lit if lit > 0 else -lit
+                        if (
+                            v > 1 and v < num_vars
+                            and v not in seen_vars
+                            and not in_absorbed(v)
+                        ):
+                            nxt.append(v)
+                frontier = nxt
+                continue
             batch = np.unique(
                 np.fromiter(fresh, dtype=np.int64, count=len(fresh))
             )
@@ -335,13 +385,23 @@ class BlastContext:
             reached = np.abs(lits_flat[flat_index].astype(np.int64))
             reached = np.unique(reached)
             reached = reached[(reached > 1) & (reached < num_vars)]
+            for arr in absorbed_vars:
+                if len(arr) and len(reached):
+                    # screen against the absorbed cone (sorted array):
+                    # vectorized membership instead of set splat
+                    pos = np.searchsorted(arr, reached).clip(
+                        max=len(arr) - 1
+                    )
+                    reached = reached[arr[pos] != reached]
             frontier = [v for v in reached.tolist() if v not in seen_vars]
         clause_parts.append(
             np.fromiter(seen_clauses, dtype=np.int64, count=len(seen_clauses))
         )
         clause_arr = np.unique(np.concatenate(clause_parts))
-        var_arr = np.fromiter(seen_vars, dtype=np.int64, count=len(seen_vars))
-        var_arr.sort()
+        var_parts = [
+            np.fromiter(seen_vars, dtype=np.int64, count=len(seen_vars))
+        ] + absorbed_vars
+        var_arr = np.unique(np.concatenate(var_parts))
         return clause_arr, var_arr
 
     def absorb_learnts(self, max_width: int = 8) -> int:
@@ -393,8 +453,8 @@ class BlastContext:
         lits = tuple(sorted({-l for l in assumption_lits}))
         if not lits or len(lits) > 12:
             return  # wide nogoods add scan cost for little pruning
-        if TRUE_LIT in lits:
-            return  # trivially satisfied
+        if TRUE_LIT in lits or any(-l in lits for l in lits):
+            return  # trivially satisfied / tautological
         key = ("nogood", lits)
         if key in self.gate_cache:
             return
@@ -497,10 +557,40 @@ class BlastContext:
         return lit
 
     def g_and_many(self, lits: Sequence[int]) -> int:
-        acc = TRUE_LIT
+        """Wide conjunction as ONE gate: n binary clauses (gate → each
+        conjunct) plus one width-(n+1) clause (all conjuncts → gate).
+
+        The chained-2-AND encoding this replaces cost n gate vars, 3n
+        clauses, and — critically — a cone/implication DEPTH of n: a
+        256-bit equality made every cone walk and CDCL propagation
+        cross 256 chain levels.  The wide gate is depth 1.  (The wide
+        closing clause is dropped by the gather device path's width
+        cap, which only weakens propagation there — soundness holds.)
+        """
+        xs = []
+        seen = set()
         for lit in lits:
-            acc = self.g_and(acc, lit)
-        return acc
+            if lit == TRUE_LIT or lit in seen:
+                continue
+            if lit == FALSE_LIT or -lit in seen:
+                return FALSE_LIT
+            seen.add(lit)
+            xs.append(lit)
+        if not xs:
+            return TRUE_LIT
+        if len(xs) == 1:
+            return xs[0]
+        if len(xs) == 2:
+            return self.g_and(xs[0], xs[1])
+        key = ("andN", tuple(sorted(xs)))
+        lit = self.gate_cache.get(key)
+        if lit is None:
+            lit = self.new_lit()
+            for x in xs:
+                self._clause([-lit, x], owner=lit)
+            self._clause([lit] + [-x for x in xs], owner=lit)
+            self.gate_cache[key] = lit
+        return lit
 
     def g_or_many(self, lits: Sequence[int]) -> int:
         acc = FALSE_LIT
@@ -512,11 +602,85 @@ class BlastContext:
     # word-level circuits
     # ------------------------------------------------------------------
 
+    def g_xor3(self, a: int, b: int, c: int) -> int:
+        """Three-input parity as ONE gate var + 8 width-4 clauses —
+        adders built from chained 2-XORs cost 5 gate vars and ~17
+        clauses per bit; the direct encoding costs 2 vars and 14, and
+        cone/CDCL work scales with both."""
+        for x, rest in ((a, (b, c)), (b, (a, c)), (c, (a, b))):
+            if x == TRUE_LIT:
+                return -self.g_xor(*rest)
+            if x == FALSE_LIT:
+                return self.g_xor(*rest)
+        if a == b:
+            return c
+        if a == -b:
+            return -c
+        if b == c:
+            return a
+        if b == -c:
+            return -a
+        if a == c:
+            return b
+        if a == -c:
+            return -b
+        flip = (a < 0) != (b < 0) != (c < 0)
+        va, vb, vc = sorted((abs(a), abs(b), abs(c)))
+        key = ("xor3", va, vb, vc)
+        lit = self.gate_cache.get(key)
+        if lit is None:
+            lit = self.new_lit()
+            self._clause([-lit, va, vb, vc], owner=lit)
+            self._clause([-lit, -va, -vb, vc], owner=lit)
+            self._clause([-lit, -va, vb, -vc], owner=lit)
+            self._clause([-lit, va, -vb, -vc], owner=lit)
+            self._clause([lit, -va, vb, vc], owner=lit)
+            self._clause([lit, va, -vb, vc], owner=lit)
+            self._clause([lit, va, vb, -vc], owner=lit)
+            self._clause([lit, -va, -vb, -vc], owner=lit)
+            self.gate_cache[key] = lit
+        return -lit if flip else lit
+
+    def g_maj(self, a: int, b: int, c: int) -> int:
+        """Three-input majority (the adder carry) as one gate var + 6
+        clauses."""
+        for x, rest in ((a, (b, c)), (b, (a, c)), (c, (a, b))):
+            if x == TRUE_LIT:
+                return self.g_or(*rest)
+            if x == FALSE_LIT:
+                return self.g_and(*rest)
+        if a == b or a == c:
+            return a
+        if b == c:
+            return b
+        if a == -b:
+            return c
+        if a == -c:
+            return b
+        if b == -c:
+            return a
+        # maj(-a,-b,-c) == -maj(a,b,c): canonicalize on the sign of the
+        # smallest-var literal
+        lits = sorted((a, b, c), key=abs)
+        flip = lits[0] < 0
+        if flip:
+            lits = [-l for l in lits]
+        key = ("maj", lits[0], lits[1], lits[2])
+        lit = self.gate_cache.get(key)
+        if lit is None:
+            lit = self.new_lit()
+            x, y, z = lits
+            self._clause([-lit, x, y], owner=lit)
+            self._clause([-lit, x, z], owner=lit)
+            self._clause([-lit, y, z], owner=lit)
+            self._clause([lit, -x, -y], owner=lit)
+            self._clause([lit, -x, -z], owner=lit)
+            self._clause([lit, -y, -z], owner=lit)
+            self.gate_cache[key] = lit
+        return -lit if flip else lit
+
     def full_adder(self, x: int, y: int, cin: int) -> Tuple[int, int]:
-        t = self.g_xor(x, y)
-        total = self.g_xor(t, cin)
-        cout = self.g_or(self.g_and(x, y), self.g_and(t, cin))
-        return total, cout
+        return self.g_xor3(x, y, cin), self.g_maj(x, y, cin)
 
     def add_bits(
         self, xs: List[int], ys: List[int], cin: int = FALSE_LIT
