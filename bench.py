@@ -2,21 +2,34 @@
 compiled contract corpus (BASELINE.md protocol), falling back to an
 embedded assembler-built corpus when the reference tree is absent.
 
-Prints ONE json line on stdout:
-  {"metric": ..., "value": N, "unit": "s", "vs_baseline": N, ...}
-plus per-contract rows (wall, solver queries/time, device dispatch
-telemetry) on stderr.  ``--all-modes`` additionally runs the ablation
-grid (device on/off x word-probing on/off) so the speedup stays
-attributable to specific components; ``--mode <m>`` picks one.
+Prints ONE json line on stdout; per-contract rows (wall, solver-time
+split, device dispatch telemetry) go to stderr.  The DEFAULT run
+covers the full protocol:
 
-The reference publishes no numbers (BASELINE.md: "published: {}") and
-cannot run here (no z3 wheel in the image), so ``vs_baseline`` is
-computed against an asserted nominal (~60 s/contract with Z3 on CPU)
-and the output carries ``baseline_kind: nominal-unmeasured`` to say so.
+  1. the base corpus in ``full`` mode AND ``nodevice`` mode (the
+     device-attribution ablation lands in the summary json);
+  2. multi-transaction depth rows (-t 3 over the heavy .sol.o inputs
+     plus a BECToken-shaped assembler token — BASELINE.md items 3-5's
+     state-space scale without solc);
+  3. the wide-frontier scale scenarios in both modes: ``scale``
+     (ADD guards — cheap for the CPU stack, exercises dispatch
+     plumbing) and ``scale_hard`` (MUL guards — the workload shape
+     where batched device solving pays).
 
-Every contract must also yield its expected SWC findings — a fast run
-that misses findings exits nonzero (perf never trades against the
-detection oracle).
+``--all-modes`` additionally runs the full ablation grid (device
+on/off x word-probing on/off); ``--mode <m>`` picks one mode;
+``--quick`` skips the -t 3 and ablation passes for fast iteration.
+
+The reference publishes no benchmark numbers and cannot execute in
+this image (its z3 dependency has no wheel here — see BASELINE.md), so
+there is NO measured reference wall-clock: ``vs_baseline`` is retained
+for the driver's schema but computed against an asserted nominal and
+labeled ``nominal-unmeasured``.  The honest performance story is the
+measured walls plus the per-component attribution this file emits.
+
+Every corpus contract must also yield its expected SWC findings — a
+fast run that misses findings exits nonzero (perf never trades against
+the detection oracle).
 """
 
 import json
@@ -86,6 +99,99 @@ def _corpus():
     ]
 
 
+def batchtoken_contract() -> str:
+    """BECToken-shaped assembler token (solc absent, so the BASELINE
+    protocol's BECToken/rubixi batch is represented by an equivalent
+    state-space shape): three dispatched functions, storage-keyed
+    balances, a bounded batch loop, and the classic
+    ``cnt * value`` multiplication overflow (SWC-101 — the actual
+    BECToken CVE shape, /root/reference/solidity_examples/BECToken.sol
+    batchTransfer)."""
+    from mythril_tpu.support.assembler import asm
+    from mythril_tpu.support.signatures import selector_of
+
+    t_sel = selector_of("transfer(address,uint256)")
+    b_sel = selector_of("batchTransfer(uint256,uint256)")
+    a_sel = selector_of("approve(address,uint256)")
+    return asm(
+        f"""
+        PUSH 0; CALLDATALOAD; PUSH 0xe0; SHR
+        DUP1; PUSH4 {t_sel}; EQ; PUSH @transfer; JUMPI
+        DUP1; PUSH4 {b_sel}; EQ; PUSH @batch; JUMPI
+        DUP1; PUSH4 {a_sel}; EQ; PUSH @approve; JUMPI
+        PUSH 0; PUSH 0; REVERT
+      transfer:
+        JUMPDEST
+        PUSH 0x24; CALLDATALOAD
+        CALLER; SLOAD
+        DUP1; DUP3; GT; PUSH @fail; JUMPI
+        DUP2; DUP2; SUB
+        CALLER; SSTORE
+        POP
+        PUSH 4; CALLDATALOAD
+        DUP1; SLOAD
+        DUP3; ADD
+        SWAP1; SSTORE
+        STOP
+      batch:
+        JUMPDEST
+        PUSH 4; CALLDATALOAD
+        PUSH 0x24; CALLDATALOAD
+        DUP2; DUP2; MUL
+        CALLER; SLOAD
+        DUP2; DUP2; LT; PUSH @fail; JUMPI
+        SUB
+        CALLER; SSTORE
+        PUSH 0
+      bloop:
+        JUMPDEST
+        DUP3; DUP2; LT; ISZERO; PUSH @bdone; JUMPI
+        DUP1; PUSH 0x1000; ADD
+        DUP1; SLOAD
+        DUP4; ADD
+        SWAP1; SSTORE
+        PUSH 1; ADD
+        PUSH @bloop; JUMP
+      bdone:
+        JUMPDEST; STOP
+      approve:
+        JUMPDEST
+        PUSH 0x24; CALLDATALOAD
+        PUSH 4; CALLDATALOAD
+        CALLER; ADD
+        SSTORE
+        STOP
+      fail:
+        JUMPDEST; PUSH 0; PUSH 0; REVERT
+        """
+    )
+
+
+# Multi-transaction depth rows (BASELINE.md protocol items 3-5 at the
+# state-space scale the corpus's small 1-2-tx contracts never reach).
+def _t3_corpus():
+    """(name, code, tx_count, expected, execution_timeout).  The
+    timeouts keep the bench bounded: batchtoken at -t 3 explores past
+    any useful budget (3 storage-writing functions x 3 txs), so its row
+    honestly reports a capped run — findings salvage at timeout, and
+    the oracle still requires SWC-101."""
+    cases = []
+    for filename, expected, timeout in (
+        ("ether_send.sol.o", {"101", "105"}, 300),
+        ("overflow.sol.o", {"101"}, 300),
+    ):
+        path = os.path.join(REFERENCE_INPUTS, filename)
+        if os.path.exists(path):
+            cases.append(
+                (filename.split(".")[0] + "_t3",
+                 open(path).read().strip(), 3, expected, timeout)
+            )
+    cases.append(
+        ("batchtoken_t3", batchtoken_contract(), 3, {"101"}, 120)
+    )
+    return cases
+
+
 def _full_corpus():
     """Reference compiled corpus when mounted, else the embedded one."""
     cases = []
@@ -98,7 +204,9 @@ def _full_corpus():
     return cases + _corpus()
 
 
-def scale_contract(depth: int = 6, guard_bits: int = 16) -> str:
+def scale_contract(
+    depth: int = 6, guard_bits: int = 16, guard: str = "add"
+) -> str:
     """Wide-frontier stressor: a binary selector-bit dispatch tree whose
     live frontier doubles per level (2**depth leaves in lockstep), then
     per-leaf guards fork again.  This is the workload shape the batched
@@ -152,11 +260,23 @@ def scale_contract(depth: int = 6, guard_bits: int = 16) -> str:
         else:
             addend = (0x1234 + 7919 * i) & mask
             target = (0x6D2B + 104729 * i) & mask
-            lines.append(
-                f"PUSH 4; CALLDATALOAD; PUSH {mask}; AND; "
-                f"PUSH {addend}; ADD; PUSH {mask}; AND; "
-                f"PUSH {target}; EQ; PUSH @ok{i}; JUMPI"
-            )
+            if guard == "mul":
+                # multiplier-circuit guards (odd factor, so always
+                # satisfiable mod 2^guard_bits): ~6x costlier per CDCL
+                # query than ADD guards and probe-resistant — the shape
+                # where batched device DPLL beats the CPU stack
+                odd = (0x6D2B + 2 * 7919 * i) & mask | 1
+                lines.append(
+                    f"PUSH 4; CALLDATALOAD; PUSH {mask}; AND; "
+                    f"PUSH {odd}; MUL; PUSH {mask}; AND; "
+                    f"PUSH {target}; EQ; PUSH @ok{i}; JUMPI"
+                )
+            else:
+                lines.append(
+                    f"PUSH 4; CALLDATALOAD; PUSH {mask}; AND; "
+                    f"PUSH {addend}; ADD; PUSH {mask}; AND; "
+                    f"PUSH {target}; EQ; PUSH @ok{i}; JUMPI"
+                )
             lines.append("PUSH 0; PUSH 0; REVERT")
             lines.append(f"ok{i}:")
             if i % 16 == 6:
@@ -170,10 +290,20 @@ def scale_contract(depth: int = 6, guard_bits: int = 16) -> str:
 # Select with --mode or MYTHRIL_BENCH_MODE; --all-modes runs every mode
 # and prints a per-mode summary to stderr (stdout stays one JSON line).
 MODES = {
-    "full": dict(batched_solving=True, word_probing=True),
-    "nodevice": dict(batched_solving=False, word_probing=True),
-    "noprobe": dict(batched_solving=True, word_probing=False),
-    "cdcl": dict(batched_solving=False, word_probing=False),
+    "full": dict(batched_solving=True, word_probing=True,
+                 device_force_dispatch=False),
+    "nodevice": dict(batched_solving=False, word_probing=True,
+                     device_force_dispatch=False),
+    "noprobe": dict(batched_solving=True, word_probing=False,
+                    device_force_dispatch=False),
+    "cdcl": dict(batched_solving=False, word_probing=False,
+                 device_force_dispatch=False),
+    # capability mode: dispatch whenever the size gates allow, ignoring
+    # the adaptive profit gate — demonstrates device-decided lanes on
+    # the scale scenarios (full mode routes cheap residues to the CDCL
+    # on purpose, so its dispatch count is near zero by design)
+    "device": dict(batched_solving=True, word_probing=True,
+                   device_force_dispatch=True),
 }
 
 
@@ -214,14 +344,24 @@ def _analyze_one(name, code, tx_count, execution_timeout, max_depth):
     )
     issues = fire_lasers(sym)
     found = {i.swc_id for i in issues}
+    wall = time.time() - t0
+    dd = dispatch_stats.as_dict()
+    dd["device_s"] = round(dd.get("device_s", 0.0), 2)
+    split = stats.split()
+    # wall-clock attribution (VERDICT r2 #7): probe + blast + cone +
+    # native CDCL + device dispatch + everything else (python VM
+    # stepping, detection hooks, report glue)
+    accounted = sum(split.values()) + dd["device_s"]
     row = {
         "contract": name,
-        "wall_s": round(time.time() - t0, 2),
+        "wall_s": round(wall, 2),
         "tx_count": tx_count,
         "found": sorted(found),
         "queries": stats.query_count,
         "solver_s": round(stats.solver_time, 2),
-        **dispatch_stats.as_dict(),
+        **split,
+        "other_s": round(max(0.0, wall - accounted), 2),
+        **dd,
     }
     return found, row
 
@@ -247,8 +387,8 @@ def _run_corpus(mode: str):
     return time.time() - begin, rows, missed
 
 
-def _run_scale(mode: str):
-    """One pass over the wide-frontier scale scenario; returns a
+def _run_scale(mode: str, guard: str = "add", depth: int = 5):
+    """One pass over a wide-frontier scale scenario; returns a
     telemetry row.  A finding miss here is recorded in the summary,
     not fatal (the corpus remains the enforced detection oracle)."""
     from mythril_tpu.support.support_args import args
@@ -259,12 +399,104 @@ def _run_scale(mode: str):
     args.batch_width = 128  # let the scheduler feed the full frontier
     try:
         _, row = _analyze_one(
-            "scale", scale_contract(depth=5), 1,
-            execution_timeout=90, max_depth=512,
+            "scale" if guard == "add" else f"scale_{guard}",
+            scale_contract(depth=depth, guard=guard), 1,
+            execution_timeout=150, max_depth=512,
         )
+        row["mode"] = mode
         return row
     finally:
         args.batch_width = saved_width
+
+
+def _run_t3():
+    """The -t 3 depth rows (always full mode); returns (rows, missed)."""
+    from mythril_tpu.support.support_args import args
+
+    for key, value in MODES["full"].items():
+        setattr(args, key, value)
+    rows, missed = [], []
+    for name, code, tx_count, expected, timeout in _t3_corpus():
+        found, row = _analyze_one(
+            name, code, tx_count, execution_timeout=timeout,
+            max_depth=128,
+        )
+        if not expected & found:
+            missed.append((name, sorted(expected), sorted(found)))
+        rows.append(row)
+    return rows, missed
+
+
+def _solver_microbench():
+    """Kernel-level comparison on one batch of 16 disjoint MUL-guard
+    queries: serial CPU funnel vs one per-lane-cone device dispatch
+    (warm — the first dispatch compiles, the second is reported).
+    Returns a summary dict, or None off-TPU."""
+    import time
+
+    from mythril_tpu.ops import batched_sat as BS
+    from mythril_tpu.ops.device_health import backend_name, device_ok
+    from mythril_tpu.ops.pallas_prop import get_pallas_backend
+    from mythril_tpu.smt import symbol_factory
+    from mythril_tpu.smt import terms as T
+    from mythril_tpu.smt.solver import (
+        get_blast_context, reset_blast_context,
+    )
+
+    if not device_ok() or backend_name() != "tpu":
+        return None
+    reset_blast_context()
+    ctx = get_blast_context()
+    lanes = []
+    for i in range(16):
+        x = symbol_factory.BitVecSym(f"mb{i}", 256)
+        mask = symbol_factory.BitVecVal(0xFFFF, 256)
+        odd = symbol_factory.BitVecVal(0x6D2B, 256)
+        tgt = symbol_factory.BitVecVal((0x1234 + 7919 * i) & 0xFFFF, 256)
+        lanes.append([((x * odd) & mask) == tgt])
+    sets = [[ctx.blast_lit(c.raw) for c in lane] for lane in lanes]
+    ctx.flush_native()
+    t0 = time.monotonic()
+    cpu_sat = sum(
+        1 for lane in lanes
+        if ctx.check([c.raw for c in lane], timeout_s=10.0)[0] == 1
+    )
+    cpu_s = time.monotonic() - t0
+    backend = get_pallas_backend()
+    device_s = verified = None
+    for _ in range(2):  # first pass compiles; report the warm pass
+        BS.dispatch_stats.reset()
+        t0 = time.monotonic()
+        out = backend.check_assumption_sets(ctx, sets)
+        device_s = time.monotonic() - t0
+    if out is None:
+        return {"cpu_s": round(cpu_s, 3), "device": "bailed"}
+    results, assignments = out
+    verified = sum(
+        1 for i, lane in enumerate(lanes)
+        if all(
+            T.evaluate(c.raw, ctx.extract_env(assignments[i])) is True
+            for c in lane
+        )
+    )
+    return {
+        "queries": 16,
+        "cpu_s": round(cpu_s, 3),
+        "cpu_sat": cpu_sat,
+        "device_warm_s": round(device_s, 3),
+        "device_verified": verified,
+        "device_sweeps": BS.dispatch_stats.device_sweeps,
+        "speedup": round(cpu_s / device_s, 2) if device_s else None,
+    }
+
+
+def _scale_summary(row):
+    keys = (
+        "wall_s", "dispatches", "lanes", "unsat", "sat_verified",
+        "undecided", "size_bailouts", "fused", "device_sweeps",
+        "device_s", "found",
+    )
+    return {k: row[k] for k in keys if k in row}
 
 
 def main() -> None:
@@ -275,6 +507,7 @@ def main() -> None:
 
     argv = sys.argv[1:]
     all_modes = "--all-modes" in argv
+    quick = "--quick" in argv
     mode = os.environ.get("MYTHRIL_BENCH_MODE", "full")
     if "--mode" in argv:
         index = argv.index("--mode") + 1
@@ -284,8 +517,18 @@ def main() -> None:
     if mode not in MODES:
         sys.exit(f"unknown mode {mode!r} (choose from {sorted(MODES)})")
 
+    # ablation passes: the full grid with --all-modes; the default run
+    # still measures full vs nodevice so the device attribution always
+    # lands in the summary json (the driver only captures the default)
+    if all_modes:
+        ablation_modes = list(MODES)
+    elif quick:
+        ablation_modes = [mode]
+    else:
+        ablation_modes = [mode] + (["nodevice"] if mode == "full" else [])
+
     results = {}
-    for run_mode in (MODES if all_modes else [mode]):
+    for run_mode in ablation_modes:
         wall, rows, missed = _run_corpus(run_mode)
         results[run_mode] = (wall, rows, missed)
         print(f"--- mode={run_mode}: {round(wall, 2)}s ---", file=sys.stderr)
@@ -294,22 +537,54 @@ def main() -> None:
         if missed:
             print(f"MISSED: {missed}", file=sys.stderr)
 
-    # wide-frontier scale scenario (device-dispatch telemetry; skipped
+    # multi-transaction depth rows (BASELINE protocol at real scale)
+    t3_rows, t3_missed = ([], [])
+    if not quick:
+        t3_rows, t3_missed = _run_t3()
+        print("--- -t 3 depth rows (mode=full) ---", file=sys.stderr)
+        for row in t3_rows:
+            print(json.dumps(row), file=sys.stderr)
+        if t3_missed:
+            print(f"T3 MISSED: {t3_missed}", file=sys.stderr)
+
+    # wide-frontier scale scenarios (device-dispatch telemetry; skipped
     # with --no-scale for corpus-only timing runs)
-    scale_row = None
+    scale_rows = {}
     if "--no-scale" not in argv:
-        scale_row = _run_scale(mode)
-        print(f"--- scale scenario (mode={mode}) ---", file=sys.stderr)
-        print(json.dumps(scale_row), file=sys.stderr)
+        scenarios = [("scale", "add")]
+        if not quick:
+            scenarios.append(("scale_mul", "mul"))
+        scale_modes = (
+            [mode] if quick
+            else list(dict.fromkeys([mode, "full", "nodevice", "device"]))
+        )
+        for label, guard in scenarios:
+            for run_mode in scale_modes:
+                row = _run_scale(run_mode, guard=guard)
+                scale_rows[(label, run_mode)] = row
+                print(
+                    f"--- {label} scenario (mode={run_mode}) ---",
+                    file=sys.stderr,
+                )
+                print(json.dumps(row), file=sys.stderr)
+
+    microbench = None
+    if not quick:
+        try:
+            microbench = _solver_microbench()
+        except Exception as exc:  # noqa: BLE001 — bench must not die here
+            microbench = {"error": str(exc)[:200]}
 
     wall, rows, missed = results[mode]
     summary = {
         "metric": "analyze_corpus_wall_s",
         "value": round(wall, 2),
         "unit": "s",
-        # the reference cannot run here (no z3 wheel in the image), so
-        # vs_baseline remains computed against the asserted nominal;
-        # baseline_kind flags it as unmeasured (BASELINE.md protocol)
+        # the reference cannot execute in this image (z3 dependency has
+        # no wheel), so there is no measured reference wall: this field
+        # is kept for the driver's schema, computed against an asserted
+        # nominal, and labeled as such.  The honest story is the
+        # measured walls + attribution below.
         "vs_baseline": round(
             NOMINAL_REFERENCE_WALL_S * len(rows) / wall, 2
         ),
@@ -319,29 +594,43 @@ def main() -> None:
         "device_dispatches": sum(r["dispatches"] for r in rows),
         "device_lanes": sum(r["lanes"] for r in rows),
         "device_unsat": sum(r["unsat"] for r in rows),
+        "device_sat_verified": sum(r["sat_verified"] for r in rows),
         "host_probe_sat": sum(r["host_probe_sat"] for r in rows),
+        "solver_split": {
+            k: round(sum(r[k] for r in rows), 2)
+            for k in ("probe_s", "blast_s", "cone_s", "native_s",
+                      "device_s", "other_s")
+        },
     }
-    if all_modes:
+    if len(results) > 1:
         summary["ablation_wall_s"] = {
             m: round(results[m][0], 2) for m in results
         }
-    if scale_row is not None:
-        summary["scale_wall_s"] = scale_row["wall_s"]
-        summary["scale_dispatches"] = scale_row["dispatches"]
-        summary["scale_device_lanes"] = scale_row["lanes"]
-        summary["scale_device_unsat"] = scale_row["unsat"]
-        summary["scale_sat_verified"] = scale_row["sat_verified"]
-        summary["scale_size_bailouts"] = scale_row["size_bailouts"]
-        summary["scale_fused"] = scale_row.get("fused", False)
-        # telemetry scenario, not the detection oracle: a miss (e.g. a
+    if t3_rows:
+        summary["t3_wall_s"] = round(sum(r["wall_s"] for r in t3_rows), 2)
+        summary["t3_rows"] = [
+            {k: r[k] for k in ("contract", "wall_s", "queries",
+                               "solver_s", "found")}
+            for r in t3_rows
+        ]
+        if t3_missed:
+            summary["t3_error"] = f"t3 missed findings: {t3_missed}"
+    if microbench is not None:
+        summary["solver_batch_microbench"] = microbench
+    for (label, run_mode), row in scale_rows.items():
+        key = label if run_mode == mode else f"{label}_{run_mode}"
+        summary[key] = _scale_summary(row)
+        # telemetry scenarios, not the detection oracle: a miss (e.g. a
         # timeout on a degraded device path) is recorded, not fatal
-        if "106" not in scale_row["found"]:
-            summary["scale_error"] = (
-                f"scale scenario missed SWC-106 (found {scale_row['found']})"
+        if "106" not in row["found"]:
+            summary.setdefault("scale_errors", []).append(
+                f"{label}/{run_mode} missed SWC-106 (found {row['found']})"
             )
-    if missed:
+    if missed or t3_missed:
         summary["vs_baseline"] = 0.0
-        summary["error"] = f"missed findings: {missed}"
+        summary["error"] = (
+            f"missed findings: {missed or ''} {t3_missed or ''}".strip()
+        )
         print(json.dumps(summary))
         sys.exit(1)
     print(json.dumps(summary))

@@ -1,10 +1,18 @@
-"""Statespace value/op wrappers used by the POST-module pass (reference:
-mythril/analysis/ops.py)."""
+"""Concrete-or-symbolic value tagging for the statespace post-pass.
+
+The POST-entrypoint detection modules walk recorded states and need a
+uniform answer to "is this stack operand a number I can use, or still
+an expression?".  :func:`get_variable` classifies an operand once and
+the wrappers carry that tag alongside the payload.
+
+Reference counterpart: mythril/analysis/ops.py (VarType/Variable/Call
+surface; the classification itself rides on our term DAG's
+``symbolic`` flag instead of z3 AST probing).
+"""
 
 from enum import Enum
 
-from mythril_tpu.laser.ethereum import util
-from mythril_tpu.smt import simplify
+from mythril_tpu.smt import BitVec, Bool, simplify
 
 
 class VarType(Enum):
@@ -13,22 +21,53 @@ class VarType(Enum):
 
 
 class Variable:
+    """A stack operand tagged with its concreteness."""
+
+    __slots__ = ("val", "type")
+
     def __init__(self, val, _type):
         self.val = val
         self.type = _type
 
+    @classmethod
+    def concrete(cls, value: int) -> "Variable":
+        return cls(value, VarType.CONCRETE)
+
+    @classmethod
+    def symbolic(cls, expression) -> "Variable":
+        return cls(simplify(expression), VarType.SYMBOLIC)
+
+    @property
+    def is_concrete(self) -> bool:
+        return self.type == VarType.CONCRETE
+
     def __str__(self):
         return str(self.val)
 
+    def __repr__(self):
+        tag = "concrete" if self.is_concrete else "symbolic"
+        return f"<Variable {tag} {self.val}>"
 
-def get_variable(i) -> Variable:
-    try:
-        return Variable(util.get_concrete_int(i), VarType.CONCRETE)
-    except TypeError:
-        return Variable(simplify(i), VarType.SYMBOLIC)
+
+def get_variable(operand) -> Variable:
+    """Classify one operand: ints, constant bitvectors, and constant
+    bools come back CONCRETE with a Python int payload; anything still
+    containing free symbols comes back SYMBOLIC with a simplified
+    expression payload."""
+    if isinstance(operand, int):
+        return Variable.concrete(operand)
+    if isinstance(operand, BitVec) and not operand.symbolic:
+        return Variable.concrete(operand.value)
+    if isinstance(operand, Bool) and operand.value is not None:
+        return Variable.concrete(int(operand.value))
+    return Variable.symbolic(operand)
 
 
 class Op:
+    """A recorded operation: where in the statespace it happened."""
+
+    __slots__ = ("node", "state", "state_index")
+
     def __init__(self, node, state, state_index):
         self.node = node
         self.state = state
@@ -36,20 +75,19 @@ class Op:
 
 
 class Call(Op):
-    def __init__(
-        self,
-        node,
-        state,
-        state_index,
-        _type,
-        to,
-        gas,
-        value=Variable(0, VarType.CONCRETE),
-        data=None,
-    ):
+    """A message call captured by the post-pass, with its classified
+    operands (consumed by the POST modules via SymExecWrapper.calls)."""
+
+    __slots__ = ("to", "gas", "type", "value", "data")
+
+    def __init__(self, node, state, state_index, _type, to, gas,
+                 value=None, data=None):
         super().__init__(node, state, state_index)
         self.to = to
         self.gas = gas
         self.type = _type
-        self.value = value
+        self.value = value if value is not None else Variable.concrete(0)
         self.data = data
+
+    def __repr__(self):
+        return f"<Call {self.type} to={self.to} value={self.value}>"

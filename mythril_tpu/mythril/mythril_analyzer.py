@@ -1,8 +1,28 @@
-"""MythrilAnalyzer: per-contract analysis loop (reference:
-mythril/mythril/mythril_analyzer.py)."""
+"""Contract-corpus analysis orchestration.
+
+Coordinates one analysis campaign over the disassembler's contract
+list: builds a symbolic executor per contract, harvests detection
+issues (salvaging partial results on interrupt or crash), optionally
+confirms exploit sequences by lockstep concrete replay, and assembles
+the final :class:`Report`.
+
+Corpus sharding: when several contracts are analyzed on a multi-device
+host, contracts are distributed round-robin over the visible devices —
+contract-level data parallelism (SURVEY §2.16: "data parallelism over
+contracts = shard a corpus across chips").  Each contract's device
+dispatches (ops/pallas_prop.py) place their arrays on the contract's
+assigned device via ops.device_placement, so independent contracts
+use independent chips.
+
+Reference counterpart: mythril/mythril/mythril_analyzer.py (the
+per-contract loop + statistics toggles); the symbolizer factory,
+salvage pipeline, replay hook, and corpus sharding are this
+implementation's own shape.
+"""
 
 import logging
 import traceback
+from dataclasses import dataclass
 from typing import List, Optional
 
 from mythril_tpu.analysis.report import Issue, Report
@@ -17,6 +37,24 @@ from mythril_tpu.support.start_time import StartTime
 from mythril_tpu.support.support_args import args
 
 log = logging.getLogger(__name__)
+
+
+@dataclass
+class _Campaign:
+    """Settings for one analysis campaign, resolved once at analyzer
+    construction (the reference re-reads its attribute soup per call)."""
+
+    strategy: str = "dfs"
+    address: Optional[str] = None
+    max_depth: Optional[int] = None
+    execution_timeout: Optional[int] = None
+    loop_bound: Optional[int] = None
+    create_timeout: Optional[int] = None
+    use_onchain_data: bool = True
+    disable_dependency_pruning: bool = False
+    custom_modules_directory: str = ""
+    enable_coverage_strategy: bool = False
+    shard_corpus: bool = True
 
 
 class MythrilAnalyzer:
@@ -40,20 +78,26 @@ class MythrilAnalyzer:
         parallel_solving: bool = False,
         call_depth_limit: int = 3,
         enable_coverage_strategy: bool = False,
+        shard_corpus: bool = True,
     ):
         self.eth = disassembler.eth
         self.contracts: List[EVMContract] = disassembler.contracts or []
         self.enable_online_lookup = disassembler.enable_online_lookup
-        self.use_onchain_data = use_onchain_data
-        self.strategy = strategy
-        self.address = address
-        self.max_depth = max_depth
-        self.execution_timeout = execution_timeout
-        self.loop_bound = loop_bound
-        self.create_timeout = create_timeout
-        self.disable_dependency_pruning = disable_dependency_pruning
-        self.custom_modules_directory = custom_modules_directory
-        self.enable_coverage_strategy = enable_coverage_strategy
+        self.campaign = _Campaign(
+            strategy=strategy,
+            address=address,
+            max_depth=max_depth,
+            execution_timeout=execution_timeout,
+            loop_bound=loop_bound,
+            create_timeout=create_timeout,
+            use_onchain_data=use_onchain_data,
+            disable_dependency_pruning=disable_dependency_pruning,
+            custom_modules_directory=custom_modules_directory,
+            enable_coverage_strategy=enable_coverage_strategy,
+            shard_corpus=shard_corpus,
+        )
+        # the laser stack reads these through the global args bus
+        # (SURVEY §5.6's tier 2) — same flow as the reference
         args.sparse_pruning = sparse_pruning
         args.solver_timeout = solver_timeout or args.solver_timeout
         args.parallel_solving = parallel_solving
@@ -61,24 +105,40 @@ class MythrilAnalyzer:
         args.call_depth_limit = call_depth_limit
         args.iprof = enable_iprof
 
+    # ------------------------------------------------------------------
+    # symbolic-executor factory — single assembly point for every mode
+    # ------------------------------------------------------------------
+
+    def _symbolize(self, contract, **overrides) -> SymExecWrapper:
+        cfg = self.campaign
+        settings = dict(
+            dynloader=DynLoader(self.eth, active=cfg.use_onchain_data),
+            max_depth=cfg.max_depth,
+            execution_timeout=cfg.execution_timeout,
+            create_timeout=cfg.create_timeout,
+            disable_dependency_pruning=cfg.disable_dependency_pruning,
+            custom_modules_directory=cfg.custom_modules_directory,
+        )
+        settings.update(overrides)
+        return SymExecWrapper(
+            contract or self.contracts[0],
+            cfg.address,
+            cfg.strategy,
+            **settings,
+        )
+
+    # ------------------------------------------------------------------
+    # statespace/graph modes (no detection modules)
+    # ------------------------------------------------------------------
+
     def dump_statespace(self, contract: EVMContract = None) -> str:
         from mythril_tpu.analysis.traceexplore import (
             get_serializable_statespace,
         )
 
-        sym = SymExecWrapper(
-            contract or self.contracts[0],
-            self.address,
-            self.strategy,
-            dynloader=DynLoader(self.eth, active=self.use_onchain_data),
-            max_depth=self.max_depth,
-            execution_timeout=self.execution_timeout,
-            create_timeout=self.create_timeout,
-            disable_dependency_pruning=self.disable_dependency_pruning,
-            run_analysis_modules=False,
-            custom_modules_directory=self.custom_modules_directory,
+        return get_serializable_statespace(
+            self._symbolize(contract, run_analysis_modules=False)
         )
-        return get_serializable_statespace(sym)
 
     def graph_html(
         self,
@@ -89,85 +149,94 @@ class MythrilAnalyzer:
     ) -> str:
         from mythril_tpu.analysis.callgraph import generate_graph
 
-        sym = SymExecWrapper(
-            contract or self.contracts[0],
-            self.address,
-            self.strategy,
-            dynloader=DynLoader(self.eth, active=self.use_onchain_data),
-            max_depth=self.max_depth,
-            execution_timeout=self.execution_timeout,
-            transaction_count=transaction_count,
-            create_timeout=self.create_timeout,
-            disable_dependency_pruning=self.disable_dependency_pruning,
+        sym = self._symbolize(
+            contract,
             run_analysis_modules=False,
-            custom_modules_directory=self.custom_modules_directory,
+            transaction_count=transaction_count,
         )
         return generate_graph(sym, physics=enable_physics, phrackify=phrackify)
+
+    # ------------------------------------------------------------------
+    # detection campaign
+    # ------------------------------------------------------------------
+
+    def _analyze_contract(self, contract, modules, transaction_count):
+        """Symbolically execute one contract and return (issues,
+        execution_info, traceback-or-None).  Interrupts and crashes
+        salvage whatever the callback modules had already found."""
+        StartTime()  # per-contract wall-clock epoch for report timestamps
+        failure = None
+        execution_info = None
+        try:
+            sym = self._symbolize(
+                contract,
+                loop_bound=self.campaign.loop_bound,
+                transaction_count=transaction_count,
+                modules=modules,
+                compulsory_statespace=False,
+                enable_coverage_strategy=(
+                    self.campaign.enable_coverage_strategy
+                ),
+            )
+            issues = fire_lasers(sym, modules)
+            execution_info = sym.execution_info
+        except DetectorNotFoundError:
+            raise
+        except KeyboardInterrupt:
+            log.critical("Keyboard Interrupt")
+            issues = retrieve_callback_issues(modules)
+        except Exception:
+            failure = traceback.format_exc()
+            log.critical(
+                "Exception occurred, aborting analysis:\n" + failure
+            )
+            issues = retrieve_callback_issues(modules)
+        return issues, execution_info, failure
+
+    @staticmethod
+    def _confirm_by_replay(issues: List[Issue], contract) -> None:
+        """Lockstep-replay exploit sequences on device for independent
+        confirmation (annotation only; findings/formats unaffected)."""
+        if not issues or not getattr(args, "concrete_replay", True):
+            return
+        try:
+            from mythril_tpu.analysis.concrete_replay import replay_issues
+
+            replay_issues(issues, contract.code)
+        except Exception:  # noqa: BLE001 — validation is best-effort
+            log.debug("concrete replay skipped:\n" + traceback.format_exc())
 
     def fire_lasers(
         self,
         modules: Optional[List[str]] = None,
         transaction_count: Optional[int] = None,
     ) -> Report:
-        all_issues: List[Issue] = []
         SolverStatistics().enabled = True
-        exceptions = []
-        execution_info = None
-        for contract in self.contracts:
-            StartTime()  # reinitialize for each contract
-            try:
-                sym = SymExecWrapper(
-                    contract,
-                    self.address,
-                    self.strategy,
-                    dynloader=DynLoader(self.eth, active=self.use_onchain_data),
-                    max_depth=self.max_depth,
-                    execution_timeout=self.execution_timeout,
-                    loop_bound=self.loop_bound,
-                    create_timeout=self.create_timeout,
-                    transaction_count=transaction_count,
-                    modules=modules,
-                    compulsory_statespace=False,
-                    disable_dependency_pruning=self.disable_dependency_pruning,
-                    custom_modules_directory=self.custom_modules_directory,
-                    enable_coverage_strategy=self.enable_coverage_strategy,
-                )
-                issues = fire_lasers(sym, modules)
-                execution_info = sym.execution_info
-            except DetectorNotFoundError:
-                raise
-            except KeyboardInterrupt:
-                log.critical("Keyboard Interrupt")
-                issues = retrieve_callback_issues(modules)
-            except Exception:
-                log.critical(
-                    "Exception occurred, aborting analysis:\n"
-                    + traceback.format_exc()
-                )
-                issues = retrieve_callback_issues(modules)
-                exceptions.append(traceback.format_exc())
-            for issue in issues:
-                issue.add_code_info(contract)
-            if issues and getattr(args, "concrete_replay", True):
-                # independent on-device confirmation of exploit sequences
-                # (lockstep batched VM); annotation only — report formats
-                # and findings are unaffected
-                try:
-                    from mythril_tpu.analysis.concrete_replay import (
-                        replay_issues,
-                    )
+        from mythril_tpu.ops.device_placement import corpus_shard
 
-                    replay_issues(issues, contract.code)
-                except Exception:  # noqa: BLE001 — validation is best-effort
-                    log.debug(
-                        "concrete replay skipped:\n" + traceback.format_exc()
-                    )
-            all_issues += issues
+        all_issues: List[Issue] = []
+        exceptions: List[str] = []
+        execution_info = None
+        shard = self.campaign.shard_corpus and len(self.contracts) > 1
+        for index, contract in enumerate(self.contracts):
+            # contract-level data parallelism: pin this contract's
+            # device work to devices[index % n] (no-op on 1 device)
+            with corpus_shard(index if shard else None):
+                issues, info, failure = self._analyze_contract(
+                    contract, modules, transaction_count
+                )
+                if info is not None:
+                    execution_info = info
+                if failure:
+                    exceptions.append(failure)
+                for issue in issues:
+                    issue.add_code_info(contract)
+                self._confirm_by_replay(issues, contract)
+            all_issues.extend(issues)
             log.info("Solver statistics: \n%s", SolverStatistics())
 
-        source_data = Source()
-        source_data.get_source_from_contracts_list(self.contracts)
-
+        # resolve source mappings for the final report
+        Source().get_source_from_contracts_list(self.contracts)
         report = Report(
             contracts=self.contracts,
             exceptions=exceptions,

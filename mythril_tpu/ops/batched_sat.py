@@ -78,6 +78,15 @@ class DispatchStats:
         # total DPLL sweeps the dense kernel ran (wall-clock breakdown:
         # device solve time ≈ sweeps x per-sweep cost for the shape)
         self.device_sweeps = 0
+        # wall-clock spent inside device dispatches (cone + build +
+        # solve + fetch), for the bench breakdown
+        self.device_s = 0.0
+        # device id the active corpus shard last placed arrays on
+        # (ops/device_placement.py; stays 0 on single-device hosts)
+        self.corpus_shard_device = 0
+        # dispatches skipped because the projected CPU cost of the
+        # residue did not clear args.device_min_save_s
+        self.profit_skips = 0
 
     def as_dict(self):
         return dict(self.__dict__)
@@ -605,8 +614,11 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     # permanent; a failed probe is retried only after a new model lands
     # in recent_models (frontiers repeat constraint sets across rounds,
     # so re-probing measured ~20% of corpus wall-clock)
+    from mythril_tpu.smt.solver import SolverStatistics
     from mythril_tpu.support.model import peek_model_verdict
 
+    stats = SolverStatistics()
+    probe_began = time.monotonic()
     for i, nodes in enumerate(node_sets):
         if nodes is None:
             continue
@@ -625,6 +637,7 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         if ctx.probe_with_memo(nodes) is not None:
             decided[i] = True
             dispatch_stats.host_probe_sat += 1
+    stats.probe_s += time.monotonic() - probe_began
 
     open_indices = [i for i, d in enumerate(decided) if d is None]
     # below this many probe-resistant lanes the device dispatch's fixed
@@ -658,6 +671,22 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
             unique[lits_key] = lane
             rep_indices.append(i)
         lane_of.append(lane)
+
+    if not getattr(args, "device_force_dispatch", False):
+        # adaptive profit gate: the dispatch pays 0.3-2.4 s (cone +
+        # build + compile-amortized solve); skip it whenever the tuned
+        # CPU stack is projected to clear the residue for less.  The
+        # projection uses the analysis's own observed native CDCL cost
+        # so the policy tracks the workload, not a constant.
+        stats = SolverStatistics()
+        avg_native = (
+            stats.native_s / stats.native_calls
+            if getattr(stats, "native_calls", 0) else 0.0
+        )
+        projected = len(rep_indices) * avg_native
+        if projected < getattr(args, "device_min_save_s", 0.5):
+            dispatch_stats.profit_skips += 1
+            return decided
 
     backend = get_backend()
     fuse_retry_attempt = False
@@ -696,6 +725,7 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         [assumption_sets[i] for i in rep_indices],
     )
     dispatch_elapsed = time.monotonic() - dispatch_began
+    dispatch_stats.device_s += dispatch_elapsed
     # attribution counters tally only real device (or interpret-mode
     # kernel) passes — a bail-out to the CDCL tail is not a dispatch
     engaged = getattr(backend, "device_engaged", False)

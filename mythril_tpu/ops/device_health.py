@@ -110,10 +110,15 @@ def _probe() -> bool:
             return False
     # device answered from a clean process moments ago: the in-process
     # init below should complete quickly.  The join deadline deducts
-    # the pre-probe's share so the worst-case stall stays bounded by
-    # MYTHRIL_TPU_HEALTH_TIMEOUT overall (floor guards the healthy
-    # path, whose compile the subprocess just cached).
-    timeout_s = max(15.0, timeout_s - (_time.monotonic() - began))
+    # the pre-probe's share so the worst-case total stall stays bounded
+    # by MYTHRIL_TPU_HEALTH_TIMEOUT overall; when the pre-probe
+    # consumed (nearly) everything, the floor grants the healthy path
+    # only what remains of half the budget (the subprocess just cached
+    # the compile, so a healthy init is fast) — total stall is capped
+    # at 1.5x the configured budget in the worst case, never the old
+    # unconditional 15 s floor
+    remaining = timeout_s - (_time.monotonic() - began)
+    timeout_s = max(min(15.0, timeout_s / 2.0), remaining)
     result = {}
 
     def run():

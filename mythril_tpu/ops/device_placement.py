@@ -1,0 +1,76 @@
+"""Contract-level corpus sharding: pin device work to a chip.
+
+SURVEY §2.16's second parallelism axis — "data parallelism over
+contracts = shard a corpus across chips".  The analyzer enters a
+:func:`corpus_shard` context per contract; while it is active, the
+dense SAT backends place their arrays on ``devices[index % n]`` so
+independent contracts' dispatches run on independent chips instead of
+all landing on device 0.  With one visible device everything degrades
+to a no-op.
+
+This is deliberately a placement policy, not a mesh: per-dispatch
+frontier solving already shards lanes/clauses over the dp x cp mesh
+(parallel/mesh.py); corpus sharding is the coarser, embarrassingly
+parallel axis above it, and composes with process-level parallelism
+(one analyzer process per host) the same way.
+"""
+
+import logging
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_state = threading.local()
+
+
+def _devices():
+    import jax
+
+    from mythril_tpu.ops import configure_jax
+    from mythril_tpu.ops.device_health import device_ok
+
+    if not device_ok():
+        return []
+    configure_jax()
+    return jax.devices()
+
+
+@contextmanager
+def corpus_shard(index: Optional[int]):
+    """Route device placement to ``devices[index % n]`` inside the
+    context (``None`` → default placement)."""
+    previous = getattr(_state, "shard_index", None)
+    _state.shard_index = index
+    try:
+        yield
+    finally:
+        _state.shard_index = previous
+
+
+def current_device():
+    """The device the active corpus shard should place arrays on, or
+    None for default placement (single device / no shard active)."""
+    index = getattr(_state, "shard_index", None)
+    if index is None:
+        return None
+    devices = _devices()
+    if len(devices) <= 1:
+        return None
+    device = devices[index % len(devices)]
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+
+    dispatch_stats.corpus_shard_device = getattr(device, "id", 0)
+    return device
+
+
+def place(array):
+    """jax.device_put onto the active shard's device (identity when no
+    shard is active)."""
+    device = current_device()
+    if device is None:
+        return array
+    import jax
+
+    return jax.device_put(array, device)

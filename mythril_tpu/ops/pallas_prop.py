@@ -223,17 +223,22 @@ class DenseClausePool:
             # the incidence cell collapses duplicates, so width must
             # count UNIQUE literals or conflicts/units are missed
             width[0, c] = len(lits)
+        from mythril_tpu.ops.device_placement import place
+
         build = _make_incidence_builder(
             C, V,
             _bucket(max(1, len(pos_r)), floor=256),
             _bucket(max(1, len(neg_r)), floor=256),
         )
+        # committed inputs pin the jitted build (and everything
+        # downstream that consumes its outputs) to the corpus shard's
+        # device — contract-level data parallelism over chips
         self.P, self.N, self.width = build(
-            _pad_coords(pos_r, build.n_pos),
-            _pad_coords(pos_c, build.n_pos),
-            _pad_coords(neg_r, build.n_neg),
-            _pad_coords(neg_c, build.n_neg),
-            width,
+            place(_pad_coords(pos_r, build.n_pos)),
+            place(_pad_coords(pos_c, build.n_pos)),
+            place(_pad_coords(neg_r, build.n_neg)),
+            place(_pad_coords(neg_c, build.n_neg)),
+            place(width),
         )
         self.num_vars = V - 1
         self.C, self.V = C, V
@@ -867,11 +872,13 @@ class PallasSatBackend:
             for lane, lits in enumerate(chunk):
                 for lit in lits:
                     A0[lane, remap[abs(lit)]] = 1.0 if lit > 0 else -1.0
+            from mythril_tpu.ops.device_placement import place
+
             step = make_dense_solve(
                 pool.C, V, B, steps, interpret, decisions
             )
             A, st, steps_used = step(
-                pool.P, pool.N, pool.width, jnp.asarray(A0),
+                pool.P, pool.N, pool.width, place(jnp.asarray(A0)),
             )
             dispatch_stats.device_sweeps += int(steps_used)
             n = len(chunk)
@@ -956,19 +963,21 @@ class PallasSatBackend:
                             neg_c.append(remap[-lit])
                 for lit in lits:
                     A0[lane, remap[abs(lit)]] = 1.0 if lit > 0 else -1.0
+            from mythril_tpu.ops.device_placement import place
+
             build = _make_lane_incidence_builder(
                 B, max_C, max_V,
                 _bucket(max(1, len(pos_l)), floor=256),
                 _bucket(max(1, len(neg_l)), floor=256),
             )
             P, N, W = build(
-                _pad_coords(pos_l, build.n_pos),
-                _pad_coords(pos_r, build.n_pos),
-                _pad_coords(pos_c, build.n_pos),
-                _pad_coords(neg_l, build.n_neg),
-                _pad_coords(neg_r, build.n_neg),
-                _pad_coords(neg_c, build.n_neg),
-                width,
+                place(_pad_coords(pos_l, build.n_pos)),
+                place(_pad_coords(pos_r, build.n_pos)),
+                place(_pad_coords(pos_c, build.n_pos)),
+                place(_pad_coords(neg_l, build.n_neg)),
+                place(_pad_coords(neg_r, build.n_neg)),
+                place(_pad_coords(neg_c, build.n_neg)),
+                place(width),
             )
             step = make_batched_solve(max_C, max_V, B, steps, decisions)
             A, st, steps_used = step(P, N, W, jnp.asarray(A0))

@@ -19,6 +19,7 @@ Bit order convention: bits[0] is the LSB.  Literal 1 is constant TRUE
 """
 
 import logging
+import time
 from array import array
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -85,6 +86,21 @@ def _truth_bit(lit: int, truth: np.ndarray) -> bool:
 
 def _const_bits(value: int, width: int) -> List[int]:
     return [TRUE_LIT if (value >> i) & 1 else FALSE_LIT for i in range(width)]
+
+
+_stats_singleton = None
+
+
+def _solver_stats():
+    """Cached SolverStatistics singleton (imported lazily once: the
+    solver package imports this module at load, and check() is the
+    hottest funnel — per-call import machinery measurably taxed it)."""
+    global _stats_singleton
+    if _stats_singleton is None:
+        from mythril_tpu.smt.solver import SolverStatistics
+
+        _stats_singleton = SolverStatistics()
+    return _stats_singleton
 
 
 _CTX_GENERATION = 0
@@ -211,7 +227,14 @@ class BlastContext:
         is clauses_py[i]'s literals — the cone BFS gathers whole clause
         batches without touching Python tuples.  The store syncs to the
         clauses_py tail here (one tight batch loop per cone burst)
-        rather than per _clause call, which measurably taxed blasting."""
+        rather than per _clause call, which measurably taxed blasting.
+
+        INVARIANT: the returned views alias resizable array.array
+        buffers — they must stay local to one cone walk and MUST NOT be
+        retained across any call that can append a clause, or the next
+        ``store.extend`` raises BufferError ("cannot resize an array
+        that is exporting buffers").  ``_cone_of_var`` keeps them
+        frame-local; do the same in any new caller."""
         n = len(self.clauses_py)
         if self._csr_cursor < n:
             store = self._lits_store
@@ -997,14 +1020,20 @@ class BlastContext:
             return SatSolver.UNSAT, None
         from mythril_tpu.support.support_args import args as _args
 
+        stats = _solver_stats()
         if getattr(_args, "word_probing", True):
+            t0 = time.monotonic()
             env = self.probe_with_memo(nodes)
+            stats.probe_s += time.monotonic() - t0
             if env is not None:
                 return SatSolver.SAT, env
+        t0 = time.monotonic()
         assumptions = [self.blast_lit(c) for c in nodes]
+        stats.blast_s += time.monotonic() - t0
         # restrict CDCL decisions to the query's cone: against a large
         # shared pool, VSIDS otherwise wanders into foreign gates and
         # pays full-pool propagation per irrelevant decision
+        t0 = time.monotonic()
         if getattr(_args, "cone_decisions", True):
             try:
                 _, cone_vars = self.cone(assumptions, need_clauses=False)
@@ -1023,8 +1052,12 @@ class BlastContext:
         else:
             # a stale restriction from an earlier query would be unsound
             self.solver.set_relevant([])
+        stats.cone_s += time.monotonic() - t0
         self.flush_native()
+        t0 = time.monotonic()
         status = self.solver.solve(assumptions, conflict_budget, timeout_s)
+        stats.native_s += time.monotonic() - t0
+        stats.native_calls += 1
         if status != SatSolver.SAT:
             if status == SatSolver.UNSAT:
                 # permanent memo: frontier rounds repeat constraint sets

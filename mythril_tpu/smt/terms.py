@@ -609,12 +609,19 @@ class DefaultTable(dict):
     default must travel with its tables (IndependenceSolver._restrict).
     """
 
+    _MISSING = object()
+
     def __init__(self, data, default):
         super().__init__(data)
         self.default = default
 
-    def get(self, key, default=None):
-        return super().get(key, self.default)
+    def get(self, key, default=_MISSING):
+        # the table's own default applies only when the caller did not
+        # pass one — plain dict.get semantics must not be shadowed for
+        # callers that supply an explicit fallback
+        if default is DefaultTable._MISSING:
+            default = self.default
+        return super().get(key, default)
 
 
 class EvalEnv:

@@ -28,6 +28,16 @@ class Args(object, metaclass=Singleton):
         # (device dispatch + sweep latency exceeds the whole CPU solve);
         # measured on the embedded corpus, see laser/batch.py
         self.device_min_lanes = 8
+        # adaptive dispatch profit gate: only pay device dispatch when
+        # the projected CPU cost of the residue (lanes x observed
+        # native ms/query) clears this bar.  Measured (scale_mul d6 on
+        # the real chip): dispatches average 0.5-2.4 s while the tuned
+        # CDCL clears the same lanes at 2-15 ms each — an unconditional
+        # dispatch policy made full mode 20x slower than nodevice.
+        self.device_min_save_s = 0.5
+        # capability/benchmark override: dispatch whenever the size
+        # gates allow, ignoring the profit projection
+        self.device_force_dispatch = False
 
 
 args = Args()

@@ -95,6 +95,7 @@ def test_batch_check_states_routes_through_mesh(monkeypatch):
     from mythril_tpu.support.support_args import args
 
     monkeypatch.setattr(args, "device_min_lanes", 2)
+    monkeypatch.setattr(args, "device_force_dispatch", True)
     # explicit opt-in: auto mode skips the device on non-TPU backends,
     # "off" selects the gather/mesh path with the dense kernel disabled
     monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
@@ -139,3 +140,46 @@ def test_learnt_clause_sharing():
     if absorbed:
         # absorbed learnts carry a cone owner so sweeps can reach them
         assert ctx.pool_version > 0
+
+
+def test_corpus_shard_places_arrays_on_assigned_device(monkeypatch):
+    """Contract-level data parallelism (SURVEY §2.16: shard a corpus
+    across chips): inside corpus_shard(i), dense dispatches must place
+    their planes on devices[i % n], so independent contracts use
+    independent chips."""
+    import jax
+
+    monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "force")
+    from mythril_tpu.ops.device_placement import corpus_shard, place
+    from mythril_tpu.smt import symbol_factory
+    from mythril_tpu.smt.solver import get_blast_context, reset_blast_context
+
+    devices = jax.devices()
+    assert len(devices) >= 2, "conftest provides 8 virtual devices"
+    import numpy as np
+
+    with corpus_shard(3):
+        arr = place(np.arange(8, dtype=np.int32))
+        assert arr.devices() == {devices[3 % len(devices)]}
+    # outside the context: default placement again
+    arr = place(np.arange(8, dtype=np.int32))
+    assert hasattr(arr, "shape")  # identity (numpy) — no forced device
+
+    # end-to-end: a dispatch inside a shard context succeeds and the
+    # telemetry records the assigned device
+    reset_blast_context()
+    ctx = get_blast_context()
+    from mythril_tpu.ops import batched_sat as BS
+    from mythril_tpu.ops.pallas_prop import PallasSatBackend
+
+    x = symbol_factory.BitVecSym("shard_x", 16)
+    sets = [[ctx.blast_lit((x == v).raw)] for v in range(3, 11)]
+    BS.dispatch_stats.reset()
+    with corpus_shard(5):
+        out = PallasSatBackend().check_assumption_sets(ctx, sets)
+    assert out is not None
+    results, assignments = out
+    assert all(r is not False for r in results)  # all lanes satisfiable
+    assert BS.dispatch_stats.corpus_shard_device == devices[
+        5 % len(devices)
+    ].id

@@ -87,8 +87,10 @@ def test_batch_check_states_uses_pallas(monkeypatch):
     from mythril_tpu.support.support_args import args
 
     # the host word-level probe decides the SAT lanes before dispatch;
-    # drop the residue gate so the 3 UNSAT lanes still reach the kernel
+    # drop the residue/profit gates so the UNSAT lanes still reach the
+    # kernel (the adaptive gate would route this tiny residue to CDCL)
     monkeypatch.setattr(args, "device_min_lanes", 2)
+    monkeypatch.setattr(args, "device_force_dispatch", True)
     lanes = _lane_constraints(6)
     verdicts = batch_check_states([Constraints(lane) for lane in lanes])
     for i, v in enumerate(verdicts):
@@ -271,6 +273,7 @@ def test_futile_dispatch_fuse(monkeypatch):
 
     monkeypatch.setattr(args, "device_min_lanes", 2)
     monkeypatch.setattr(args, "word_probing", False)
+    monkeypatch.setattr(args, "device_force_dispatch", True)
     backend = BS.get_backend()
 
     # force "engaged but nothing decided" outcomes without a device:
@@ -321,6 +324,7 @@ def test_fuse_retry_rearms_on_decision(monkeypatch):
 
     monkeypatch.setattr(args, "device_min_lanes", 2)
     monkeypatch.setattr(args, "word_probing", False)
+    monkeypatch.setattr(args, "device_force_dispatch", True)
     backend = BS.get_backend()
     mode = {"deciding": False}
 
@@ -529,3 +533,27 @@ def test_layout_chooser_picks_batched_for_disjoint_cones(monkeypatch):
         env = _env_from_assignment(ctx, assignments[i])
         for c in lanes[i]:
             assert T.evaluate(c.raw, env) is True, f"lane {i} model bad"
+
+
+def test_profit_gate_routes_cheap_residues_to_cdcl(monkeypatch):
+    """The adaptive dispatch gate: when the analysis's own observed
+    native CDCL cost projects the residue cheaper than a device
+    dispatch, the frontier goes straight to the CDCL tail and the skip
+    is counted (device never pays unless it is projected to win)."""
+    from mythril_tpu.laser.ethereum.state.constraints import Constraints
+    from mythril_tpu.ops import batched_sat as BS
+    from mythril_tpu.smt.solver import SolverStatistics
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setattr(args, "device_min_lanes", 2)
+    monkeypatch.setattr(args, "word_probing", False)
+    stats = SolverStatistics()
+    monkeypatch.setattr(stats, "enabled", True)
+    monkeypatch.setattr(stats, "native_s", 0.004)   # observed 2 ms/query
+    monkeypatch.setattr(stats, "native_calls", 2)
+    lanes = _lane_constraints(6)
+    BS.dispatch_stats.reset()
+    before = BS.dispatch_stats.dispatches
+    BS.batch_check_states([Constraints(lane) for lane in lanes])
+    assert BS.dispatch_stats.dispatches == before  # no dispatch paid
+    assert BS.dispatch_stats.profit_skips >= 1
