@@ -87,6 +87,10 @@ class DispatchStats:
         # dispatches skipped because the projected CPU cost of the
         # residue did not clear args.device_min_save_s
         self.profit_skips = 0
+        # dispatch attempts abandoned because the device health probe
+        # failed (wedged tunnel etc.) — explains zero dispatches on a
+        # host whose accelerator is down
+        self.unhealthy_skips = 0
 
     def as_dict(self):
         return dict(self.__dict__)
@@ -434,6 +438,7 @@ class BatchedSatBackend:
 
         num_vars = ctx.solver.num_vars
         if not device_ok():
+            dispatch_stats.unhealthy_skips += 1
             self.last_assignments = np.zeros(
                 (len(assumption_sets), num_vars + 1), np.int8
             )
