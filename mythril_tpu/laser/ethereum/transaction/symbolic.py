@@ -155,7 +155,32 @@ def _setup_global_state_for_execution(
     new_node.states.append(global_state)
     global_state.node = new_node
     new_node.constraints = global_state.world_state.constraints
-    laser_evm.work_list.append(global_state)
+
+    # concrete-prefix dispatch (laser/ethereum/lockstep_dispatch.py):
+    # a validated dispatcher prefix lets the seed be replaced by
+    # per-selector states positioned at the function entries, skipping
+    # the per-state symbolic re-execution of the dispatcher chain and
+    # its per-fork feasibility checks
+    split = None
+    if isinstance(transaction, MessageCallTransaction) and isinstance(
+        transaction.call_data, SymbolicCalldata
+    ):
+        from mythril_tpu.laser.ethereum.lockstep_dispatch import (
+            presplit_states,
+        )
+
+        split = presplit_states(global_state)
+    if split:
+        from mythril_tpu.ops.batched_sat import dispatch_stats
+
+        for state, condition in split:
+            laser_evm._new_node_state(
+                state, JumpType.CONDITIONAL, condition
+            )
+            laser_evm.work_list.append(state)
+        dispatch_stats.presplit_states += len(split)
+    else:
+        laser_evm.work_list.append(global_state)
 
 
 def execute_contract_creation(

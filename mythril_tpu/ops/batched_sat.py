@@ -91,6 +91,9 @@ class DispatchStats:
         # failed (wedged tunnel etc.) — explains zero dispatches on a
         # host whose accelerator is down
         self.unhealthy_skips = 0
+        # transaction seeds replaced by dispatcher pre-split states
+        # (laser/ethereum/lockstep_dispatch.py)
+        self.presplit_states = 0
 
     def as_dict(self):
         return dict(self.__dict__)

@@ -690,40 +690,79 @@ for _k in range(16):
 
 
 @functools.lru_cache(maxsize=8)
-def _jit_run(bucket: int, max_steps: int):
+def _jit_run(bucket: int, max_steps: int, record_visited: bool = False):
     """One compiled runner per (code-length bucket, step cap) — shared
-    by every program in the bucket (code/jumpdest are arguments)."""
+    by every program in the bucket (code/jumpdest are arguments).
+
+    ``record_visited`` additionally maintains a per-lane visited-pc
+    bitmap (u32[B, bucket/32]): concrete per-lane coverage, used by the
+    dispatcher pre-split validation (laser/ethereum/lockstep_dispatch)
+    to prove a selector's concrete execution reaches its mapped entry.
+    """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     step = make_step()
+    words = bucket // 32 + 1
 
     def run(state, code, jumpdest, code_len):
+        B = state.pc.shape[0]
+        rows = jnp.arange(B)
+
         def cond(carry):
-            state, i = carry
+            state, _visited, i = carry
             return jnp.any(state.halt == RUNNING) & (i < max_steps)
 
         def body(carry):
-            state, i = carry
-            return step(state, code, jumpdest, code_len), i + 1
+            state, visited, i = carry
+            if record_visited:
+                active = state.halt == RUNNING
+                word = jnp.clip(state.pc >> 5, 0, words - 1)
+                bit = jnp.where(
+                    active,
+                    (jnp.uint32(1) << (state.pc & 31).astype(jnp.uint32)),
+                    jnp.uint32(0),
+                )
+                visited = visited.at[rows, word].set(
+                    visited[rows, word] | bit
+                )
+            return step(state, code, jumpdest, code_len), visited, i + 1
 
-        state, steps = lax.while_loop(cond, body, (state, 0))
-        return state, steps
+        visited0 = jnp.zeros(
+            (B, words if record_visited else 1), jnp.uint32
+        )
+        state, visited, steps = lax.while_loop(
+            cond, body, (state, visited0, 0)
+        )
+        return state, visited, steps
 
     return jax.jit(run)
 
 
-def run_batch(code: bytes, state, max_steps: int = 4096):
-    """Run all lanes to halt (or the step cap) and return the final
-    state + step count."""
+def run_batch(code: bytes, state, max_steps: int = 4096,
+              record_visited: bool = False):
+    """Run all lanes to halt (or the step cap).  Returns
+    ``(state, steps)``, or ``(state, visited, steps)`` with the
+    visited-pc bitmap when ``record_visited``."""
     import jax.numpy as jnp
 
     program = prepare_program(bytes(code))
-    run = _jit_run(len(program.code), max_steps)
-    return run(
+    run = _jit_run(len(program.code), max_steps, record_visited)
+    state, visited, steps = run(
         state,
         jnp.asarray(program.code),
         jnp.asarray(program.jumpdest),
         jnp.int32(program.length),
     )
+    if record_visited:
+        return state, visited, steps
+    return state, steps
+
+
+def pc_visited(visited, lane: int, pc: int) -> bool:
+    """Did ``lane`` execute the instruction at byte offset ``pc``?"""
+    import numpy as np
+
+    word = np.asarray(visited)[lane, pc >> 5]
+    return bool((int(word) >> (pc & 31)) & 1)

@@ -38,6 +38,16 @@ class Args(object, metaclass=Singleton):
         # capability/benchmark override: dispatch whenever the size
         # gates allow, ignoring the profit projection
         self.device_force_dispatch = False
+        # concrete-prefix dispatcher pre-split (SoA-validated): replace
+        # each transaction seed with per-selector states at the
+        # function entries (laser/ethereum/lockstep_dispatch.py).
+        # Measured on batchtoken -t 2 (3 alternating reps, pinned CPU):
+        # findings identical, median wall 47.2 s off vs 51.8 s on — the
+        # dispatcher prefix is too cheap for the skip to pay and the
+        # substituted selector constraints probe slightly worse, so the
+        # pre-split stays opt-in until the SoA stepper displaces more
+        # than the prefix.
+        self.lockstep_dispatch = False
 
 
 args = Args()

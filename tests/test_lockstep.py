@@ -252,3 +252,54 @@ def test_replay_confirms_selfdestruct_issue():
         }
 
     assert replay_issue(MissIssue(), code) == "executed"
+
+
+def test_dispatcher_presplit_positions_and_findings(monkeypatch):
+    """Concrete-prefix dispatch (SURVEY §7.2.1 first step): the
+    SoA-validated plan must map every discovered selector to its entry,
+    the pre-split states must sit AT those entries with the selector
+    constraint attached, and a full analysis with the pre-split on must
+    find exactly the same issues as the classic path."""
+    import logging
+
+    logging.getLogger("mythril_tpu").setLevel(logging.CRITICAL)
+    import bench
+    from mythril_tpu.disassembler.disassembly import Disassembly
+    from mythril_tpu.laser.ethereum import lockstep_dispatch as LD
+    from mythril_tpu.support.support_args import args
+
+    code = bench.batchtoken_contract()
+    disassembly = Disassembly(code)
+    plan = LD.dispatcher_plan(disassembly)
+    assert plan is not None, "canonical dispatcher must match + validate"
+    # every discovered function entry is covered by the plan
+    assert set(plan.branches) == {
+        int(h, 16) if isinstance(h, str) else h
+        for h in (
+            int.from_bytes(bytes.fromhex("a9059cbb"), "big"),  # transfer
+            int.from_bytes(bytes.fromhex("6001f88d"), "big"),
+            int.from_bytes(bytes.fromhex("095ea7b3"), "big"),  # approve
+        )
+    }
+    for selector, (entry, entry_index, gmin, gmax, depth) in (
+        plan.branches.items()
+    ):
+        assert disassembly.instruction_list[entry_index].address == entry
+        assert disassembly.instruction_list[entry_index].op_code == "JUMPDEST"
+        assert 0 < gmin <= gmax
+        assert depth >= 1
+
+    # end-to-end: same findings with the pre-split enabled
+    monkeypatch.setattr(args, "lockstep_dispatch", True)
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+
+    found, row = bench._analyze_one(
+        "bt_presplit", code, 1, execution_timeout=90, max_depth=128
+    )
+    assert row["presplit_states"] > 0, "pre-split must have engaged"
+    assert "101" in found
+    monkeypatch.setattr(args, "lockstep_dispatch", False)
+    found_classic, _ = bench._analyze_one(
+        "bt_classic", code, 1, execution_timeout=90, max_depth=128
+    )
+    assert found == found_classic, (found, found_classic)
