@@ -120,6 +120,14 @@ class MythrilAnalyzer:
             custom_modules_directory=cfg.custom_modules_directory,
         )
         settings.update(overrides)
+        # None uniformly means "use the executor's default": forwarding
+        # it verbatim would poison downstream (max_depth's strategy
+        # comparison, transaction_count's range(), loop_bound's
+        # BoundedLoops opt-in)
+        settings = {
+            key: value for key, value in settings.items()
+            if value is not None
+        }
         return SymExecWrapper(
             contract or self.contracts[0],
             cfg.address,

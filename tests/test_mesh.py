@@ -183,3 +183,43 @@ def test_corpus_shard_places_arrays_on_assigned_device(monkeypatch):
     assert BS.dispatch_stats.corpus_shard_device == devices[
         5 % len(devices)
     ].id
+
+
+def test_analyzer_shards_contract_corpus(monkeypatch):
+    """fire_lasers over several contracts must enter one corpus_shard
+    context per contract with the round-robin index — the analyzer-level
+    wiring of contract-axis data parallelism."""
+    import logging
+
+    logging.getLogger("mythril_tpu").setLevel(logging.CRITICAL)
+    from mythril_tpu.mythril.mythril_analyzer import MythrilAnalyzer
+    from mythril_tpu.mythril.mythril_disassembler import MythrilDisassembler
+    from mythril_tpu.support import assembler
+
+    code = assembler.asm("CALLER; SUICIDE")
+    disassembler = MythrilDisassembler(eth=None)
+    disassembler.load_from_bytecode(code, bin_runtime=True)
+    disassembler.load_from_bytecode(code, bin_runtime=True)
+    disassembler.load_from_bytecode(code, bin_runtime=True)
+
+    entered = []
+    from mythril_tpu.ops import device_placement as DP
+
+    real_shard = DP.corpus_shard
+
+    def spy(index):
+        entered.append(index)
+        return real_shard(index)
+
+    monkeypatch.setattr(DP, "corpus_shard", spy)
+    analyzer = MythrilAnalyzer(
+        disassembler,
+        strategy="bfs",
+        execution_timeout=30,
+        use_onchain_data=False,
+        address="0x0901d12ebe1b195e5aa8748e62bd7734ae19b51f",
+    )
+    report = analyzer.fire_lasers(transaction_count=1)
+    assert entered == [0, 1, 2], entered
+    swcs = {issue.swc_id for issue in report.issues.values()}
+    assert "106" in swcs
