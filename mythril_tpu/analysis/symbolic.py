@@ -105,7 +105,15 @@ class SymExecWrapper:
         )
         if loop_bound is not None:
             self.laser.extend_strategy(BoundedLoopsStrategy, loop_bound)
-        self._instrument(disable_dependency_pruning)
+        plugins = self._instrument(disable_dependency_pruning)
+        if enable_coverage_strategy and "coverage" in plugins:
+            from mythril_tpu.laser.plugin.plugins.coverage.coverage_strategy import (
+                CoverageStrategy,
+            )
+
+            self.laser.extend_strategy(
+                CoverageStrategy, plugins["coverage"]
+            )
         if run_analysis_modules:
             self._attach_detection_hooks(modules)
 
@@ -154,7 +162,7 @@ class SymExecWrapper:
         loader.add_args("call-depth-limit", call_depth_limit=args.call_depth_limit)
         if not disable_dependency_pruning:
             loader.load(DependencyPrunerBuilder())
-        loader.instrument_virtual_machine(self.laser, None)
+        return loader.instrument_virtual_machine(self.laser, None)
 
     def _attach_detection_hooks(self, modules: Optional[List[str]]) -> None:
         callback_modules = ModuleLoader().get_detection_modules(

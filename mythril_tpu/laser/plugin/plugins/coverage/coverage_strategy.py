@@ -10,13 +10,13 @@ from mythril_tpu.laser.plugin.plugins.coverage.coverage_plugin import (
 
 
 class CoverageStrategy(BasicSearchStrategy):
-    def __init__(
-        self,
-        super_strategy: BasicSearchStrategy,
-        coverage_plugin: InstructionCoveragePlugin,
-    ):
+    """Decorator strategy; instantiated via LaserEVM.extend_strategy,
+    whose convention passes constructor extras as one args tuple
+    (args[0] = the live InstructionCoveragePlugin)."""
+
+    def __init__(self, super_strategy: BasicSearchStrategy, args):
         self.super_strategy = super_strategy
-        self.coverage_plugin = coverage_plugin
+        self.coverage_plugin: InstructionCoveragePlugin = args[0]
         super().__init__(super_strategy.work_list, super_strategy.max_depth)
 
     def get_strategic_global_state(self) -> GlobalState:

@@ -198,3 +198,38 @@ def test_state_change_after_call_swc_107():
     )
     found, _ = _analyze(code, 1)
     assert "107" in found, found
+
+
+def test_coverage_strategy_analysis_runs():
+    """--enable-coverage-strategy must actually wrap the search
+    strategy in CoverageStrategy around the live coverage plugin (the
+    wiring was silently dropped once — a run with the flag behaved
+    identically to one without), and the analysis still produces the
+    expected finding."""
+    import bench
+    from mythril_tpu.analysis.security import fire_lasers
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.laser.ethereum.time_handler import time_handler
+    from mythril_tpu.laser.plugin.plugins.coverage.coverage_strategy import (
+        CoverageStrategy,
+    )
+    from mythril_tpu.solidity.evmcontract import EVMContract
+
+    _reset_analysis_state()
+    code = bench._corpus()[0][1]  # killbilly
+    time_handler.start_execution(60)
+    sym = SymExecWrapper(
+        EVMContract(code=code, name="covstrat"),
+        address=0x901D12EBE1B195E5AA8748E62BD7734AE19B51F,
+        strategy="bfs",
+        max_depth=128,
+        execution_timeout=60,
+        create_timeout=10,
+        transaction_count=1,
+        enable_coverage_strategy=True,
+    )
+    strategy = sym.laser.strategy
+    assert isinstance(strategy, CoverageStrategy), type(strategy)
+    assert strategy.coverage_plugin.coverage, "plugin saw no execution"
+    issues = fire_lasers(sym)
+    assert "106" in {i.swc_id for i in issues}
