@@ -33,6 +33,21 @@ _verdict: Optional[bool] = None
 _backend_name: Optional[str] = None
 
 
+def subprocess_probe_ok(timeout_s: Optional[float] = None) -> bool:
+    """The killable-subprocess verdict ALONE — for callers that must
+    decide a platform demotion BEFORE any in-process jax touch (the
+    driver entry points in __graft_entry__.py).  The full
+    :func:`device_ok` additionally warms backend init in-process,
+    which on a tunnel that wedges mid-init parks a zombie thread
+    inside jax's backend lock — past that point no demotion can
+    rescue the process, so the decision has to come first."""
+    if timeout_s is None:
+        timeout_s = float(
+            os.environ.get("MYTHRIL_TPU_HEALTH_TIMEOUT", "60")
+        )
+    return _subprocess_preprobe(timeout_s)
+
+
 def _subprocess_preprobe(timeout_s: float) -> bool:
     """Backend discovery + a tiny computation in a KILLABLE subprocess.
 
