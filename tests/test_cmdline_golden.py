@@ -106,6 +106,8 @@ EXACT_CASES = [
     ("suicide.sol.o", {"106"}),
     ("origin.sol.o", {"115"}),
     ("exceptions.sol.o", {"110"}),
+    ("calls.sol.o", {"104", "107"}),
+    ("returnvalue.sol.o", {"104", "107"}),
     ("environments.sol.o", {"101"}),
     ("kinds_of_calls.sol.o", {"104", "107", "112"}),
     ("metacoin.sol.o", {"101"}),
