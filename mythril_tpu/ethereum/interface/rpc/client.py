@@ -8,6 +8,7 @@ no node is reachable (the common case in this environment).
 
 import json
 import logging
+import urllib.error
 import urllib.request
 from typing import Any, List, Optional
 
@@ -97,6 +98,11 @@ class EthJsonRpc(BaseClient):
                 if response.status != 200:
                     raise BadStatusCodeError(str(response.status))
                 body = response.read()
+        except urllib.error.HTTPError as e:
+            # urlopen raises (rather than returns) non-2xx responses;
+            # without this branch an HTTP 500 would misclassify as a
+            # connection failure (HTTPError subclasses OSError)
+            raise BadStatusCodeError(str(e.code))
         except OSError as e:
             raise ConnectionError_(str(e))
         try:

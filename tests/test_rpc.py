@@ -17,6 +17,7 @@ import pytest
 from mythril_tpu.ethereum.interface.rpc.client import (
     BadJsonError,
     BadResponseError,
+    BadStatusCodeError,
     ClientError,
     ConnectionError_,
     EthJsonRpc,
@@ -102,6 +103,20 @@ def test_error_paths_surface_as_client_errors():
         "urllib.request.urlopen", side_effect=OSError("refused")
     ):
         with pytest.raises(ConnectionError_):
+            client.eth_getCode("0x" + "44" * 20)
+    # urlopen RAISES non-2xx responses as HTTPError (an OSError
+    # subclass): the client must classify them as status errors, not
+    # connection failures — a regression here once made every HTTP 500
+    # look like an unreachable node
+    import urllib.error
+
+    with mock.patch(
+        "urllib.request.urlopen",
+        side_effect=urllib.error.HTTPError(
+            "http://n", 500, "boom", None, None
+        ),
+    ):
+        with pytest.raises(BadStatusCodeError):
             client.eth_getCode("0x" + "44" * 20)
     assert issubclass(ConnectionError_, ClientError)
 
