@@ -244,10 +244,24 @@ def create_analyzer_parser(analyzer_parser: argparse.ArgumentParser) -> None:
         help="Enable solving parallelization",
     )
     options.add_argument(
-        "--batched-solving",
+        "--no-batched-solving",
         action="store_true",
-        default=True,
-        help="Batch frontier feasibility checks on the accelerator (default on)",
+        help="Disable the batched frontier feasibility path (per-state "
+        "solving only, as in the reference)",
+    )
+    options.add_argument(
+        "--device-force-dispatch",
+        action="store_true",
+        help="Dispatch frontiers to the accelerator whenever the size "
+        "gates allow, bypassing the adaptive profit gate (capability/"
+        "benchmark runs)",
+    )
+    options.add_argument(
+        "--lockstep-dispatch",
+        action="store_true",
+        help="Pre-split transaction seeds by function selector via the "
+        "SoA-validated dispatcher plan (experimental, see "
+        "docs/measurements_r3.md)",
     )
     options.add_argument(
         "--no-onchain-data",
@@ -499,6 +513,17 @@ def _build_analyzer(
 ) -> MythrilAnalyzer:
     """One construction point for MythrilAnalyzer from CLI flags
     (shared by analyze and truffle so new flags can't drift apart)."""
+    from mythril_tpu.support.support_args import args as support_args
+
+    support_args.batched_solving = not getattr(
+        args, "no_batched_solving", False
+    )
+    support_args.device_force_dispatch = getattr(
+        args, "device_force_dispatch", False
+    )
+    support_args.lockstep_dispatch = getattr(
+        args, "lockstep_dispatch", False
+    )
     return MythrilAnalyzer(
         strategy=args.strategy,
         disassembler=disassembler,
