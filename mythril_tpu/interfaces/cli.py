@@ -513,18 +513,10 @@ def _build_analyzer(
 ) -> MythrilAnalyzer:
     """One construction point for MythrilAnalyzer from CLI flags
     (shared by analyze and truffle so new flags can't drift apart)."""
-    from mythril_tpu.support.support_args import args as support_args
-
-    support_args.batched_solving = not getattr(
-        args, "no_batched_solving", False
-    )
-    support_args.device_force_dispatch = getattr(
-        args, "device_force_dispatch", False
-    )
-    support_args.lockstep_dispatch = getattr(
-        args, "lockstep_dispatch", False
-    )
     return MythrilAnalyzer(
+        batched_solving=not args.no_batched_solving,
+        device_force_dispatch=args.device_force_dispatch,
+        lockstep_dispatch=args.lockstep_dispatch,
         strategy=args.strategy,
         disassembler=disassembler,
         address=address,

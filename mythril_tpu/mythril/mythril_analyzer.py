@@ -79,6 +79,9 @@ class MythrilAnalyzer:
         call_depth_limit: int = 3,
         enable_coverage_strategy: bool = False,
         shard_corpus: bool = True,
+        batched_solving: bool = True,
+        device_force_dispatch: bool = False,
+        lockstep_dispatch: bool = False,
     ):
         self.eth = disassembler.eth
         self.contracts: List[EVMContract] = disassembler.contracts or []
@@ -104,6 +107,9 @@ class MythrilAnalyzer:
         args.unconstrained_storage = unconstrained_storage
         args.call_depth_limit = call_depth_limit
         args.iprof = enable_iprof
+        args.batched_solving = batched_solving
+        args.device_force_dispatch = device_force_dispatch
+        args.lockstep_dispatch = lockstep_dispatch
 
     # ------------------------------------------------------------------
     # symbolic-executor factory — single assembly point for every mode
