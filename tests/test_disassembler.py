@@ -111,3 +111,30 @@ def test_disassembles_real_solc_output():
         assert len(disassembly.instruction_list) > 10, name
         count += 1
     assert count > 5
+
+
+# -- EVMContract surface (reference: tests/evmcontract_test.py) --------------
+
+_EVMC_CODE = (
+    "0x60606040525b603c5b60006010603e565b9050593681016040523660008237"
+    "602060003683856040603f5a0204f41560545760206000f35bfe5b50565b005b"
+    "73c3b2ae46792547a96b9f84405e36d0e07edcd05c5b905600a165627a7a7230"
+    "582062a884f947232ada573f95940cce9c8bfb7e4e14e21df5af4e884941afb5"
+    "5e590029"
+)
+
+
+def test_evmcontract_instruction_list_length():
+    from mythril_tpu.solidity.evmcontract import EVMContract
+
+    contract = EVMContract(_EVMC_CODE, _EVMC_CODE)
+    assert len(contract.disassembly.instruction_list) == 53
+
+
+def test_evmcontract_easm_and_expression_matching():
+    from mythril_tpu.solidity.evmcontract import EVMContract
+
+    contract = EVMContract(_EVMC_CODE)
+    assert "PUSH1 0x60" in contract.get_easm()
+    assert contract.matches_expression("code#PUSH1# or code#PUSH1#")
+    assert not contract.matches_expression("func#abcdef#")
