@@ -83,8 +83,84 @@ def load() -> ctypes.CDLL:
         lib.cdcl_set_relevant.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
         ]
+        lib.cdcl_num_vars.argtypes = [ctypes.c_void_p]
+        lib.cdcl_num_vars.restype = ctypes.c_int32
         lib.keccak256_native.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+        ]
+        # clause pool + gate layer (pool.cpp)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.pool_new.argtypes = [ctypes.c_void_p]
+        lib.pool_new.restype = ctypes.c_void_p
+        lib.pool_free.argtypes = [ctypes.c_void_p]
+        lib.pool_new_var.argtypes = [ctypes.c_void_p]
+        lib.pool_new_var.restype = ctypes.c_int32
+        lib.pool_clause.argtypes = [
+            ctypes.c_void_p, i32p, ctypes.c_int32, ctypes.c_int32,
+            i32p, ctypes.c_int32,
+        ]
+        for name in ("pool_and2", "pool_xor2"):
+            fn = getattr(lib, name)
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+            fn.restype = ctypes.c_int32
+        for name in ("pool_xor3", "pool_maj", "pool_mux"):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32,
+            ]
+            fn.restype = ctypes.c_int32
+        lib.pool_and_many.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int64]
+        lib.pool_and_many.restype = ctypes.c_int32
+        lib.pool_add_bits.argtypes = [
+            ctypes.c_void_p, i32p, i32p, ctypes.c_int32, ctypes.c_int32,
+            i32p, i32p,
+        ]
+        for name in ("pool_ult_lit", "pool_eq_lit"):
+            fn = getattr(lib, name)
+            fn.argtypes = [ctypes.c_void_p, i32p, i32p, ctypes.c_int32]
+            fn.restype = ctypes.c_int32
+        lib.pool_mux_bits.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, i32p, i32p, ctypes.c_int32, i32p,
+        ]
+        lib.pool_map_bits.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, i32p, i32p, ctypes.c_int32, i32p,
+        ]
+        lib.pool_mul_bits.argtypes = [
+            ctypes.c_void_p, i32p, i32p, ctypes.c_int32, i32p,
+        ]
+        lib.pool_udivmod_bits.argtypes = [
+            ctypes.c_void_p, i32p, i32p, ctypes.c_int32, i32p, i32p,
+        ]
+        lib.pool_congruence.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, i32p, i32p, ctypes.c_int32,
+        ]
+        lib.pool_absorb_learnts.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.pool_absorb_learnts.restype = ctypes.c_int64
+        lib.pool_nogood.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int32]
+        lib.pool_nogood.restype = ctypes.c_int32
+        lib.pool_cone.argtypes = [
+            ctypes.c_void_p, i32p, ctypes.c_int64, ctypes.c_int32, i64p, i64p,
+        ]
+        lib.pool_cone_fetch.argtypes = [ctypes.c_void_p, i64p, i32p]
+        for name in ("pool_num_clauses", "pool_lits_len", "pool_version",
+                     "pool_absorbed_count"):
+            fn = getattr(lib, name)
+            fn.argtypes = [ctypes.c_void_p]
+            fn.restype = ctypes.c_int64
+        lib.pool_csr_into.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, i32p, i64p,
+        ]
+        lib.pool_padded_rows.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            i32p, i64p,
+        ]
+        lib.pool_padded_rows.restype = ctypes.c_int64
+        lib.pool_subset_sizes.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64]
+        lib.pool_subset_sizes.restype = ctypes.c_int64
+        lib.pool_subset_csr.argtypes = [
+            ctypes.c_void_p, i64p, ctypes.c_int64, i32p, i64p,
         ]
         _lib = lib
         return lib
@@ -111,7 +187,6 @@ class SatSolver:
         self._handle = self._lib.cdcl_new()
         # var 1 is the constant-TRUE anchor allocated by the solver ctor
         self.true_var = 1
-        self.num_vars = 1
 
     def __del__(self):
         try:
@@ -119,10 +194,14 @@ class SatSolver:
         except Exception:
             pass
 
+    @property
+    def num_vars(self) -> int:
+        """Total variables allocated (vars are allocated both here and
+        through the native pool's gate layer, so the count lives in C)."""
+        return self._lib.cdcl_num_vars(self._handle)
+
     def new_var(self) -> int:
-        var = self._lib.cdcl_new_var(self._handle)
-        self.num_vars = max(self.num_vars, var)
-        return var
+        return self._lib.cdcl_new_var(self._handle)
 
     def add_clause(self, lits: Sequence[int]) -> bool:
         """False when the clause makes the instance trivially UNSAT."""
@@ -140,22 +219,6 @@ class SatSolver:
         arr = (ctypes.c_int32 * len(assumptions))(*assumptions)
         return self._lib.cdcl_solve(
             self._handle, arr, len(assumptions), conflict_budget, time_budget_s
-        )
-
-    def add_clauses_flat(self, flat) -> int:
-        """Bulk clause load from a 0-separated int32 numpy array (one
-        ctypes crossing for the whole batch).  Returns the number of
-        clauses consumed; negative when the database became trivially
-        UNSAT."""
-        import numpy as np
-
-        buf = np.ascontiguousarray(flat, dtype=np.int32)
-        return int(
-            self._lib.cdcl_add_clauses(
-                self._handle,
-                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                buf.size,
-            )
         )
 
     def model_value(self, variable: int) -> bool:
@@ -193,29 +256,6 @@ class SatSolver:
             buf.size,
         )
 
-    def learnt_clauses(
-        self, max_width: int = 8, from_index: int = 0, cap: int = 1 << 18
-    ):
-        """(clauses, next_index): short learned clauses added since
-        ``from_index`` — the device pool absorbs these so CDCL-derived
-        pruning power transfers to the batched BCP kernels."""
-        out = (ctypes.c_int32 * cap)()
-        next_index = ctypes.c_int64(from_index)
-        written = self._lib.cdcl_learnt_clauses(
-            self._handle, max_width, from_index, out,
-            cap, ctypes.byref(next_index),
-        )
-        clauses = []
-        clause: List[int] = []
-        for i in range(written):
-            lit = out[i]
-            if lit == 0:
-                clauses.append(tuple(clause))
-                clause = []
-            else:
-                clause.append(lit)
-        return clauses, int(next_index.value)
-
     @property
     def conflicts(self) -> int:
         return self._lib.cdcl_conflicts(self._handle)
@@ -223,3 +263,268 @@ class SatSolver:
     @property
     def num_clauses(self) -> int:
         return self._lib.cdcl_num_clauses(self._handle)
+
+
+def _i32arr(xs):
+    import numpy as np
+
+    if isinstance(xs, np.ndarray):
+        return np.ascontiguousarray(xs, dtype=np.int32)
+    return np.fromiter(xs, dtype=np.int32, count=len(xs))
+
+
+class NativePool:
+    """ctypes wrapper over the native clause pool + gate layer
+    (csrc/pool.cpp).  Every emitted clause lands in the CSR store AND
+    the wrapped CDCL instance in the same native call — there is no
+    host-side clause mirror and no flush step.  The blaster keeps only
+    the term-DAG-facing caches (bits per node); gate dedup, the
+    defining-cone index, and the cone BFS all live natively."""
+
+    def __init__(self, solver: SatSolver):
+        self._lib = load()
+        self.solver = solver  # keeps the CDCL handle alive
+        self._handle = self._lib.pool_new(solver._handle)
+
+    def __del__(self):
+        try:
+            self._lib.pool_free(self._handle)
+        except Exception:
+            pass
+
+    # ---- allocation + raw clauses ----
+
+    def new_var(self) -> int:
+        return self._lib.pool_new_var(self._handle)
+
+    def clause(self, lits, owner: int = 0, extras=()) -> None:
+        n = len(lits)
+        arr = (ctypes.c_int32 * n)(*lits)
+        if extras:
+            earr = (ctypes.c_int32 * len(extras))(*extras)
+            self._lib.pool_clause(
+                self._handle, arr, n, owner, earr, len(extras)
+            )
+        else:
+            self._lib.pool_clause(self._handle, arr, n, owner, None, 0)
+
+    # ---- gates ----
+
+    def g_and(self, a: int, b: int) -> int:
+        return self._lib.pool_and2(self._handle, a, b)
+
+    def g_or(self, a: int, b: int) -> int:
+        return -self._lib.pool_and2(self._handle, -a, -b)
+
+    def g_xor(self, a: int, b: int) -> int:
+        return self._lib.pool_xor2(self._handle, a, b)
+
+    def g_xor3(self, a: int, b: int, c: int) -> int:
+        return self._lib.pool_xor3(self._handle, a, b, c)
+
+    def g_maj(self, a: int, b: int, c: int) -> int:
+        return self._lib.pool_maj(self._handle, a, b, c)
+
+    def g_mux(self, s: int, a: int, b: int) -> int:
+        return self._lib.pool_mux(self._handle, s, a, b)
+
+    def g_and_many(self, lits) -> int:
+        arr = (ctypes.c_int32 * len(lits))(*lits)
+        return self._lib.pool_and_many(self._handle, arr, len(lits))
+
+    # ---- word-level circuits (one crossing per word op) ----
+
+    def add_bits(self, xs, ys, cin: int):
+        n = len(xs)
+        xa = (ctypes.c_int32 * n)(*xs)
+        ya = (ctypes.c_int32 * n)(*ys)
+        out = (ctypes.c_int32 * n)()
+        carry = ctypes.c_int32()
+        self._lib.pool_add_bits(
+            self._handle, xa, ya, n, cin, out, ctypes.byref(carry)
+        )
+        return list(out), carry.value
+
+    def ult_lit(self, xs, ys) -> int:
+        n = len(xs)
+        xa = (ctypes.c_int32 * n)(*xs)
+        ya = (ctypes.c_int32 * n)(*ys)
+        return self._lib.pool_ult_lit(self._handle, xa, ya, n)
+
+    def eq_lit(self, xs, ys) -> int:
+        n = len(xs)
+        xa = (ctypes.c_int32 * n)(*xs)
+        ya = (ctypes.c_int32 * n)(*ys)
+        return self._lib.pool_eq_lit(self._handle, xa, ya, n)
+
+    def mux_bits(self, s: int, xs, ys):
+        n = len(xs)
+        xa = (ctypes.c_int32 * n)(*xs)
+        ya = (ctypes.c_int32 * n)(*ys)
+        out = (ctypes.c_int32 * n)()
+        self._lib.pool_mux_bits(self._handle, s, xa, ya, n, out)
+        return list(out)
+
+    def map_bits(self, mode: int, xs, ys):
+        """mode 0 = and, 1 = or, 2 = xor, elementwise."""
+        n = len(xs)
+        xa = (ctypes.c_int32 * n)(*xs)
+        ya = (ctypes.c_int32 * n)(*ys)
+        out = (ctypes.c_int32 * n)()
+        self._lib.pool_map_bits(self._handle, mode, xa, ya, n, out)
+        return list(out)
+
+    def mul_bits(self, xs, ys):
+        n = len(xs)
+        xa = (ctypes.c_int32 * n)(*xs)
+        ya = (ctypes.c_int32 * n)(*ys)
+        out = (ctypes.c_int32 * n)()
+        self._lib.pool_mul_bits(self._handle, xa, ya, n, out)
+        return list(out)
+
+    def udivmod_bits(self, xs, ys):
+        n = len(xs)
+        xa = (ctypes.c_int32 * n)(*xs)
+        ya = (ctypes.c_int32 * n)(*ys)
+        q = (ctypes.c_int32 * n)()
+        r = (ctypes.c_int32 * n)()
+        self._lib.pool_udivmod_bits(self._handle, xa, ya, n, q, r)
+        return list(q), list(r)
+
+    def congruence(self, same: int, a_bits, b_bits) -> None:
+        """Emit ``same -> (a_bits[i] == b_bits[i])`` clause pairs for
+        every bit in one crossing (Ackermannized array reads / UF
+        applications; see bitblast._base_array_read)."""
+        n = len(a_bits)
+        aa = (ctypes.c_int32 * n)(*a_bits)
+        ba = (ctypes.c_int32 * n)(*b_bits)
+        self._lib.pool_congruence(self._handle, same, aa, ba, n)
+
+    # ---- learned clauses + nogoods ----
+
+    def absorb_learnts(self, max_width: int = 8) -> int:
+        return int(self._lib.pool_absorb_learnts(self._handle, max_width))
+
+    def nogood(self, assumption_lits) -> bool:
+        arr = (ctypes.c_int32 * len(assumption_lits))(*assumption_lits)
+        return bool(
+            self._lib.pool_nogood(self._handle, arr, len(assumption_lits))
+        )
+
+    # ---- cone of influence ----
+
+    def cone(self, root_lits, need_clauses: bool = True):
+        """(clause indices int64, vars int64) of the defining cone of
+        ``root_lits``, both sorted ascending (numpy arrays)."""
+        import numpy as np
+
+        roots = _i32arr(root_lits)
+        n_clauses = ctypes.c_int64()
+        n_vars = ctypes.c_int64()
+        self._lib.pool_cone(
+            self._handle,
+            roots.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            roots.size, 1 if need_clauses else 0,
+            ctypes.byref(n_clauses), ctypes.byref(n_vars),
+        )
+        clauses = np.empty(n_clauses.value, dtype=np.int64)
+        cone_vars = np.empty(n_vars.value, dtype=np.int32)
+        self._lib.pool_cone_fetch(
+            self._handle,
+            clauses.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            cone_vars.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return clauses, cone_vars.astype(np.int64)
+
+    # ---- store accessors ----
+
+    @property
+    def num_clauses(self) -> int:
+        return int(self._lib.pool_num_clauses(self._handle))
+
+    @property
+    def version(self) -> int:
+        return int(self._lib.pool_version(self._handle))
+
+    @property
+    def absorbed_count(self) -> int:
+        return int(self._lib.pool_absorbed_count(self._handle))
+
+    def csr(self, from_clause: int = 0, to_clause: Optional[int] = None):
+        """(lits int32, indptr int64) copies for clauses
+        [from_clause, to_clause); indptr is rebased to 0."""
+        import numpy as np
+
+        if to_clause is None:
+            to_clause = self.num_clauses
+        count = to_clause - from_clause
+        if count <= 0:
+            return (
+                np.empty(0, dtype=np.int32),
+                np.zeros(1, dtype=np.int64),
+            )
+        total = int(self._lib.pool_lits_len(self._handle))
+        indptr = np.empty(count + 1, dtype=np.int64)
+        # worst case allocation avoided: fetch indptr first via a probe
+        # is an extra crossing; just allocate for the full store tail
+        lits = np.empty(total, dtype=np.int32)
+        self._lib.pool_csr_into(
+            self._handle, from_clause, to_clause,
+            lits.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        return lits[: indptr[-1]], indptr
+
+    def padded_rows(self, from_clause: int, to_clause: int, max_width: int):
+        """(rows [N, max_width] int32, dropped) — compacted zero-padded
+        clause rows for the dense device pools; clauses wider than
+        ``max_width`` are skipped and counted."""
+        import numpy as np
+
+        count = max(0, to_clause - from_clause)
+        out = np.zeros((count, max_width), dtype=np.int32)
+        dropped = ctypes.c_int64()
+        rows = self._lib.pool_padded_rows(
+            self._handle, from_clause, to_clause, max_width,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.byref(dropped),
+        )
+        return out[:rows], int(dropped.value)
+
+    def subset_csr(self, clause_ids):
+        """(lits int32, indptr int64) for an arbitrary clause-id list
+        (cone extraction feeds device incidence builds from this)."""
+        import numpy as np
+
+        ids = np.ascontiguousarray(clause_ids, dtype=np.int64)
+        total = int(
+            self._lib.pool_subset_sizes(
+                self._handle,
+                ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                ids.size,
+            )
+        )
+        lits = np.empty(total, dtype=np.int32)
+        indptr = np.empty(ids.size + 1, dtype=np.int64)
+        self._lib.pool_subset_csr(
+            self._handle,
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ids.size,
+            lits.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        return lits, indptr
+
+    def clause_lits(self, clause_id: int):
+        """One clause as a tuple (debug / sparse access)."""
+        lits, _ = self.subset_csr([clause_id])
+        return tuple(int(x) for x in lits)
+
+    def all_clauses(self):
+        """Materialize every clause as tuples — O(pool), tests/debug
+        only."""
+        lits, indptr = self.csr()
+        return [
+            tuple(int(x) for x in lits[indptr[i]:indptr[i + 1]])
+            for i in range(len(indptr) - 1)
+        ]

@@ -190,6 +190,7 @@ class Solver {
 
   int64_t conflicts() const { return total_conflicts_; }
   int64_t num_clauses() const { return (int64_t)clauses_.size(); }
+  int32_t num_vars() const { return (int32_t)assigns_.size() - 1; }
   int core_size() const { return (int)conflict_core_.size(); }
   const Lit* core() const { return conflict_core_.data(); }
 
@@ -663,6 +664,7 @@ int32_t cdcl_model_value(void* s, int32_t var) {
 }
 int64_t cdcl_conflicts(void* s) { return ((Solver*)s)->conflicts(); }
 int64_t cdcl_num_clauses(void* s) { return ((Solver*)s)->num_clauses(); }
+int32_t cdcl_num_vars(void* s) { return ((Solver*)s)->num_vars(); }
 int64_t cdcl_learnt_clauses(void* s, int32_t max_width, int64_t from,
                             int32_t* out, int64_t cap, int64_t* next) {
   return ((Solver*)s)->collect_learnts(max_width, from, out, cap, next);

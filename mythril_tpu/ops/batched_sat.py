@@ -133,30 +133,25 @@ class DevicePool:
             size *= 2
         return size
 
-    def refresh(self, clauses_py: Sequence[Tuple[int, ...]], num_vars: int):
+    def refresh(self, ctx, num_vars: int):
+        """Full rebuild from the native pool's CSR store (one bulk
+        padded-row fetch — no Python tuple traffic)."""
         _, jnp = _require_jax()
-        rows = []
-        dropped = 0
-        for clause in clauses_py:
-            if len(clause) > MAX_CLAUSE_WIDTH:
-                dropped += 1
-                continue
-            rows.append(
-                list(clause) + [0] * (MAX_CLAUSE_WIDTH - len(clause))
-            )
-        if not rows:
-            rows = [[0] * MAX_CLAUSE_WIDTH]
-        real_rows = len(rows)
+        total = ctx.pool.num_clauses
+        rows, dropped = ctx.pool.padded_rows(0, total, MAX_CLAUSE_WIDTH)
+        real_rows = max(1, len(rows))  # keep one inert all-zero row
         # pad clause count to the bucket with inert all-zero rows
-        target_c = self._bucket(len(rows))
-        rows.extend([[0] * MAX_CLAUSE_WIDTH] * (target_c - len(rows)))
-        self.lits_np = np.asarray(rows, dtype=np.int32)  # host mirror
+        target_c = self._bucket(real_rows)
+        mat = np.zeros((target_c, MAX_CLAUSE_WIDTH), dtype=np.int32)
+        if len(rows):
+            mat[: len(rows)] = rows
+        self.lits_np = mat  # host mirror
         # (the mesh path shards from here without a device round-trip)
         self.lits = jnp.asarray(self.lits_np)
         self.num_vars = self._bucket(num_vars)
         self.num_clauses = target_c
         self.dropped = dropped
-        self.consumed = len(clauses_py)
+        self.consumed = total
         self.filled = real_rows
         # vars with no occurrence in any retained row (bucket padding,
         # vars whose defining clauses were too wide): callers preassign
@@ -165,31 +160,30 @@ class DevicePool:
         occurring = np.abs(self.lits_np[:real_rows]).ravel()
         self.used[occurring[occurring <= self.num_vars]] = True
 
-    def append(self, new_clauses: Sequence[Tuple[int, ...]], num_vars: int) -> bool:
-        """Reflect a pool delta in-place when it fits the existing
-        buckets: pad rows are overwritten on host and device (a device
-        .at[].set touches only the delta) — no full rebuild/upload per
-        dispatch while the CDCL tail keeps learning clauses."""
+    def append(self, ctx, num_vars: int) -> bool:
+        """Reflect the pool delta since ``consumed`` in-place when it
+        fits the existing buckets: pad rows are overwritten on host and
+        device (a device .at[].set touches only the delta) — no full
+        rebuild/upload per dispatch while the CDCL tail keeps learning
+        clauses."""
         if self.lits is None or self._bucket(num_vars) > self.num_vars:
             return False
-        rows = []
-        for clause in new_clauses:
-            if len(clause) > MAX_CLAUSE_WIDTH:
-                self.dropped += 1
-                continue
-            rows.append(list(clause) + [0] * (MAX_CLAUSE_WIDTH - len(clause)))
+        total = ctx.pool.num_clauses
+        rows, dropped = ctx.pool.padded_rows(
+            self.consumed, total, MAX_CLAUSE_WIDTH
+        )
         if self.filled + len(rows) > self.num_clauses:
             return False
-        if rows:
-            block = np.asarray(rows, dtype=np.int32)
-            self.lits_np[self.filled : self.filled + len(rows)] = block
+        self.dropped += dropped
+        if len(rows):
+            self.lits_np[self.filled : self.filled + len(rows)] = rows
             self.lits = self.lits.at[
                 self.filled : self.filled + len(rows)
-            ].set(block)
+            ].set(rows)
             self.filled += len(rows)
-            occurring = np.abs(block).ravel()
+            occurring = np.abs(rows).ravel()
             self.used[occurring[occurring <= self.num_vars]] = True
-        self.consumed += len(new_clauses)
+        self.consumed = total
         return True
 
 
@@ -482,7 +476,7 @@ class BatchedSatBackend:
         absorbed = min(
             getattr(ctx, "absorbed_learnt_count", 0), MAX_LEARNT_EXEMPTION
         )
-        base_clauses = len(ctx.clauses_py) - absorbed
+        base_clauses = ctx.pool.num_clauses - absorbed
         if base_clauses > MAX_GATHER_CLAUSES:
             dispatch_stats.size_bailouts += 1
             self.last_assignments = np.zeros(
@@ -496,7 +490,7 @@ class BatchedSatBackend:
             # pool describes a different formula — appending would graft
             # the new clauses onto it at stale offsets and make device
             # UNSAT verdicts unsound, so always rebuild from scratch
-            self.pool.refresh(ctx.clauses_py, num_vars)
+            self.pool.refresh(ctx, num_vars)
             self.pool.version = ctx.pool_version
             self.pool_generation = ctx.generation
         elif self.pool.version != ctx.pool_version or (
@@ -504,10 +498,8 @@ class BatchedSatBackend:
         ):
             # delta append into the existing buckets when possible; full
             # rebuild + upload only when a bucket grows
-            if not self.pool.append(
-                ctx.clauses_py[self.pool.consumed :], num_vars
-            ):
-                self.pool.refresh(ctx.clauses_py, num_vars)
+            if not self.pool.append(ctx, num_vars):
+                self.pool.refresh(ctx, num_vars)
             self.pool.version = ctx.pool_version
 
         batch = len(assumption_sets)

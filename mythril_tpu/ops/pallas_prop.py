@@ -201,28 +201,37 @@ class DenseClausePool:
         )
 
     def refresh(self, clauses_py: Sequence[Tuple[int, ...]], num_vars: int):
-        C = _bucket(max(1, len(clauses_py)))
+        """Tuple-list entry point (tests, mesh shards over small pools);
+        the hot dispatch path uses :meth:`refresh_coords` with arrays
+        straight from the native pool's CSR."""
+        flat = [lit for clause in clauses_py for lit in clause]
+        lits = np.fromiter(flat, dtype=np.int32, count=len(flat))
+        lens = np.fromiter(
+            (len(clause) for clause in clauses_py), dtype=np.int64,
+            count=len(clauses_py),
+        )
+        indptr = np.concatenate([[0], np.cumsum(lens)])
+        urow, ulit, width_arr = dedupe_clause_rows(lits, indptr)
+        self.refresh_coords(
+            urow, ulit, width_arr, len(clauses_py), num_vars
+        )
+
+    def refresh_coords(
+        self, urow, ulit, width_arr, n_rows: int, num_vars: int
+    ):
+        """Build the device incidence planes from deduped (row, literal)
+        coordinate arrays (see :func:`dedupe_clause_rows`)."""
+        C = _bucket(max(1, n_rows))
         V = _bucket(num_vars + 1)
         # host ships only literal coordinates (a few hundred KB); the
         # [C, V] incidence planes (hundreds of MB at the TPU tier) are
         # scatter-built on device — building them as host numpy and
         # uploading four dense copies dominated dispatch latency
-        pos_r, pos_c, neg_r, neg_c = [], [], [], []
         width = np.zeros((1, C), dtype=np.float32)
-        for c, clause in enumerate(clauses_py):
-            lits = set(clause)
-            if any(-l in lits for l in lits):
-                continue  # tautology: always satisfied, width stays 0
-            for lit in lits:
-                if lit > 0:
-                    pos_r.append(c)
-                    pos_c.append(lit)
-                else:
-                    neg_r.append(c)
-                    neg_c.append(-lit)
-            # the incidence cell collapses duplicates, so width must
-            # count UNIQUE literals or conflicts/units are missed
-            width[0, c] = len(lits)
+        width[0, :n_rows] = width_arr
+        pos = ulit > 0
+        pos_r, pos_c = urow[pos], ulit[pos]
+        neg_r, neg_c = urow[~pos], -ulit[~pos]
         from mythril_tpu.ops.device_placement import place
 
         build = _make_incidence_builder(
@@ -244,7 +253,65 @@ class DenseClausePool:
         self.C, self.V = C, V
 
 
-def _pad_coords(values: List[int], size: int) -> np.ndarray:
+def dedupe_clause_rows(lits: np.ndarray, indptr: np.ndarray):
+    """Vectorized clause-row normalization for the incidence builds.
+
+    Input is a CSR literal layout (row i = clause i).  Returns
+    ``(urow, ulit, width)`` where (urow, ulit) are the unique
+    (row, literal) coordinate pairs with tautologous rows removed
+    entirely, and ``width[i]`` is the count of UNIQUE literals of row i
+    (0 for tautologies — an all-zero incidence row is inert).  The
+    incidence cell collapses duplicate literals, so width must count
+    unique ones or conflicts/units are missed."""
+    n_rows = len(indptr) - 1
+    if n_rows == 0 or len(lits) == 0:
+        empty = np.empty(0, dtype=np.int64)
+        return empty, empty.astype(np.int32), np.zeros(n_rows, np.float32)
+    row = np.repeat(
+        np.arange(n_rows, dtype=np.int64), np.diff(indptr)
+    )
+    # unique (row, literal) pairs via a packed key (|lit| < 2**32)
+    key = row << np.int64(34)
+    key += lits.astype(np.int64) + (np.int64(1) << np.int64(33))
+    _, first = np.unique(key, return_index=True)
+    urow = row[first]
+    ulit = lits[first]
+    # tautology = some (row, var) present with both polarities; pairs
+    # are unique now, so a (row, |lit|) count of 2 means both signs
+    vkey = (urow << np.int64(34)) + np.abs(ulit.astype(np.int64))
+    vals, counts = np.unique(vkey, return_counts=True)
+    width = np.zeros(n_rows, dtype=np.float32)
+    if np.any(counts > 1):
+        taut_rows = np.unique(vals[counts > 1] >> np.int64(34))
+        keep = ~np.isin(urow, taut_rows)
+        urow, ulit = urow[keep], ulit[keep]
+    np.add.at(width, urow, 1.0)
+    return urow, ulit.astype(np.int32), width
+
+
+def remap_cone_csr(ctx, clause_ids, cone_vars):
+    """Fetch the given pool clauses from the native CSR store and remap
+    variable ids onto dense columns: anchor var 1 -> column 1,
+    ``cone_vars[i]`` (sorted) -> column ``i + 2``.  Every variable in a
+    cone clause is in the cone by construction of the BFS.  Returns the
+    deduped coordinates of :func:`dedupe_clause_rows`."""
+    lits, indptr = ctx.pool.subset_csr(clause_ids)
+    av = np.abs(lits).astype(np.int64)
+    col = np.where(av == 1, 1, np.searchsorted(cone_vars, av) + 2)
+    remapped = np.where(lits < 0, -col, col).astype(np.int32)
+    return dedupe_clause_rows(remapped, indptr)
+
+
+def assumption_columns(cone_vars: np.ndarray, lits) -> np.ndarray:
+    """Dense columns of assumption literals under the same remap;
+    returns signed column ids (sign = literal polarity)."""
+    arr = np.fromiter(lits, dtype=np.int64, count=len(lits))
+    av = np.abs(arr)
+    col = np.where(av == 1, 1, np.searchsorted(cone_vars, av) + 2)
+    return np.where(arr < 0, -col, col)
+
+
+def _pad_coords(values, size: int) -> np.ndarray:
     """Pad a coordinate list to its bucket with (0, 0) writes — cell
     (0, 0) is row 0 x column 0, and column 0 is never a variable, so a
     spurious 1 there never changes counts (A[:, 0] stays 0) and forced
@@ -826,29 +893,21 @@ class PallasSatBackend:
         from mythril_tpu.ops.batched_sat import dispatch_stats
 
         # every assumption var is a cone root, so the remap is exactly
-        # anchor + cone vars
-        remap = {1: 1}
-        for var in cone_vars.tolist():  # already sorted
-            if var not in remap:
-                remap[var] = len(remap) + 1
-        num_cone_vars = len(remap)
+        # anchor + cone vars: cone_vars[i] (sorted) -> column i + 2
+        num_cone_vars = len(cone_vars) + 1
         batch = len(assumption_sets)
         orig_v1 = ctx.solver.num_vars + 1
         assignments = np.zeros((batch, orig_v1), dtype=np.int8)
         assignments[:, 1] = 1
 
-        cone_clauses = [
-            tuple(
-                (1 if lit > 0 else -1) * remap[abs(lit)]
-                for lit in ctx.clauses_py[ci]
-            )
-            for ci in clause_idx
-        ]
+        urow, ulit, width_arr = remap_cone_csr(ctx, clause_idx, cone_vars)
         pool = DenseClausePool()
-        pool.refresh(cone_clauses, num_cone_vars)
+        pool.refresh_coords(
+            urow, ulit, width_arr, len(clause_idx), num_cone_vars
+        )
         inverse = np.zeros(pool.V, dtype=np.int64)
-        for var, col in remap.items():
-            inverse[col] = var
+        inverse[1] = 1
+        inverse[2 : 2 + len(cone_vars)] = cone_vars
 
         V = pool.V
         statuses = np.zeros(batch, dtype=np.int32)
@@ -870,8 +929,8 @@ class PallasSatBackend:
             # while_loop searching after every real lane decided
             A0[len(chunk):, :] = 1.0
             for lane, lits in enumerate(chunk):
-                for lit in lits:
-                    A0[lane, remap[abs(lit)]] = 1.0 if lit > 0 else -1.0
+                cols = assumption_columns(cone_vars, lits)
+                A0[lane, np.abs(cols)] = np.where(cols > 0, 1.0, -1.0)
             from mythril_tpu.ops.device_placement import place
 
             step = make_dense_solve(
@@ -938,31 +997,26 @@ class PallasSatBackend:
             for lane, (lits, (ci, cv)) in enumerate(
                 zip(chunk, chunk_cones)
             ):
-                remap = {1: 1}
-                for var in cv.tolist():
-                    if var not in remap:
-                        remap[var] = len(remap) + 1
-                inverse = np.zeros(len(remap) + 1, dtype=np.int64)
-                for var, colx in remap.items():
-                    inverse[colx] = var
+                inverse = np.zeros(len(cv) + 2, dtype=np.int64)
+                inverse[1] = 1
+                inverse[2:] = cv
                 inverses.append(inverse)
-                A0[lane, len(remap) + 1:] = 1.0  # per-lane padding cols
-                for row, cix in enumerate(ci.tolist()):
-                    clause_lits = set(ctx.clauses_py[cix])
-                    if any(-l in clause_lits for l in clause_lits):
-                        continue  # tautology: width stays 0 (inert row)
-                    width[lane, row] = len(clause_lits)
-                    for lit in clause_lits:
-                        if lit > 0:
-                            pos_l.append(lane)
-                            pos_r.append(row)
-                            pos_c.append(remap[lit])
-                        else:
-                            neg_l.append(lane)
-                            neg_r.append(row)
-                            neg_c.append(remap[-lit])
-                for lit in lits:
-                    A0[lane, remap[abs(lit)]] = 1.0 if lit > 0 else -1.0
+                A0[lane, len(cv) + 2:] = 1.0  # per-lane padding cols
+                urow, ulit, width_arr = remap_cone_csr(ctx, ci, cv)
+                width[lane, : len(ci)] = width_arr
+                pos = ulit > 0
+                pos_l.append(np.full(int(pos.sum()), lane, dtype=np.int64))
+                pos_r.append(urow[pos])
+                pos_c.append(ulit[pos])
+                neg_l.append(np.full(int((~pos).sum()), lane, dtype=np.int64))
+                neg_r.append(urow[~pos])
+                neg_c.append(-ulit[~pos])
+                cols = assumption_columns(cv, lits)
+                A0[lane, np.abs(cols)] = np.where(cols > 0, 1.0, -1.0)
+            pos_l, pos_r, pos_c, neg_l, neg_r, neg_c = (
+                np.concatenate(part) if part else np.empty(0, np.int64)
+                for part in (pos_l, pos_r, pos_c, neg_l, neg_r, neg_c)
+            )
             from mythril_tpu.ops.device_placement import place
 
             build = _make_lane_incidence_builder(

@@ -20,12 +20,11 @@ Bit order convention: bits[0] is the LSB.  Literal 1 is constant TRUE
 
 import logging
 import time
-from array import array
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from mythril_tpu.native import SatSolver
+from mythril_tpu.native import NativePool, SatSolver
 from mythril_tpu.smt import terms as T
 
 log = logging.getLogger(__name__)
@@ -116,29 +115,25 @@ class BlastContext:
         _CTX_GENERATION += 1
         self.generation = _CTX_GENERATION
         self.solver = SatSolver()
-        # host-side mirror of the clause pool for the batched TPU backend
-        # (the native solver owns its own copy); list of literal tuples
-        self.clauses_py: List[Tuple[int, ...]] = []
-        self.pool_version = 0
+        # the clause pool (CSR store + gate caches + defining-cone index)
+        # lives natively — see native/csrc/pool.cpp.  Every clause lands
+        # in the CSR store AND the CDCL database in one native call, so
+        # there is no host mirror and no flush step any more (round-3
+        # profiling: the Python mirror + per-gate dict traffic cost 3x
+        # the CDCL search itself on the corpus).
+        self.pool = NativePool(self.solver)
         self.bits_cache: Dict[int, List[int]] = {}
         self.lit_cache: Dict[int, int] = {}
-        self.gate_cache: Dict[Tuple, int] = {}
         self.var_bits: Dict[int, List[int]] = {}       # bv var node id -> bits
         self.bool_var_lits: Dict[int, int] = {}        # bool var node id -> lit
         self.array_reads: Dict[int, List[Tuple[T.Node, List[int]]]] = {}
         self.uf_apps: Dict[int, List[Tuple[Tuple[T.Node, ...], List[int]]]] = {}
-        self.clause_count = 0
         # recent satisfying assignments: paths grow one branch condition
         # at a time, so the previous model very often still satisfies the
         # extended constraint set — verifying a candidate is a term-DAG
         # walk, orders of magnitude cheaper than a CDCL search
         self.recent_models: List[T.EvalEnv] = []
         self._freevar_cache: Dict[int, frozenset] = {}
-        # per-root cone memo: var -> (clause idx array, var array);
-        # arrays serve both cone() unions and BFS absorption
-        self._cone_cache: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
-        self._learnt_cursor = 0  # native clause index already absorbed
-        self.absorbed_learnt_count = 0  # learnts folded into clauses_py
         # probe memo: constraint-set key -> EvalEnv (SAT verdicts are
         # permanent) or (False, model_version) (negative probes expire
         # when a new model lands in recent_models); shared by the batch
@@ -150,16 +145,6 @@ class BlastContext:
         # eviction, same cap policy as probe_memo)
         self.unsat_memo: Dict[Tuple[int, ...], bool] = {}
         self.model_version = 0
-        # clauses are mirrored into the native solver lazily: _clause
-        # appends to a flat 0-separated literal buffer and check() ships
-        # the whole batch in one ctypes crossing (add_clauses_flat) —
-        # per-clause crossings were ~8% of corpus wall time
-        self._pending_flat: List[int] = []
-        # flat CSR mirror of clauses_py literals for the vectorized cone
-        # BFS (_lits_csr): C-backed arrays, appended per clause
-        self._lits_store = array("i")
-        self._lits_indptr = array("q", [0])
-        self._csr_cursor = 0  # clauses_py rows already in the store
         # native model snapshot (int8, var-indexed) for the last SAT
         # verdict; lets model extraction run vectorized instead of one
         # ctypes call per bit
@@ -171,287 +156,60 @@ class BlastContext:
         # a node-id cache for "contains a read/UF" nesting checks
         self._reads_matrix_cache = None
         self._theory_node_cache: Dict[int, bool] = {}
-        # defining-cone index: var -> indices of the clauses that define
-        # it.  By construction (Tseitin), the defined gate is the
-        # youngest variable in its defining clauses, so the default
-        # owner is max(|lit|); congruence clauses (array reads, UF apps)
-        # pass explicit extra owners.  Used by the device backends to
-        # extract the cone of influence of a query instead of sweeping
-        # the whole pool (ops/pallas_prop.py).
-        self.def_clauses: Dict[int, List[int]] = {}
-        # device-learned nogoods as (clause index, sorted var array):
-        # appended to any cone whose var set covers them (cached cones
-        # never re-walk, so def_clauses alone cannot deliver them)
-        self.nogoods: List[Tuple[int, np.ndarray]] = []
 
     # ------------------------------------------------------------------
-    # gates
+    # pool facade (the store itself is native; see csrc/pool.cpp)
     # ------------------------------------------------------------------
+
+    @property
+    def pool_version(self) -> int:
+        return self.pool.version
+
+    @property
+    def clause_count(self) -> int:
+        return self.pool.num_clauses
+
+    @property
+    def absorbed_learnt_count(self) -> int:
+        return self.pool.absorbed_count
+
+    @property
+    def clauses_py(self) -> List[Tuple[int, ...]]:
+        """Every pool clause materialized as tuples — O(pool); tests and
+        debugging only.  Production paths use the CSR accessors on
+        ``self.pool`` (csr / padded_rows / subset_csr)."""
+        return self.pool.all_clauses()
 
     def flush_native(self) -> None:
-        """Ship buffered clauses to the native solver in one bulk ctypes
-        crossing.  Must run before every native solve; the device/mirror
-        paths read ``clauses_py`` directly and need no flush."""
-        if not self._pending_flat:
-            return
-        flat = np.array(self._pending_flat, dtype=np.int32)
-        self._pending_flat.clear()
-        self.solver.add_clauses_flat(flat)
-
-    def _clause(
-        self,
-        lits: Sequence[int],
-        owners: Sequence[int] = (),
-        owner: Optional[int] = None,
-    ) -> None:
-        """``owner`` short-circuits the max-|lit| scan when the caller
-        just allocated the defined gate var (always the newest, hence
-        the max) — the scan was measurable at millions of clauses."""
-        self._pending_flat.extend(lits)
-        self._pending_flat.append(0)
-        index = len(self.clauses_py)
-        self.clauses_py.append(tuple(lits))
-        if owner is None:
-            owner = max((abs(l) for l in lits), default=0)
-        if owner > 1:
-            self.def_clauses.setdefault(owner, []).append(index)
-        for extra in owners:
-            if abs(extra) > 1 and abs(extra) != owner:
-                self.def_clauses.setdefault(abs(extra), []).append(index)
-        self.pool_version += 1
-        self.clause_count += 1
-
-    def _lits_csr(self):
-        """Zero-copy numpy views over a lazily synced flat-literal
-        store: (lits int32 view, indptr int64 view).  Row i of the CSR
-        is clauses_py[i]'s literals — the cone BFS gathers whole clause
-        batches without touching Python tuples.  The store syncs to the
-        clauses_py tail here (one tight batch loop per cone burst)
-        rather than per _clause call, which measurably taxed blasting.
-
-        INVARIANT: the returned views alias resizable array.array
-        buffers — they must stay local to one cone walk and MUST NOT be
-        retained across any call that can append a clause, or the next
-        ``store.extend`` raises BufferError ("cannot resize an array
-        that is exporting buffers").  ``_cone_of_var`` keeps them
-        frame-local; do the same in any new caller."""
-        n = len(self.clauses_py)
-        if self._csr_cursor < n:
-            store = self._lits_store
-            indptr = self._lits_indptr
-            for clause in self.clauses_py[self._csr_cursor :]:
-                store.extend(clause)
-                indptr.append(len(store))
-            self._csr_cursor = n
-        lits = np.frombuffer(self._lits_store, dtype=np.int32)
-        indptr_view = np.frombuffer(self._lits_indptr, dtype=np.int64)
-        return lits, indptr_view
+        """No-op, kept for API compatibility: clauses now land in the
+        CDCL database in the same native call that records them in the
+        pool's CSR store."""
 
     def cone(self, root_lits: Sequence[int], need_clauses: bool = True):
-        """(clause_indices, vars) of the defining cone of ``root_lits``.
+        """(clause_indices, vars) of the defining cone of ``root_lits``,
+        both sorted numpy int64 arrays.
 
-        Walks defining clauses backward from the roots: every variable's
-        semantics (the gates computing it from the query's free inputs)
-        is included; clauses merely *consuming* a cone variable for some
-        unrelated constraint are not.  Propagation restricted to the
-        cone is sound for UNSAT (every pool clause holds globally) and
-        complete enough for model probing (free inputs are in the cone).
-
-        Per-root cones are memoized: a stale cached cone (late congruence
-        clauses can attach to already-walked vars) is a clause *subset* —
-        still sound for UNSAT, at worst weaker at propagation.  Cached
-        cones are sorted int64 arrays; per-call union is one
-        concatenate+unique pass instead of large frozenset unions.
-
-        Returns (clause_indices, vars) as sorted numpy int64 arrays.
-        """
-        clause_parts = []
-        var_parts = []
-        fresh_roots = []
-        for root in root_lits:
-            var = abs(root)
-            if var <= 1:
-                continue
-            cached = self._cone_cache.get(var)
-            if cached is None:
-                fresh_roots.append(var)
-                continue
-            clause_parts.append(cached[0])
-            var_parts.append(cached[1])
-        # every fresh root gets a complete cached cone: queries share
-        # their prefix constraints, so the cold walk amortizes across
-        # the whole analysis.  (A delta-walk variant — fresh roots
-        # walked against pre-absorbed sibling cones and left uncached —
-        # was measured 2-3x SLOWER end-to-end: uncached roots re-walk
-        # on every later query that shares them.)
-        for var in fresh_roots:
-            cached = self._cone_of_var(var)
-            self._cone_cache[var] = cached
-            clause_parts.append(cached[0])
-            var_parts.append(cached[1])
-        if not clause_parts:
-            empty = np.empty(0, dtype=np.int64)
-            return empty, empty
-        if len(clause_parts) == 1:
-            cone_vars = var_parts[0]
-            clause_union = clause_parts[0]
-        else:
-            cone_vars = np.unique(np.concatenate(var_parts))
-            clause_union = (
-                np.unique(np.concatenate(clause_parts)) if need_clauses
-                else np.empty(0, dtype=np.int64)
-            )
-        if need_clauses and self.nogoods and cone_vars.size:
-            # nogoods whose vars the cone covers prune it; cached cones
-            # never re-walk, so they are appended here per call
-            extra = [
-                np.int64(index) for index, ngvars in self.nogoods
-                if ngvars.size and np.all(
-                    cone_vars[np.searchsorted(
-                        cone_vars, ngvars
-                    ).clip(max=cone_vars.size - 1)] == ngvars
-                )
-            ]
-            if extra:
-                clause_union = np.unique(np.concatenate(
-                    [clause_union, np.asarray(extra, dtype=np.int64)]
-                ))
-        return clause_union, cone_vars
-
-    def _cone_of_var(self, root_var: int):
-        """Uncached single-root cone walk; returns (clause indices,
-        vars).  Level-synchronous BFS: per level, the
-        frontier's defining clause ids come from the def_clauses index
-        (Python dict, cheap) and their literals are gathered in one
-        vectorized CSR pass (_lits_csr) — iterating clause tuples in
-        Python dominated cold-walk time.  Memoized sub-cones absorb by
-        marking their whole var set seen and appending their clause
-        arrays."""
-        lits_flat, indptr = self._lits_csr()
-        num_vars = self.solver.num_vars + 1
-        # seen-sets stay Python sets so a small cone costs O(cone), not
-        # O(pool) (full-pool bool masks made many-small-cones workloads
-        # quadratic in pool size); only the per-level literal gather is
-        # vectorized over the CSR.  Absorbed cached sub-cones are NOT
-        # splatted into the set (a 50k-var cached cone costs 50k set
-        # inserts per absorption, which dominated cold walks on
-        # deep-term workloads) — they are kept as sorted arrays and
-        # frontier candidates are screened against them vectorized.
-        from bisect import bisect_left
-
-        seen_vars = set()
-        absorbed_vars: List[np.ndarray] = []
-        seen_clauses = set()
-        clause_parts = []
-        frontier = [root_var]
-
-        def in_absorbed(v: int) -> bool:
-            for arr in absorbed_vars:
-                i = bisect_left(arr, v)
-                if i < len(arr) and arr[i] == v:
-                    return True
-            return False
-
-        while frontier:
-            clause_ids: List[int] = []
-            for var in frontier:
-                if var >= num_vars or var in seen_vars:
-                    continue
-                seen_vars.add(var)
-                hit = self._cone_cache.get(var)
-                if hit is not None:
-                    clause_parts.append(hit[0])
-                    absorbed_vars.append(hit[1])
-                    if len(absorbed_vars) > 8:
-                        # keep membership screening O(log n): merge
-                        # instead of scanning many arrays per literal
-                        absorbed_vars = [
-                            np.unique(np.concatenate(absorbed_vars))
-                        ]
-                    continue
-                clause_ids.extend(self.def_clauses.get(var, ()))
-            fresh = [ci for ci in clause_ids if ci not in seen_clauses]
-            if not fresh:
-                break
-            seen_clauses.update(fresh)
-            if len(fresh) < 48:
-                # deep terms walk hundreds of small levels (mux/carry
-                # chains): per-level numpy dispatch overhead dominates
-                # there, so small levels iterate the clause tuples
-                # directly
-                nxt = []
-                for ci in fresh:
-                    for lit in self.clauses_py[ci]:
-                        v = lit if lit > 0 else -lit
-                        if (
-                            v > 1 and v < num_vars
-                            and v not in seen_vars
-                            and not in_absorbed(v)
-                        ):
-                            nxt.append(v)
-                frontier = nxt
-                continue
-            batch = np.unique(
-                np.fromiter(fresh, dtype=np.int64, count=len(fresh))
-            )
-            starts = indptr[batch]
-            lens = indptr[batch + 1] - starts
-            total = int(lens.sum())
-            if total == 0:
-                break
-            offsets = np.concatenate(([0], np.cumsum(lens)[:-1]))
-            flat_index = (
-                np.repeat(starts, lens)
-                + np.arange(total)
-                - np.repeat(offsets, lens)
-            )
-            reached = np.abs(lits_flat[flat_index].astype(np.int64))
-            reached = np.unique(reached)
-            reached = reached[(reached > 1) & (reached < num_vars)]
-            for arr in absorbed_vars:
-                if len(arr) and len(reached):
-                    # screen against the absorbed cone (sorted array):
-                    # vectorized membership instead of set splat
-                    pos = np.searchsorted(arr, reached).clip(
-                        max=len(arr) - 1
-                    )
-                    reached = reached[arr[pos] != reached]
-            frontier = [v for v in reached.tolist() if v not in seen_vars]
-        clause_parts.append(
-            np.fromiter(seen_clauses, dtype=np.int64, count=len(seen_clauses))
-        )
-        clause_arr = np.unique(np.concatenate(clause_parts))
-        var_parts = [
-            np.fromiter(seen_vars, dtype=np.int64, count=len(seen_vars))
-        ] + absorbed_vars
-        var_arr = np.unique(np.concatenate(var_parts))
-        return clause_arr, var_arr
+        Walks defining clauses backward from the roots (natively, with a
+        per-root memo): every variable's semantics (the gates computing
+        it from the query's free inputs) is included; clauses merely
+        *consuming* a cone variable for some unrelated constraint are
+        not.  Propagation restricted to the cone is sound for UNSAT
+        (every pool clause holds globally) and complete enough for model
+        probing (free inputs are in the cone).  A stale cached cone
+        (late congruence clauses can attach to already-walked vars) is a
+        clause *subset* — still sound for UNSAT, at worst weaker at
+        propagation.  Device-learned nogoods covered by the cone's var
+        set are appended per call."""
+        return self.pool.cone(root_lits, need_clauses)
 
     def absorb_learnts(self, max_width: int = 8) -> int:
         """Pull clauses the native CDCL has learned since the last sync
-        into the host-side pool mirror, so the next device-pool refresh
-        ships them to the batched BCP kernels (SURVEY §5.8: CDCL-derived
+        into the pool's CSR store, so the next device-pool refresh ships
+        them to the batched BCP kernels (SURVEY §5.8: CDCL-derived
         pruning power transfers to the lockstep path).  Learned clauses
-        are implied by the pool, so absorbing them preserves the
-        device verdict soundness contract.  Returns how many were added.
-        """
-        try:
-            clauses, cursor = self.solver.learnt_clauses(
-                max_width=max_width, from_index=self._learnt_cursor
-            )
-        except Exception:  # noqa: BLE001 — sharing is an optimization
-            return 0
-        self._learnt_cursor = cursor
-        for lits in clauses:
-            index = len(self.clauses_py)
-            self.clauses_py.append(tuple(lits))
-            owner = max((abs(l) for l in lits), default=0)
-            if owner > 1:
-                self.def_clauses.setdefault(owner, []).append(index)
-        if clauses:
-            self.pool_version += 1
-            self.absorbed_learnt_count += len(clauses)
-        return len(clauses)
+        are implied by the pool, so absorbing them preserves the device
+        verdict soundness contract.  Returns how many were added."""
+        return self.pool.absorb_learnts(max_width)
 
     def note_unsat(self, nodes: Sequence[T.Node]) -> None:
         """Memoize a (sound) UNSAT verdict for a constraint-node set —
@@ -471,307 +229,113 @@ class BlastContext:
         preserves equisatisfiability and lets both the native CDCL and
         later device dispatches refute related queries without
         re-searching.  This is the learned-clause channel flowing
-        device → pool (the reverse of :meth:`absorb_learnts`).
-        """
-        lits = tuple(sorted({-l for l in assumption_lits}))
-        if not lits or len(lits) > 12:
-            return  # wide nogoods add scan cost for little pruning
-        if TRUE_LIT in lits or any(-l in lits for l in lits):
-            return  # trivially satisfied / tautological
-        key = ("nogood", lits)
-        if key in self.gate_cache:
-            return
-        self.gate_cache[key] = TRUE_LIT
-        index = len(self.clauses_py)
-        self.clauses_py.append(lits)
-        self._pending_flat.extend(lits)
-        self._pending_flat.append(0)
-        owner = max(abs(l) for l in lits)
-        if owner > 1:
-            self.def_clauses.setdefault(owner, []).append(index)
-        # per-root cones are memoized permanently, so a nogood indexed
-        # only under def_clauses would never reach already-walked cones
-        # (exactly the repeated queries it should prune) — register it
-        # for the subset-append in cone()
-        self.nogoods.append(
-            (index, np.fromiter(
-                sorted({abs(l) for l in lits}), dtype=np.int64
-            ))
-        )
-        self.pool_version += 1
-        self.absorbed_learnt_count += 1
+        device → pool (the reverse of :meth:`absorb_learnts`).  The
+        native side dedupes, rejects tautologies and wide nogoods
+        (> 12 lits add scan cost for little pruning), and registers the
+        clause for the cone subset-append."""
+        self.pool.nogood(list(assumption_lits))
 
     def new_lit(self) -> int:
-        return self.solver.new_var()
+        return self.pool.new_var()
+
+    # ------------------------------------------------------------------
+    # gates — all emission is native (csrc/pool.cpp): constant folding,
+    # structural-sharing caches, and the Tseitin clauses happen behind
+    # one ctypes crossing per gate
+    # ------------------------------------------------------------------
 
     def g_and(self, a: int, b: int) -> int:
-        if a == FALSE_LIT or b == FALSE_LIT or a == -b:
-            return FALSE_LIT
-        if a == TRUE_LIT:
-            return b
-        if b == TRUE_LIT or a == b:
-            return a
-        key = ("and", min(a, b), max(a, b))
-        lit = self.gate_cache.get(key)
-        if lit is None:
-            lit = self.new_lit()
-            self._clause([-lit, a], owner=lit)
-            self._clause([-lit, b], owner=lit)
-            self._clause([lit, -a, -b], owner=lit)
-            self.gate_cache[key] = lit
-        return lit
+        return self.pool.g_and(a, b)
 
     def g_or(self, a: int, b: int) -> int:
-        return -self.g_and(-a, -b)
+        return self.pool.g_or(a, b)
 
     def g_xor(self, a: int, b: int) -> int:
-        if a == TRUE_LIT:
-            return -b
-        if a == FALSE_LIT:
-            return b
-        if b == TRUE_LIT:
-            return -a
-        if b == FALSE_LIT:
-            return a
-        if a == b:
-            return FALSE_LIT
-        if a == -b:
-            return TRUE_LIT
-        # canonicalize on positive vars: xor(a,b) = xor(|a|,|b|) ^ signs
-        flip = (a < 0) != (b < 0)
-        va, vb = abs(a), abs(b)
-        if va > vb:
-            va, vb = vb, va
-        key = ("xor", va, vb)
-        lit = self.gate_cache.get(key)
-        if lit is None:
-            lit = self.new_lit()
-            self._clause([-lit, va, vb], owner=lit)
-            self._clause([-lit, -va, -vb], owner=lit)
-            self._clause([lit, -va, vb], owner=lit)
-            self._clause([lit, va, -vb], owner=lit)
-            self.gate_cache[key] = lit
-        return -lit if flip else lit
+        return self.pool.g_xor(a, b)
 
     def g_mux(self, s: int, a: int, b: int) -> int:
         """s ? a : b"""
-        if s == TRUE_LIT:
-            return a
-        if s == FALSE_LIT:
-            return b
-        if a == b:
-            return a
-        if a == TRUE_LIT and b == FALSE_LIT:
-            return s
-        if a == FALSE_LIT and b == TRUE_LIT:
-            return -s
-        key = ("mux", s, a, b)
-        lit = self.gate_cache.get(key)
-        if lit is None:
-            lit = self.new_lit()
-            self._clause([-s, -a, lit], owner=lit)
-            self._clause([-s, a, -lit], owner=lit)
-            self._clause([s, -b, lit], owner=lit)
-            self._clause([s, b, -lit], owner=lit)
-            if a != TRUE_LIT and a != FALSE_LIT and b != TRUE_LIT and b != FALSE_LIT:
-                self._clause([-a, -b, lit], owner=lit)   # redundant, aids propagation
-                self._clause([a, b, -lit], owner=lit)
-            self.gate_cache[key] = lit
-        return lit
+        return self.pool.g_mux(s, a, b)
 
     def g_and_many(self, lits: Sequence[int]) -> int:
-        """Wide conjunction as ONE gate: n binary clauses (gate → each
-        conjunct) plus one width-(n+1) clause (all conjuncts → gate).
-
-        The chained-2-AND encoding this replaces cost n gate vars, 3n
-        clauses, and — critically — a cone/implication DEPTH of n: a
-        256-bit equality made every cone walk and CDCL propagation
-        cross 256 chain levels.  The wide gate is depth 1.  (The wide
-        closing clause is dropped by the gather device path's width
-        cap, which only weakens propagation there — soundness holds.)
-        """
-        xs = []
-        seen = set()
-        for lit in lits:
-            if lit == TRUE_LIT or lit in seen:
-                continue
-            if lit == FALSE_LIT or -lit in seen:
-                return FALSE_LIT
-            seen.add(lit)
-            xs.append(lit)
-        if not xs:
-            return TRUE_LIT
-        if len(xs) == 1:
-            return xs[0]
-        if len(xs) == 2:
-            return self.g_and(xs[0], xs[1])
-        key = ("andN", tuple(sorted(xs)))
-        lit = self.gate_cache.get(key)
-        if lit is None:
-            lit = self.new_lit()
-            for x in xs:
-                self._clause([-lit, x], owner=lit)
-            self._clause([lit] + [-x for x in xs], owner=lit)
-            self.gate_cache[key] = lit
-        return lit
+        """Wide conjunction as ONE gate var: n binary clauses (gate →
+        each conjunct) plus one width-(n+1) closing clause.  The wide
+        gate keeps cone/implication depth at 1 where a chained-2-AND
+        encoding costs depth n.  (The wide closing clause is dropped by
+        the gather device path's width cap, which only weakens
+        propagation there — soundness holds.)"""
+        return self.pool.g_and_many(list(lits))
 
     def g_or_many(self, lits: Sequence[int]) -> int:
-        acc = FALSE_LIT
-        for lit in lits:
-            acc = self.g_or(acc, lit)
-        return acc
-
-    # ------------------------------------------------------------------
-    # word-level circuits
-    # ------------------------------------------------------------------
+        return -self.pool.g_and_many([-lit for lit in lits])
 
     def g_xor3(self, a: int, b: int, c: int) -> int:
-        """Three-input parity as ONE gate var + 8 width-4 clauses —
-        adders built from chained 2-XORs cost 5 gate vars and ~17
-        clauses per bit; the direct encoding costs 2 vars and 14, and
-        cone/CDCL work scales with both."""
-        for x, rest in ((a, (b, c)), (b, (a, c)), (c, (a, b))):
-            if x == TRUE_LIT:
-                return -self.g_xor(*rest)
-            if x == FALSE_LIT:
-                return self.g_xor(*rest)
-        if a == b:
-            return c
-        if a == -b:
-            return -c
-        if b == c:
-            return a
-        if b == -c:
-            return -a
-        if a == c:
-            return b
-        if a == -c:
-            return -b
-        flip = (a < 0) != (b < 0) != (c < 0)
-        va, vb, vc = sorted((abs(a), abs(b), abs(c)))
-        key = ("xor3", va, vb, vc)
-        lit = self.gate_cache.get(key)
-        if lit is None:
-            lit = self.new_lit()
-            self._clause([-lit, va, vb, vc], owner=lit)
-            self._clause([-lit, -va, -vb, vc], owner=lit)
-            self._clause([-lit, -va, vb, -vc], owner=lit)
-            self._clause([-lit, va, -vb, -vc], owner=lit)
-            self._clause([lit, -va, vb, vc], owner=lit)
-            self._clause([lit, va, -vb, vc], owner=lit)
-            self._clause([lit, va, vb, -vc], owner=lit)
-            self._clause([lit, -va, -vb, -vc], owner=lit)
-            self.gate_cache[key] = lit
-        return -lit if flip else lit
+        """Three-input parity as ONE gate var + 8 width-4 clauses (2
+        vars / 14 clauses per adder bit with g_maj, vs 5 vars / ~17
+        clauses for chained 2-XOR adders)."""
+        return self.pool.g_xor3(a, b, c)
 
     def g_maj(self, a: int, b: int, c: int) -> int:
-        """Three-input majority (the adder carry) as one gate var + 6
+        """Three-input majority (the adder carry): one gate var + 6
         clauses."""
-        for x, rest in ((a, (b, c)), (b, (a, c)), (c, (a, b))):
-            if x == TRUE_LIT:
-                return self.g_or(*rest)
-            if x == FALSE_LIT:
-                return self.g_and(*rest)
-        if a == b or a == c:
-            return a
-        if b == c:
-            return b
-        if a == -b:
-            return c
-        if a == -c:
-            return b
-        if b == -c:
-            return a
-        # maj(-a,-b,-c) == -maj(a,b,c): canonicalize on the sign of the
-        # smallest-var literal
-        lits = sorted((a, b, c), key=abs)
-        flip = lits[0] < 0
-        if flip:
-            lits = [-l for l in lits]
-        key = ("maj", lits[0], lits[1], lits[2])
-        lit = self.gate_cache.get(key)
-        if lit is None:
-            lit = self.new_lit()
-            x, y, z = lits
-            self._clause([-lit, x, y], owner=lit)
-            self._clause([-lit, x, z], owner=lit)
-            self._clause([-lit, y, z], owner=lit)
-            self._clause([lit, -x, -y], owner=lit)
-            self._clause([lit, -x, -z], owner=lit)
-            self._clause([lit, -y, -z], owner=lit)
-            self.gate_cache[key] = lit
-        return -lit if flip else lit
+        return self.pool.g_maj(a, b, c)
 
     def full_adder(self, x: int, y: int, cin: int) -> Tuple[int, int]:
-        return self.g_xor3(x, y, cin), self.g_maj(x, y, cin)
+        return self.pool.g_xor3(x, y, cin), self.pool.g_maj(x, y, cin)
+
+    # ------------------------------------------------------------------
+    # word-level circuits — one native crossing per word op; the ripple
+    # chains, multiplier rows, and divider iterations loop in C++
+    # ------------------------------------------------------------------
 
     def add_bits(
         self, xs: List[int], ys: List[int], cin: int = FALSE_LIT
     ) -> Tuple[List[int], int]:
-        out = []
-        carry = cin
-        for x, y in zip(xs, ys):
-            s, carry = self.full_adder(x, y, carry)
-            out.append(s)
-        return out, carry
+        return self.pool.add_bits(xs, ys, cin)
 
     def sub_bits(self, xs: List[int], ys: List[int]) -> Tuple[List[int], int]:
         """xs - ys; carry-out == 1 iff xs >= ys (no borrow)."""
-        return self.add_bits(xs, [-y for y in ys], TRUE_LIT)
+        return self.pool.add_bits(xs, [-y for y in ys], TRUE_LIT)
 
     def neg_bits(self, xs: List[int]) -> List[int]:
-        out, _ = self.add_bits([-x for x in xs], _const_bits(0, len(xs)), TRUE_LIT)
+        out, _ = self.pool.add_bits(
+            [-x for x in xs], _const_bits(0, len(xs)), TRUE_LIT
+        )
         return out
 
     def eq_lit(self, xs: List[int], ys: List[int]) -> int:
-        return self.g_and_many([-self.g_xor(x, y) for x, y in zip(xs, ys)])
+        return self.pool.eq_lit(xs, ys)
 
     def ult_lit(self, xs: List[int], ys: List[int]) -> int:
-        _, carry = self.sub_bits(xs, ys)
-        return -carry
+        # native carry-only comparator: the sum bits of the implied
+        # subtraction are never materialized (6 clauses/bit, not 14)
+        return self.pool.ult_lit(xs, ys)
 
     def ule_lit(self, xs: List[int], ys: List[int]) -> int:
-        return -self.ult_lit(ys, xs)
+        return -self.pool.ult_lit(ys, xs)
 
     def slt_lit(self, xs: List[int], ys: List[int]) -> int:
         sign_x, sign_y = xs[-1], ys[-1]
-        return self.g_mux(
-            self.g_xor(sign_x, sign_y), sign_x, self.ult_lit(xs, ys)
+        return self.pool.g_mux(
+            self.pool.g_xor(sign_x, sign_y), sign_x, self.pool.ult_lit(xs, ys)
         )
 
     def mux_bits(self, s: int, xs: List[int], ys: List[int]) -> List[int]:
-        return [self.g_mux(s, x, y) for x, y in zip(xs, ys)]
+        return self.pool.mux_bits(s, xs, ys)
 
     def mul_bits(self, xs: List[int], ys: List[int]) -> List[int]:
-        width = len(xs)
-        acc = _const_bits(0, width)
-        for i, y in enumerate(ys):
-            if y == FALSE_LIT:
-                continue
-            partial = [FALSE_LIT] * i + [self.g_and(x, y) for x in xs[: width - i]]
-            acc, _ = self.add_bits(acc, partial)
-        return acc
+        return self.pool.mul_bits(xs, ys)
 
     def udivmod_bits(
         self, xs: List[int], ys: List[int]
     ) -> Tuple[List[int], List[int]]:
         """Restoring division; (quotient, remainder) with SMT-LIB zero
         semantics handled by the caller via a zero-divisor mux."""
-        width = len(xs)
-        # remainder runs one bit wider: after the shift-in it can reach
-        # 2*divisor-1 which needs w+1 bits when the divisor is large
-        ys_wide = ys + [FALSE_LIT]
-        remainder = _const_bits(0, width + 1)
-        quotient = [FALSE_LIT] * width
-        for i in range(width - 1, -1, -1):
-            remainder = [xs[i]] + remainder[:width]  # shift left, bring down bit
-            diff, no_borrow = self.sub_bits(remainder, ys_wide)
-            quotient[i] = no_borrow
-            remainder = self.mux_bits(no_borrow, diff, remainder)
-        return quotient, remainder[:width]
+        return self.pool.udivmod_bits(xs, ys)
 
     def shift_bits(self, xs: List[int], ys: List[int], mode: str) -> List[int]:
-        """Barrel shifter; mode in {'shl','lshr','ashr'}."""
+        """Barrel shifter; mode in {'shl','lshr','ashr'}.  Stays in
+        Python: ~log2(width) mux_bits crossings per shift."""
         width = len(xs)
         fill = xs[-1] if mode == "ashr" else FALSE_LIT
         stages = max(1, (width - 1).bit_length())
@@ -785,11 +349,11 @@ class BlastContext:
                 shifted = [FALSE_LIT] * min(amount, width) + acc[: max(0, width - amount)]
             else:
                 shifted = acc[amount:] + [fill] * min(amount, width)
-            acc = self.mux_bits(s, shifted, acc)
+            acc = self.pool.mux_bits(s, shifted, acc)
         # any shift-amount bit >= stages forces the overflow fill
         overflow = self.g_or_many(ys[stages:])
         if overflow != FALSE_LIT:
-            acc = self.mux_bits(overflow, [fill] * width, acc)
+            acc = self.pool.mux_bits(overflow, [fill] * width, acc)
         return acc
 
     # ------------------------------------------------------------------
@@ -839,11 +403,11 @@ class BlastContext:
                     xs, ys = ys, xs
                 return self.mul_bits(xs, ys)
             if op == "and":
-                return [self.g_and(x, y) for x, y in zip(xs, ys)]
+                return self.pool.map_bits(0, xs, ys)
             if op == "or":
-                return [self.g_or(x, y) for x, y in zip(xs, ys)]
+                return self.pool.map_bits(1, xs, ys)
             if op == "xor":
-                return [self.g_xor(x, y) for x, y in zip(xs, ys)]
+                return self.pool.map_bits(2, xs, ys)
             if op in ("shl", "lshr", "ashr"):
                 return self.shift_bits(xs, ys, op)
             if op in ("udiv", "urem"):
@@ -918,9 +482,7 @@ class BlastContext:
         bits = [self.new_lit() for _ in range(rng)]
         for prev_idx, prev_bits in reads:
             same = self.eq_lit(idx_bits, self.blast_bits(prev_idx))
-            for a, b in zip(bits, prev_bits):
-                self._clause([-same, -a, b], owners=(a,))
-                self._clause([-same, a, -b], owners=(a,))
+            self.pool.congruence(same, bits, prev_bits)
         reads.append((idx, bits))
         return bits
 
@@ -941,9 +503,7 @@ class BlastContext:
                     for ab, pa in zip(arg_bits, prev_args)
                 ]
             )
-            for a, b in zip(bits, prev_bits):
-                self._clause([-same, -a, b], owners=(a,))
-                self._clause([-same, a, -b], owners=(a,))
+            self.pool.congruence(same, bits, prev_bits)
         apps.append((args, bits))
         return bits
 
@@ -952,9 +512,6 @@ class BlastContext:
     # ------------------------------------------------------------------
 
     def blast_lit(self, node: T.Node) -> int:
-        # NOTE: generated clauses are buffered host-side; callers that
-        # hit self.solver directly afterwards (instead of going through
-        # check(), which flushes) must call flush_native() first
         cached = self.lit_cache.get(node.id)
         if cached is not None:
             return cached
@@ -1053,7 +610,6 @@ class BlastContext:
             # a stale restriction from an earlier query would be unsound
             self.solver.set_relevant([])
         stats.cone_s += time.monotonic() - t0
-        self.flush_native()
         t0 = time.monotonic()
         status = self.solver.solve(assumptions, conflict_budget, timeout_s)
         stats.native_s += time.monotonic() - t0
