@@ -140,6 +140,9 @@ def load() -> ctypes.CDLL:
         lib.pool_absorb_learnts.restype = ctypes.c_int64
         lib.pool_nogood.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int32]
         lib.pool_nogood.restype = ctypes.c_int32
+        lib.pool_relevant_cone.argtypes = [
+            ctypes.c_void_p, i32p, ctypes.c_int64,
+        ]
         lib.pool_cone.argtypes = [
             ctypes.c_void_p, i32p, ctypes.c_int64, ctypes.c_int32, i64p, i64p,
         ]
@@ -412,6 +415,13 @@ class NativePool:
         )
 
     # ---- cone of influence ----
+
+    def relevant_cone(self, root_lits) -> None:
+        """Compute the var union of the roots' cones (incrementally
+        cached against the previous call's root set) and install it as
+        the CDCL decision restriction — no host-side fetch."""
+        arr = (ctypes.c_int32 * len(root_lits))(*root_lits)
+        self._lib.pool_relevant_cone(self._handle, arr, len(root_lits))
 
     def cone(self, root_lits, need_clauses: bool = True):
         """(clause indices int64, vars int64) of the defining cone of

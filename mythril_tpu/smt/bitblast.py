@@ -593,17 +593,11 @@ class BlastContext:
         t0 = time.monotonic()
         if getattr(_args, "cone_decisions", True):
             try:
-                _, cone_vars = self.cone(assumptions, need_clauses=False)
-                assumption_vars = np.abs(
-                    np.fromiter(assumptions, dtype=np.int64, count=len(assumptions))
-                )
-                # no dedupe needed: set_relevant marks a membership
-                # bitmap, duplicates are harmless
-                self.solver.set_relevant(
-                    np.concatenate([cone_vars, assumption_vars]).astype(
-                        np.int32
-                    )
-                )
+                # one native call: cone-var union (incrementally cached
+                # against the previous query's roots — sets grow by
+                # appending) installed straight into the CDCL decision
+                # restriction, no host-side fetch
+                self.pool.relevant_cone(assumptions)
             except Exception:  # noqa: BLE001 — optimization only
                 self.solver.set_relevant([])
         else:
