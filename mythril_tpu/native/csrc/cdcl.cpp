@@ -66,6 +66,7 @@ class Solver {
     seen_.resize(assigns_.size(), 0);
     heap_pos_.resize(assigns_.size(), -1);
     watches_.resize(assigns_.size() * 2 + 2);
+    bin_watches_.resize(assigns_.size() * 2 + 2);
     heap_insert(v);
     return v;
   }
@@ -221,6 +222,7 @@ class Solver {
   bool ok_ = true;
   vector<Clause> clauses_;
   vector<vector<Watcher>> watches_;   // indexed by lit_index
+  vector<vector<Watcher>> bin_watches_;  // binary-clause implications
   vector<int8_t> assigns_;            // var -> 0/1/-1
   vector<int> level_;
   vector<int> reason_;                // var -> clause idx or -1
@@ -317,11 +319,22 @@ class Solver {
   void var_decay() { var_inc_ /= 0.95; }
 
   // ---- clause attachment ----
+
+  // Binary clauses live in dedicated implication lists: propagation
+  // reads the implied literal directly instead of touching the Clause
+  // object (most of the pool is 2-lit Tseitin gate clauses, so this is
+  // the hot path of every BCP pass).  Shared by attach() and the
+  // reduceDB watch rebuild so the routing rule cannot drift.
+  void attach_watchers(int idx, const vector<Lit>& lits) {
+    auto& target = lits.size() == 2 ? bin_watches_ : watches_;
+    target[lit_index(-lits[0])].push_back({idx, lits[1]});
+    target[lit_index(-lits[1])].push_back({idx, lits[0]});
+  }
+
   int attach(const vector<Lit>& lits, bool learned) {
     int idx = (int)clauses_.size();
     clauses_.push_back(Clause{(float)cla_inc_, learned, false, lits});
-    watches_[lit_index(-lits[0])].push_back({idx, lits[1]});
-    watches_[lit_index(-lits[1])].push_back({idx, lits[0]});
+    attach_watchers(idx, clauses_[idx].lits);
     return idx;
   }
 
@@ -337,6 +350,14 @@ class Solver {
   int propagate() {
     while (qhead_ < trail_.size()) {
       Lit p = trail_[qhead_++];
+      // binary implications first: p true forces w.blocker for every
+      // entry; no watch moving, no Clause access
+      auto& bws = bin_watches_[lit_index(p)];
+      for (const Watcher& w : bws) {
+        int v = value(w.blocker);
+        if (v == -1) return w.clause;  // conflict
+        if (v == 0) uncheckedEnqueue(w.blocker, w.clause);
+      }
       auto& ws = watches_[lit_index(p)];
       size_t i = 0, j = 0;
       while (i < ws.size()) {
@@ -407,8 +428,12 @@ class Solver {
     do {
       Clause& cl = clauses_[c];
       if (cl.learned) cla_bump(c);
-      for (size_t k = (p == 0 ? 0 : 1); k < cl.lits.size(); ++k) {
+      for (size_t k = 0; k < cl.lits.size(); ++k) {
         Lit q = cl.lits[k];
+        // skip the implied literal by identity, not position: binary
+        // implications enqueue the watcher's blocker, which need not
+        // be lits[0]
+        if (p != 0 && q == p) continue;
         Var v = std::abs(q);
         if (!seen_[v] && level_[v] > 0) {
           seen_[v] = 1;
@@ -506,11 +531,11 @@ class Solver {
     }
     // rebuild watches
     for (auto& ws : watches_) ws.clear();
+    for (auto& ws : bin_watches_) ws.clear();
     for (int i = 0; i < (int)clauses_.size(); ++i) {
       Clause& c = clauses_[i];
       if (c.deleted || c.lits.empty()) continue;
-      watches_[lit_index(-c.lits[0])].push_back({i, c.lits[1]});
-      watches_[lit_index(-c.lits[1])].push_back({i, c.lits[0]});
+      attach_watchers(i, c.lits);
     }
     max_learned_ += max_learned_ / 10;
   }
