@@ -264,6 +264,13 @@ def create_analyzer_parser(analyzer_parser: argparse.ArgumentParser) -> None:
         "docs/measurements_r3.md)",
     )
     options.add_argument(
+        "--proof-log",
+        action="store_true",
+        help="Record a DRAT-style proof stream on the native solver and "
+        "certify every UNSAT verdict with the independent checker "
+        "before reporting (wrong-UNSAT defense; adds memory and time)",
+    )
+    options.add_argument(
         "--no-onchain-data",
         action="store_true",
         help="Don't attempt to retrieve contract code, variables and balances from the blockchain",
@@ -517,6 +524,7 @@ def _build_analyzer(
         batched_solving=not args.no_batched_solving,
         device_force_dispatch=args.device_force_dispatch,
         lockstep_dispatch=args.lockstep_dispatch,
+        proof_log=args.proof_log,
         strategy=args.strategy,
         disassembler=disassembler,
         address=address,

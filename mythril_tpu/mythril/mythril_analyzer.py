@@ -82,6 +82,7 @@ class MythrilAnalyzer:
         batched_solving: bool = True,
         device_force_dispatch: bool = False,
         lockstep_dispatch: bool = False,
+        proof_log: bool = False,
     ):
         self.eth = disassembler.eth
         self.contracts: List[EVMContract] = disassembler.contracts or []
@@ -110,6 +111,7 @@ class MythrilAnalyzer:
         args.batched_solving = batched_solving
         args.device_force_dispatch = device_force_dispatch
         args.lockstep_dispatch = lockstep_dispatch
+        args.proof_log = proof_log
 
     # ------------------------------------------------------------------
     # symbolic-executor factory — single assembly point for every mode

@@ -88,6 +88,7 @@ class Solver {
       if (v == -1 && level_of(l) == 0) continue;     // already false forever
       out.push_back(l);
     }
+    proof_event(3, out.data(), out.size());
     if (out.empty()) { ok_ = false; return false; }
     if (out.size() == 1) {
       // global unit: belongs at level 0 (kills any saved trail — rare)
@@ -135,7 +136,7 @@ class Solver {
   int solve(const Lit* assumps, int n_assumps, int64_t conflict_budget,
             double time_budget_s) {
     conflict_core_.clear();
-    if (!ok_) return -1;
+    if (!ok_) { proof_event(5, nullptr, 0); return -1; }
     // Assumption-prefix trail reuse: queries arrive as incrementally
     // growing path-constraint sets, so consecutive calls usually share
     // a long assumption prefix.  Decision level i+1 always holds
@@ -180,6 +181,13 @@ class Solver {
     // its early all-relevant-assigned SAT return would be unsound for
     // them)
     restricted_ = false;
+    if (status == -1) {
+      // certify the verdict: DB-level UNSAT (5) is checkable by unit
+      // propagation alone; assumption UNSAT (4) by propagating the
+      // assumption cube over the live clause set
+      if (!ok_) proof_event(5, nullptr, 0);
+      else proof_event(4, assumptions_.data(), assumptions_.size());
+    }
     // keep the trail: the next call reuses the matching prefix
     return status;
   }
@@ -192,6 +200,35 @@ class Solver {
   int64_t conflicts() const { return total_conflicts_; }
   int64_t num_clauses() const { return (int64_t)clauses_.size(); }
   int32_t num_vars() const { return (int32_t)assigns_.size() - 1; }
+
+  // ---- proof logging (wrong-UNSAT defense, SURVEY §4) ----
+  //
+  // A DRAT-style event stream: every ORIGINAL clause (as normalized and
+  // attached), every LEARNED clause (each must have the RUP property
+  // against the clauses live at that point), every deletion, and a
+  // final conflict event for each UNSAT verdict.  An independent
+  // checker (mythril_tpu/smt/drat.py) replays the stream with its own
+  // propagator: a corrupted learned clause fails its RUP check, so a
+  // wrong UNSAT cannot ship silently.  Encoding: int32 records
+  // [marker, lits..., 0] with markers ORIG=3, LEARN=1, DELETE=2,
+  // ASSUMPTION_CONFLICT=4 (lits = the assumption set), DB_CONFLICT=5.
+  void proof_enable() {
+    proof_enabled_ = true;
+    // the constructor's constant-TRUE anchor unit {1} predates any
+    // proof_enable() call; without it the checker cannot certify
+    // verdicts involving the FALSE_LIT (-1) assumption
+    Lit anchor = 1;
+    proof_event(3, &anchor, 1);
+  }
+  bool proof_enabled() const { return proof_enabled_; }
+  bool proof_overflowed() const { return proof_overflow_; }
+  int64_t proof_size() const { return (int64_t)proof_.size(); }
+  int64_t proof_fetch(int32_t* out, int64_t cap) const {
+    int64_t n = std::min(cap, (int64_t)proof_.size());
+    std::memcpy(out, proof_.data(), n * sizeof(int32_t));
+    return n;
+  }
+  void proof_clear() { proof_.clear(); proof_overflow_ = false; }
   int core_size() const { return (int)conflict_core_.size(); }
   const Lit* core() const { return conflict_core_.data(); }
 
@@ -248,6 +285,21 @@ class Solver {
   int64_t total_conflicts_ = 0;
   double deadline_ = -1.0;
   int64_t max_learned_ = 8192;
+  bool proof_enabled_ = false;
+  bool proof_overflow_ = false;
+  vector<int32_t> proof_;
+  static constexpr int64_t kProofCap = (int64_t)1 << 24;  // 64 MB of int32
+
+  void proof_event(int32_t marker, const Lit* lits, size_t n) {
+    if (!proof_enabled_ || proof_overflow_) return;
+    if ((int64_t)proof_.size() + (int64_t)n + 2 > kProofCap) {
+      proof_overflow_ = true;
+      return;
+    }
+    proof_.push_back(marker);
+    proof_.insert(proof_.end(), lits, lits + n);
+    proof_.push_back(0);
+  }
 
   static double now() {
     struct timespec ts;
@@ -526,6 +578,7 @@ class Solver {
       int ci = learned_idx[i];
       if (locked[ci]) continue;
       clauses_[ci].deleted = true;
+      proof_event(2, clauses_[ci].lits.data(), clauses_[ci].lits.size());
       clauses_[ci].lits.clear();
       clauses_[ci].lits.shrink_to_fit();
     }
@@ -568,6 +621,7 @@ class Solver {
           return -1;
         }
         int back_level = analyze(confl, learnt);
+        proof_event(1, learnt.data(), learnt.size());
         cancelUntil(std::max(back_level, 0));
         if (learnt.size() == 1) {
           if (value(learnt[0]) == 0) uncheckedEnqueue(learnt[0], -1);
@@ -697,6 +751,18 @@ int64_t cdcl_learnt_clauses(void* s, int32_t max_width, int64_t from,
 void cdcl_set_relevant(void* s, const int32_t* vars, int64_t n) {
   ((Solver*)s)->set_relevant(vars, n);
 }
+void cdcl_proof_enable(void* s) { ((Solver*)s)->proof_enable(); }
+int32_t cdcl_proof_enabled(void* s) {
+  return ((Solver*)s)->proof_enabled() ? 1 : 0;
+}
+int32_t cdcl_proof_overflowed(void* s) {
+  return ((Solver*)s)->proof_overflowed() ? 1 : 0;
+}
+int64_t cdcl_proof_size(void* s) { return ((Solver*)s)->proof_size(); }
+int64_t cdcl_proof_fetch(void* s, int32_t* out, int64_t cap) {
+  return ((Solver*)s)->proof_fetch(out, cap);
+}
+void cdcl_proof_clear(void* s) { ((Solver*)s)->proof_clear(); }
 
 // ---------------------------------------------------------------------------
 // keccak-256 (Ethereum variant: original Keccak padding 0x01)

@@ -122,6 +122,13 @@ class BlastContext:
         # profiling: the Python mirror + per-gate dict traffic cost 3x
         # the CDCL search itself on the corpus).
         self.pool = NativePool(self.solver)
+        from mythril_tpu.support.support_args import args as _args
+
+        if getattr(_args, "proof_log", False):
+            # wrong-UNSAT defense: record the DRAT-style event stream
+            # so every UNSAT verdict can be certified by the
+            # independent checker (smt/drat.py)
+            self.solver.enable_proof()
         self.bits_cache: Dict[int, List[int]] = {}
         self.lit_cache: Dict[int, int] = {}
         self.var_bits: Dict[int, List[int]] = {}       # bv var node id -> bits
@@ -233,6 +240,15 @@ class BlastContext:
         native side dedupes, rejects tautologies and wide nogoods
         (> 12 lits add scan cost for little pruning), and registers the
         clause for the cone subset-append."""
+        from mythril_tpu.support.support_args import args as _args
+
+        if getattr(_args, "proof_log", False):
+            # a device refutation is not replayable by the proof
+            # checker's unit propagation; absorbing it would plant an
+            # unverifiable axiom under later certified verdicts.  The
+            # nogood is an optimization only — skip it and keep the
+            # proof airtight.
+            return
         self.pool.nogood(list(assumption_lits))
 
     def new_lit(self) -> int:

@@ -21,6 +21,10 @@ class Args(object, metaclass=Singleton):
         self.batched_solving = True          # batch frontier feasibility checks
         self.word_probing = True             # host word-level model probing
         self.cone_decisions = True           # CDCL decisions restricted to query cone
+        # record a DRAT-style proof stream on the CDCL and verify every
+        # UNSAT verdict is certified (wrong-UNSAT defense, SURVEY §4);
+        # CI-tier — adds memory/time, off by default
+        self.proof_log = False
         self.batch_width = 16                # VM states stepped per scheduler round
         self.concrete_replay = True          # lockstep replay of exploit sequences
         self.batch_lanes = 64                # target lanes per TPU solver batch

@@ -1,0 +1,341 @@
+"""Adversarial solver-correctness fuzzing (SURVEY §4: the reference has
+no solver-correctness tests because it trusts Z3; we cannot).
+
+The small-instance differential tests in test_smt.py never drive the
+CDCL into sustained conflict/learning activity, and a real round-4 bug
+(positional literal skipping in conflict analysis corrupting learned
+clauses once binary implications stopped enqueueing lits[0]) slipped
+straight past them while losing an SWC-101 finding on the batchtoken
+oracle.  These instances are sized and shaped to force what that bug
+needed: long binary implication chains (the dominant Tseitin shape),
+conflict-rich cores, clause learning across incremental assumption
+solves, and restarts.
+
+Every UNSAT verdict is re-derived by an independent referee — a
+deliberately dumb chronological DPLL with no learning, no watches, no
+activity — sharing no code or data structures with cdcl.cpp.  Every
+SAT verdict is checked against the full clause set directly.
+(Reintroducing the round-4 analyze() bug into cdcl.cpp makes this file
+fail within the first seeds — verified once by hand.)
+"""
+
+import random
+
+from mythril_tpu.native import SatSolver
+
+
+def _referee_solve(num_vars, clauses, assumptions):
+    """Chronological DPLL, no learning: returns True (SAT) / False."""
+    assign = {}
+    for lit in assumptions:
+        v, val = abs(lit), lit > 0
+        if assign.get(v, val) != val:
+            return False
+        assign[v] = val
+
+    def propagate():
+        changed = True
+        while changed:
+            changed = False
+            for clause in clauses:
+                unassigned = None
+                satisfied = False
+                count = 0
+                for lit in clause:
+                    v = abs(lit)
+                    if v in assign:
+                        if assign[v] == (lit > 0):
+                            satisfied = True
+                            break
+                    else:
+                        unassigned = lit
+                        count += 1
+                if satisfied:
+                    continue
+                if count == 0:
+                    return False  # conflict
+                if count == 1:
+                    assign[abs(unassigned)] = unassigned > 0
+                    changed = True
+        return True
+
+    def search():
+        if not propagate():
+            return False
+        for v in range(2, num_vars + 1):
+            if v not in assign:
+                break
+        else:
+            return True
+        saved = dict(assign)
+        for val in (True, False):
+            assign[v] = val
+            if search():
+                return True
+            assign.clear()
+            assign.update(saved)
+        return False
+
+    assign[1] = True  # constant-TRUE anchor
+    return search()
+
+
+def _check_model(solver, clauses, assumptions):
+    for lit in assumptions:
+        assert solver.model_value(abs(lit)) == (lit > 0), "model vs assumption"
+    for clause in clauses:
+        assert any(
+            solver.model_value(abs(lit)) == (lit > 0) for lit in clause
+        ), f"model falsifies clause {clause}"
+
+
+def _implication_chain_instance(rng, num_vars):
+    """Binary-heavy instances: long implication chains stitched with
+    ternary cross-links, the shape the Tseitin pool actually has."""
+    clauses = []
+    order = list(range(2, num_vars + 1))
+    rng.shuffle(order)
+    for a, b in zip(order, order[1:]):
+        sa = rng.choice((1, -1))
+        sb = rng.choice((1, -1))
+        clauses.append([-sa * a, sb * b])  # sa*a -> sb*b
+    for _ in range(num_vars // 2):
+        picks = rng.sample(order, 3)
+        clauses.append(
+            [rng.choice((1, -1)) * v for v in picks]
+        )
+    # a few forcing units to seed propagation storms
+    for v in rng.sample(order, max(1, num_vars // 8)):
+        clauses.append([rng.choice((1, -1)) * v])
+    return clauses
+
+
+def test_binary_chain_torture_vs_referee():
+    rng = random.Random(20260730)
+    for trial in range(60):
+        num_vars = rng.randint(12, 22)
+        solver = SatSolver()
+        for _ in range(num_vars - 1):
+            solver.new_var()
+        clauses = _implication_chain_instance(rng, num_vars)
+        for clause in clauses:
+            solver.add_clause(list(clause))
+        # several incremental queries against the same instance, with
+        # growing assumption prefixes (the analysis's access pattern)
+        base = [
+            rng.choice((1, -1)) * v
+            for v in rng.sample(range(2, num_vars + 1), 3)
+        ]
+        for k in range(1, len(base) + 1):
+            assumptions = base[:k]
+            got = solver.solve(assumptions)
+            want = _referee_solve(num_vars, clauses, assumptions)
+            assert got in (SatSolver.SAT, SatSolver.UNSAT)
+            assert (got == SatSolver.SAT) == want, (
+                f"trial {trial}, assumptions {assumptions}: "
+                f"cdcl={got} referee={want}"
+            )
+            if got == SatSolver.SAT:
+                _check_model(solver, clauses, assumptions)
+
+
+def _parity_cnf(xor_vars, parity):
+    """CNF for xor(vars) == parity: all sign patterns with odd/even
+    negation count (direct encoding, 2^(k-1) clauses)."""
+    k = len(xor_vars)
+    clauses = []
+    for pattern in range(1 << k):
+        # the clause [l1..lk] excludes exactly the assignment
+        # falsifying every li: value(v_i) = 0 where the literal is
+        # positive (bit set), 1 where negative — so the excluded
+        # assignment's xor is (k - popcount(pattern)) % 2.  Emit the
+        # clause iff that xor violates the required parity.
+        excluded_xor = (k - bin(pattern).count("1")) % 2
+        if excluded_xor == parity:
+            continue
+        clauses.append(
+            [v if (pattern >> i) & 1 else -v
+             for i, v in enumerate(xor_vars)]
+        )
+    return clauses
+
+
+def _gf2_referee(num_vars, systems, assumptions):
+    """Gaussian elimination over GF(2): SAT iff the parity system plus
+    the assumption pins is consistent.  Independent of any CNF view."""
+    import numpy as np
+
+    rows = []
+    rhs = []
+    for xor_vars, parity in systems:
+        row = np.zeros(num_vars + 1, dtype=np.uint8)
+        for v in xor_vars:
+            row[v] ^= 1
+        rows.append(row)
+        rhs.append(parity)
+    for lit in assumptions:
+        row = np.zeros(num_vars + 1, dtype=np.uint8)
+        row[abs(lit)] = 1
+        rows.append(row)
+        rhs.append(1 if lit > 0 else 0)
+    a = np.array(rows, dtype=np.uint8)
+    b = np.array(rhs, dtype=np.uint8)
+    r = 0
+    for col in range(num_vars + 1):
+        pivot = None
+        for i in range(r, len(a)):
+            if a[i, col]:
+                pivot = i
+                break
+        if pivot is None:
+            continue
+        a[[r, pivot]] = a[[pivot, r]]
+        b[[r, pivot]] = b[[pivot, r]]
+        mask = a[:, col].copy().astype(bool)
+        mask[r] = False
+        a[mask] ^= a[r]
+        b[mask] ^= b[r]
+        r += 1
+    # inconsistent iff some zero row has rhs 1
+    zero_rows = ~a.any(axis=1)
+    return not bool((b[zero_rows] == 1).any())
+
+
+def test_parity_torture_vs_gf2():
+    """XOR/parity systems are the classic CDCL stressor: resolution
+    proofs are long, so verdicts exercise sustained conflict analysis,
+    learning, restarts, and clause-DB churn — precisely where a subtly
+    corrupted learned clause flips an answer.  The referee solves the
+    same system by GF(2) elimination, sharing nothing with the CNF
+    view.  (The reintroduced round-4 analyze() bug fails this test on
+    seed 1 — verified by hand against a scratch build.)"""
+    rng = random.Random(20260731)
+    for trial in range(12):
+        num_vars = rng.randint(18, 30)
+        solver = SatSolver()
+        for _ in range(num_vars - 1):
+            solver.new_var()
+        systems = []
+        for _ in range(num_vars + rng.randint(-2, 4)):
+            # k=2 rows lower into BINARY clauses (equivalence /
+            # antivalence links) — the dominant Tseitin shape, and the
+            # reason-clause class the round-4 analyze() bug corrupted
+            k = rng.choice((2, 2, 3, 3, 4))
+            xor_vars = rng.sample(range(2, num_vars + 1), k)
+            parity = rng.getrandbits(1)
+            systems.append((xor_vars, parity))
+            for clause in _parity_cnf(xor_vars, parity):
+                solver.add_clause(list(clause))
+        for _query in range(4):
+            assumptions = [
+                rng.choice((1, -1)) * v
+                for v in rng.sample(range(2, num_vars + 1),
+                                    rng.randint(0, 5))
+            ]
+            got = solver.solve(assumptions)
+            want = _gf2_referee(num_vars, systems, assumptions)
+            assert got in (SatSolver.SAT, SatSolver.UNSAT)
+            assert (got == SatSolver.SAT) == want, (
+                f"trial {trial}, assumptions {assumptions}: "
+                f"cdcl={got} gf2={want}"
+            )
+            if got == SatSolver.SAT:
+                for xor_vars, parity in systems:
+                    acc = 0
+                    for v in xor_vars:
+                        acc ^= 1 if solver.model_value(v) else 0
+                    assert acc == parity, "model violates parity row"
+
+
+def test_blaster_known_sat_never_unsat():
+    """Known-SAT construction through the REAL encoding pipeline: pick
+    a concrete assignment, emit only constraints true under it
+    (multiplier equations included — the conflict-heavy circuit class),
+    and force the CDCL path by bypassing the word-level probe.  Any
+    UNSAT verdict is a proven wrong-UNSAT.  This is the exact failure
+    shape of the round-4 analyze() bug (batchtoken lost its SWC-101
+    because a SAT overflow query came back UNSAT), reproduced at test
+    scale: the reintroduced bug fails this test within the first
+    trials — verified by hand against a scratch build."""
+    import random as _random
+
+    from mythril_tpu.smt import terms as T
+    from mythril_tpu.smt.bitblast import BlastContext
+
+    rng = _random.Random(424242)
+    for trial in range(12):
+        width = rng.choice((12, 16))
+        mask = (1 << width) - 1
+        ctx = BlastContext()
+        vars_ = [T.var(f"kt{trial}_{i}", width) for i in range(4)]
+        assignment = {v.id: rng.getrandbits(width) for v in vars_}
+        env = T.EvalEnv(dict(assignment))
+
+        def rexpr(depth):
+            if depth == 0 or rng.random() < 0.25:
+                if rng.random() < 0.7:
+                    return rng.choice(vars_)
+                return T.const(rng.getrandbits(width), width)
+            op = rng.choice((T.add, T.sub, T.mul, T.mul, T.bv_and,
+                             T.bv_or, T.bv_xor))
+            return op(rexpr(depth - 1), rexpr(depth - 1))
+
+        constraints = []
+        for _ in range(6):
+            e = rexpr(3)
+            value = T.evaluate(e, env)
+            if rng.random() < 0.5:
+                constraints.append(T.eq(e, T.const(value, width)))
+            else:
+                # a true inequality under the assignment
+                other = rng.getrandbits(width)
+                if other == value:
+                    other = (other + 1) & mask
+                if value < other:
+                    constraints.append(T.ult(e, T.const(other, width)))
+                else:
+                    constraints.append(T.ult(T.const(other, width), e))
+        # solve incrementally with growing constraint sets, straight on
+        # the CDCL (no probe): every prefix is satisfied by `env`, so
+        # UNSAT is impossible
+        for k in range(1, len(constraints) + 1):
+            assumptions = [ctx.blast_lit(c) for c in constraints[:k]]
+            status = ctx.solver.solve(assumptions)
+            assert status == SatSolver.SAT, (
+                f"wrong-UNSAT: trial {trial} prefix {k} "
+                f"(witness assignment exists by construction)"
+            )
+
+
+def test_conflict_rich_incremental_torture():
+    """Interleave clause additions with solves so learned clauses from
+    one query constrain the next — a wrong learnt clause poisons later
+    verdicts, which is exactly what must be caught."""
+    rng = random.Random(77)
+    for trial in range(25):
+        num_vars = rng.randint(10, 16)
+        solver = SatSolver()
+        for _ in range(num_vars - 1):
+            solver.new_var()
+        clauses = []
+        for round_no in range(6):
+            for _ in range(rng.randint(3, 8)):
+                width = rng.choice((2, 2, 2, 3))  # binary-heavy
+                picks = rng.sample(range(2, num_vars + 1), width)
+                clause = [rng.choice((1, -1)) * v for v in picks]
+                clauses.append(clause)
+                solver.add_clause(list(clause))
+            assumptions = [
+                rng.choice((1, -1)) * v
+                for v in rng.sample(range(2, num_vars + 1), rng.randint(0, 4))
+            ]
+            got = solver.solve(assumptions)
+            want = _referee_solve(num_vars, clauses, assumptions)
+            if got == SatSolver.UNSAT and not want:
+                continue
+            assert got in (SatSolver.SAT, SatSolver.UNSAT)
+            assert (got == SatSolver.SAT) == want, (
+                f"trial {trial} round {round_no}: cdcl={got} referee={want}"
+            )
+            if got == SatSolver.SAT:
+                _check_model(solver, clauses, assumptions)
