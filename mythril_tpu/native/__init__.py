@@ -509,8 +509,11 @@ class NativePool:
         [from_clause, to_clause); indptr is rebased to 0."""
         import numpy as np
 
+        clause_total = self.num_clauses
         if to_clause is None:
-            to_clause = self.num_clauses
+            to_clause = clause_total
+        from_clause = max(0, from_clause)
+        to_clause = min(clause_total, to_clause)
         count = to_clause - from_clause
         if count <= 0:
             return (
@@ -535,6 +538,8 @@ class NativePool:
         ``max_width`` are skipped and counted."""
         import numpy as np
 
+        from_clause = max(0, from_clause)
+        to_clause = min(self.num_clauses, to_clause)
         count = max(0, to_clause - from_clause)
         out = np.zeros((count, max_width), dtype=np.int32)
         dropped = ctypes.c_int64()

@@ -65,6 +65,8 @@ class DispatchStats:
         self.undecided = 0         # lanes handed to the CDCL tail
         self.host_probe_sat = 0    # lanes decided by host word-level probing
         self.mesh_dispatches = 0   # invocations through the sharded mesh path
+        self.mesh_pool_rows = 0    # clause rows in the last mesh dispatch
+        self.mesh_absorbed = 0     # absorbed CDCL learnts in that pool
         # dispatch attempts that bailed on the size caps (cone too large
         # for the dense kernel AND pool too large for the gather probe):
         # explains a zero dispatch count on small-contract corpora
@@ -531,6 +533,14 @@ class BatchedSatBackend:
                 get_mesh(), self.pool.lits_np, assign,
             )
             dispatch_stats.mesh_dispatches += 1
+            # rows scanned per shard ride cp; absorbed CDCL learnts are
+            # inside pool.filled (refresh folds them in above), so this
+            # pair documents that the learned-clause channel reaches the
+            # sharded path
+            dispatch_stats.mesh_pool_rows = self.pool.filled
+            dispatch_stats.mesh_absorbed = getattr(
+                ctx, "absorbed_learnt_count", 0
+            )
         else:
             step = self._step_cache.get(self.pool.num_vars)
             if step is None:

@@ -127,6 +127,22 @@ def sharded_frontier_solve(
     dp = mesh.shape["dp"]
     cp = mesh.shape["cp"]
     batch = assign.shape[0]
+    true_v1 = assign.shape[1]
+    # bucket the VAR count (true_v1 - 1) to a power of two: the pool
+    # grows on every blast, and a per-dispatch shard_map recompile
+    # (tens of seconds) would otherwise dominate the whole mesh path.
+    # (Bucketing the column count v+1 itself would round an
+    # already-bucketed pool up to double the needed width.)
+    num_vars = 256
+    while num_vars < true_v1 - 1:
+        num_vars *= 2
+    v1 = num_vars + 1
+    if v1 > true_v1:
+        # pad columns as assigned-true: nonexistent vars must never
+        # consume DPLL decisions or keep the sweep loop running
+        assign = np.concatenate(
+            [assign, np.ones((batch, v1 - true_v1), np.int8)], axis=1
+        )
     pad_lanes = (-batch) % dp
     if pad_lanes:
         # pad lanes fully assigned: an all-open lane would keep the
@@ -140,16 +156,16 @@ def sharded_frontier_solve(
         lits = np.concatenate(
             [lits, np.zeros((pad_rows, lits.shape[1]), np.int32)]
         )
-    cache_key = (id(mesh), assign.shape[1] - 1)
+    cache_key = (id(mesh), num_vars)
     solve = _solve_cache.get(cache_key)
     if solve is None:
-        solve = make_sharded_solve(mesh, assign.shape[1] - 1)
+        solve = make_sharded_solve(mesh, num_vars)
         _solve_cache.clear()  # one live shape per mesh is enough
         _solve_cache[cache_key] = solve
     final_assign, status = solve(
         jnp.asarray(lits), jnp.asarray(assign)
     )
     return (
-        np.asarray(final_assign)[:batch],
+        np.asarray(final_assign)[:batch, :true_v1],
         np.asarray(status)[:batch],
     )
