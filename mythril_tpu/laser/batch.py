@@ -45,7 +45,9 @@ def prune_infeasible(states: List) -> List:
             continue
         undecided.append(state)
 
-    min_lanes = max(2, getattr(args, "device_min_lanes", 8))
+    from mythril_tpu.ops.batched_sat import effective_min_lanes
+
+    min_lanes = effective_min_lanes()
     use_batch = args.batched_solving and len(undecided) >= min_lanes
     if use_batch:
         # gate on the number of *unique* constraint sets: sibling forks

@@ -597,11 +597,15 @@ class Pool {
     }
     cdcl_set_relevant(solver_, uni.data(), (int64_t)uni.size());
     if (fresh.empty()) return;  // exact hit: don't fill the ring with dups
-    if (relevant_cache_.size() < 16) {
+    // deep multi-transaction frontiers keep ~dozens of live states
+    // whose query sets interleave; the ring must span them or every
+    // query rebuilds its union from scratch
+    constexpr size_t kRing = 64;
+    if (relevant_cache_.size() < kRing) {
       relevant_cache_.push_back({std::move(root_vars), std::move(uni)});
     } else {
-      relevant_cache_[relevant_cursor_ % 16] = {std::move(root_vars),
-                                                std::move(uni)};
+      relevant_cache_[relevant_cursor_ % kRing] = {std::move(root_vars),
+                                                   std::move(uni)};
       ++relevant_cursor_;
     }
   }

@@ -50,6 +50,24 @@ FUSE_RETRY_PERIOD = 8   # fused contexts re-probe the device every N
 MAX_FUSE_RETRIES = 3    # eligible rounds, at most this many times
 
 
+def effective_min_lanes() -> int:
+    """Structural lane floor for the batched frontier path, shared by
+    laser/batch.py (entry gate) and the dispatch gate here so the two
+    can never drift.  Lane-count ECONOMICS belong to the adaptive
+    profit gate (projected CPU residue cost vs device_min_save_s): at
+    the default knob setting the floor is relaxed to 4 so
+    narrow-but-expensive frontiers (deep -t3 residues average ~200 ms
+    of CDCL per query) reach that gate at all.  An operator who
+    explicitly RAISES device_min_lanes above the default is asking to
+    keep narrow frontiers off the device, and is honored verbatim."""
+    from mythril_tpu.support.support_args import args
+
+    knob = getattr(args, "device_min_lanes", 8)
+    if knob > 8:
+        return knob
+    return max(2, min(knob, 4))
+
+
 class DispatchStats:
     """Device-dispatch telemetry (read by bench.py ablations and the
     solver-statistics report so speedup claims stay attributable)."""
@@ -650,9 +668,7 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     stats.probe_s += time.monotonic() - probe_began
 
     open_indices = [i for i, d in enumerate(decided) if d is None]
-    # below this many probe-resistant lanes the device dispatch's fixed
-    # costs exceed the CDCL tail it would save
-    if len(open_indices) < max(2, getattr(args, "device_min_lanes", 8)):
+    if len(open_indices) < effective_min_lanes():
         return decided
 
     # blast only the still-open lanes (probe-decided lanes must not grow

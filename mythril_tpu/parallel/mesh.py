@@ -132,10 +132,12 @@ def sharded_frontier_solve(
     # grows on every blast, and a per-dispatch shard_map recompile
     # (tens of seconds) would otherwise dominate the whole mesh path.
     # (Bucketing the column count v+1 itself would round an
-    # already-bucketed pool up to double the needed width.)
-    num_vars = 256
-    while num_vars < true_v1 - 1:
-        num_vars *= 2
+    # already-bucketed pool up to double the needed width.)  Shares
+    # DevicePool's bucket helper so the production caller — which
+    # passes a pool already bucketed by it — always hits this cache.
+    from mythril_tpu.ops.batched_sat import DevicePool
+
+    num_vars = DevicePool._bucket(true_v1 - 1)
     v1 = num_vars + 1
     if v1 > true_v1:
         # pad columns as assigned-true: nonexistent vars must never
