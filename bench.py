@@ -356,6 +356,7 @@ def _analyze_one(name, code, tx_count, execution_timeout, max_depth):
     from mythril_tpu.analysis.security import fire_lasers
     from mythril_tpu.analysis.symbolic import SymExecWrapper
     from mythril_tpu.laser.ethereum.time_handler import time_handler
+    from mythril_tpu.ops.async_dispatch import async_stats, get_async_dispatcher
     from mythril_tpu.ops.batched_sat import dispatch_stats
     from mythril_tpu.smt.solver import SolverStatistics, reset_blast_context
     from mythril_tpu.solidity.evmcontract import EVMContract
@@ -367,6 +368,8 @@ def _analyze_one(name, code, tx_count, execution_timeout, max_depth):
         module.reset_module()
         module.cache.clear()
     dispatch_stats.reset()
+    async_stats.reset()
+    get_async_dispatcher().drop()
     stats = SolverStatistics()
     stats.enabled = True
     stats.reset()
@@ -402,6 +405,8 @@ def _analyze_one(name, code, tx_count, execution_timeout, max_depth):
         **split,
         "other_s": round(max(0.0, wall - accounted), 2),
         **dd,
+        **{k: round(v, 3) if isinstance(v, float) else v
+           for k, v in async_stats.as_dict().items()},
         "device_status": DEVICE_STATUS,
     }
     return found, row

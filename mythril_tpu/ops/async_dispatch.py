@@ -1,0 +1,178 @@
+"""Asynchronous device dispatch: overlap accelerator solving with host
+exploration (VERDICT r3 #1 / SURVEY §7 north star).
+
+The synchronous dispatch path (ops/batched_sat.py) must beat the CPU
+on wall-clock to be worth blocking for, so its profit gate keeps the
+device idle whenever the CDCL clears the residue faster — correct, and
+exactly why BENCH_r03 showed zero device seconds.  This module changes
+the economics: when the profit gate declines a frontier, the same
+prepared batch can be launched WITHOUT blocking (jax dispatch is
+asynchronous; the host thread returns before the kernel finishes) and
+harvested on a later call once the arrays are ready.  The device then
+only has to beat *idle time*:
+
+- device-refuted lanes land in the UNSAT memo and as pool nogoods, so
+  when the frontier re-presents the same (or a superset) constraint
+  set — frontiers repeat sets round over round — the host skips the
+  CDCL work entirely;
+- device models that verify against the terms enter ``recent_models``,
+  feeding the word-level probe the same way CDCL models do.
+
+Nothing ever waits: a pending batch whose results never arrive before
+the analysis ends is simply dropped (telemetry: async_dropped).
+"""
+
+import logging
+import time
+from typing import List, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+class AsyncStats:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.launches = 0          # batches launched without blocking
+        self.harvested = 0         # batches whose results were consumed
+        self.unsat = 0             # lanes refuted (memoized + nogood)
+        self.models = 0            # device models verified + remembered
+        self.dropped = 0           # pending batches discarded unread
+        self.launch_s = 0.0        # host time spent launching (non-block)
+        self.harvest_s = 0.0       # host time spent harvesting
+
+    def as_dict(self):
+        return {f"async_{k}": v for k, v in self.__dict__.items()}
+
+
+async_stats = AsyncStats()
+
+
+class AsyncDispatcher:
+    """One in-flight batch at a time, tied to a blast-context
+    generation.  The caller is ops/batched_sat.batch_check_states:
+    ``harvest`` runs at every entry (cheap readiness check), ``launch``
+    runs when the profit gate declines a frontier the device could
+    still prefetch."""
+
+    def __init__(self):
+        self.pending = None
+
+    # -- launch --------------------------------------------------------
+
+    def launch(self, backend, ctx, rep_assumption_sets, rep_node_sets,
+               rep_constraint_sets) -> bool:
+        """Prepare (on this thread — the only part that touches the
+        blast context) and hand the compile+launch to a worker thread,
+        so even a first-per-bucket jit compile never blocks the host.
+        Returns True when a batch went in flight."""
+        if self.pending is not None:
+            return False
+        began = time.monotonic()
+        runner = backend.prepare_gather(ctx, rep_assumption_sets)
+        if runner is None:
+            return False
+        pending = {
+            "generation": ctx.generation,
+            "status": None,
+            "assign": None,
+            "done": False,
+            "assumption_sets": list(rep_assumption_sets),
+            "node_sets": list(rep_node_sets),
+            "constraint_sets": list(rep_constraint_sets),
+        }
+
+        def work():
+            try:
+                handle = runner()
+                pending["status"] = handle["status"]
+                pending["assign"] = handle["assign"]
+            except Exception as exc:  # noqa: BLE001 — prefetch only
+                log.debug("async dispatch failed: %s", exc)
+                pending["failed"] = True
+            pending["done"] = True
+
+        import threading
+
+        thread = threading.Thread(target=work, daemon=True)
+        thread.start()
+        self.pending = pending
+        async_stats.launches += 1
+        async_stats.launch_s += time.monotonic() - began
+        return True
+
+    # -- harvest -------------------------------------------------------
+
+    def _ready(self) -> bool:
+        if not self.pending["done"]:
+            return False  # worker thread still compiling/launching
+        status = self.pending["status"]
+        try:
+            return bool(status.is_ready())
+        except AttributeError:  # older jax arrays: treat as ready
+            return True
+
+    def harvest(self, ctx) -> None:
+        """Consume a finished batch, if any.  Never blocks: a batch
+        still in flight stays pending; a batch from a dead context is
+        dropped."""
+        if self.pending is None:
+            return
+        if self.pending["generation"] != ctx.generation:
+            self.pending = None
+            async_stats.dropped += 1
+            return
+        if self.pending.get("failed"):
+            self.pending = None
+            async_stats.dropped += 1
+            return
+        if not self._ready():
+            return
+        began = time.monotonic()
+        pending, self.pending = self.pending, None
+        from mythril_tpu.smt import terms as T
+
+        status = np.asarray(pending["status"])
+        assign = np.asarray(pending["assign"])
+        from mythril_tpu.ops.batched_sat import _env_from_assignment
+
+        for lane, node_set in enumerate(pending["node_sets"]):
+            if status[lane] == 2:
+                # sound UNSAT: permanent memo + pool nogood, so the
+                # CDCL and later dispatches inherit the refutation
+                ctx.note_unsat(node_set)
+                ctx.learn_nogood(pending["assumption_sets"][lane])
+                async_stats.unsat += 1
+            elif status[lane] == 1:
+                env = _env_from_assignment(ctx, assign[lane])
+                ok = True
+                for constraint in pending["constraint_sets"][lane]:
+                    node = getattr(constraint, "raw", constraint)
+                    if isinstance(node, bool):
+                        continue
+                    if T.evaluate(node, env) is not True:
+                        ok = False
+                        break
+                if ok:
+                    ctx._remember_model(env)
+                    async_stats.models += 1
+        async_stats.harvested += 1
+        async_stats.harvest_s += time.monotonic() - began
+
+    def drop(self) -> None:
+        if self.pending is not None:
+            self.pending = None
+            async_stats.dropped += 1
+
+
+_dispatcher: Optional[AsyncDispatcher] = None
+
+
+def get_async_dispatcher() -> AsyncDispatcher:
+    global _dispatcher
+    if _dispatcher is None:
+        _dispatcher = AsyncDispatcher()
+    return _dispatcher

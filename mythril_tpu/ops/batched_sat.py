@@ -504,38 +504,9 @@ class BatchedSatBackend:
             )
             return [None] * len(assumption_sets)
 
+        assign = self._sync_pool_and_assign(ctx, assumption_sets, num_vars)
         jax, jnp = _require_jax()
-        if self.pool_generation != ctx.generation:
-            # a new BlastContext (reset between analyses): the resident
-            # pool describes a different formula — appending would graft
-            # the new clauses onto it at stale offsets and make device
-            # UNSAT verdicts unsound, so always rebuild from scratch
-            self.pool.refresh(ctx, num_vars)
-            self.pool.version = ctx.pool_version
-            self.pool_generation = ctx.generation
-        elif self.pool.version != ctx.pool_version or (
-            self.pool.num_vars < num_vars
-        ):
-            # delta append into the existing buckets when possible; full
-            # rebuild + upload only when a bucket grows
-            if not self.pool.append(ctx, num_vars):
-                self.pool.refresh(ctx, num_vars)
-            self.pool.version = ctx.pool_version
-
         batch = len(assumption_sets)
-        V1 = self.pool.num_vars + 1
-        assign = np.zeros((batch, V1), dtype=np.int8)
-        # vars absent from every retained clause (bucket padding, vars
-        # defined only by dropped wide clauses) are preassigned so the
-        # DPLL never spends decisions completing them; assumptions below
-        # overwrite where they refer to such a var
-        assign[:, ~self.pool.used] = 1
-        assign[:, 1] = 1  # constant-TRUE anchor
-        for lane, assumptions in enumerate(assumption_sets):
-            for lit in assumptions:
-                var = abs(lit)
-                if var < V1:
-                    assign[lane, var] = 1 if lit > 0 else -1
 
         self.device_engaged = True
         if len(jax.devices()) > 1:
@@ -579,6 +550,90 @@ class BatchedSatBackend:
                 results.append(None)  # candidate: host verifies the model
         return results
 
+    def _sync_pool_and_assign(self, ctx, assumption_sets, num_vars):
+        """Shared prep for the sync and async gather paths: reflect the
+        pool delta on device and build the assumption-seeded assignment
+        matrix."""
+        _require_jax()
+        if self.pool_generation != ctx.generation:
+            # a new BlastContext (reset between analyses): the resident
+            # pool describes a different formula — appending would graft
+            # the new clauses onto it at stale offsets and make device
+            # UNSAT verdicts unsound, so always rebuild from scratch
+            self.pool.refresh(ctx, num_vars)
+            self.pool.version = ctx.pool_version
+            self.pool_generation = ctx.generation
+        elif self.pool.version != ctx.pool_version or (
+            self.pool.num_vars < num_vars
+        ):
+            # delta append into the existing buckets when possible; full
+            # rebuild + upload only when a bucket grows
+            if not self.pool.append(ctx, num_vars):
+                self.pool.refresh(ctx, num_vars)
+            self.pool.version = ctx.pool_version
+
+        batch = len(assumption_sets)
+        V1 = self.pool.num_vars + 1
+        assign = np.zeros((batch, V1), dtype=np.int8)
+        # vars absent from every retained clause (bucket padding, vars
+        # defined only by dropped wide clauses) are preassigned so the
+        # DPLL never spends decisions completing them; assumptions below
+        # overwrite where they refer to such a var
+        assign[:, ~self.pool.used] = 1
+        assign[:, 1] = 1  # constant-TRUE anchor
+        for lane, assumptions in enumerate(assumption_sets):
+            for lit in assumptions:
+                var = abs(lit)
+                if var < V1:
+                    assign[lane, var] = 1 if lit > 0 else -1
+        return assign
+
+    def prepare_gather(self, ctx, assumption_sets):
+        """Async-prefetch preparation (ops/async_dispatch.py): run the
+        sync path's eligibility gates (minus the profit gate) and build
+        the device inputs ON THE CALLING THREAD — everything that
+        touches the blast context — then return a zero-argument runner
+        that compiles (first time per pool bucket) and launches the
+        jitted solve.  The runner is safe to execute on a worker
+        thread: it captures immutable jax arrays and plain numpy, and
+        the host thread never waits on it.  Returns None when the
+        frontier is ineligible."""
+        if not assumption_sets:
+            return None
+        from mythril_tpu.ops.device_health import backend_name, device_ok
+        from mythril_tpu.ops.pallas_prop import pallas_enabled
+
+        if not device_ok():
+            return None
+        if pallas_enabled() is None and backend_name() in (None, "cpu"):
+            return None
+        num_vars = ctx.solver.num_vars
+        if num_vars > MAX_GATHER_VARS:
+            return None
+        ctx.absorb_learnts(max_width=MAX_CLAUSE_WIDTH)
+        absorbed = min(
+            getattr(ctx, "absorbed_learnt_count", 0), MAX_LEARNT_EXEMPTION
+        )
+        if ctx.pool.num_clauses - absorbed > MAX_GATHER_CLAUSES:
+            return None
+        _, jnp = _require_jax()
+        assign = self._sync_pool_and_assign(ctx, assumption_sets, num_vars)
+        bucket = self.pool.num_vars
+        lits = self.pool.lits  # immutable jax array: safe to capture
+
+        def run():
+            step = self._step_cache.get(bucket)
+            if step is None:
+                # first compile for this bucket happens on the worker
+                # thread — the host's only budget here is idle time
+                step = make_solve_step(bucket)
+                self._step_cache = {bucket: step}
+            assign_dev, status_dev = step(lits, jnp.asarray(assign))
+            return {"status": status_dev, "assign": assign_dev}
+
+        return run
+
+
 _backend: Optional[BatchedSatBackend] = None
 
 
@@ -611,6 +666,11 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     from mythril_tpu.support.support_args import args
 
     ctx = get_blast_context()
+    from mythril_tpu.ops.async_dispatch import get_async_dispatcher
+
+    # consume any finished async prefetch first: its UNSAT memos and
+    # remembered models decide lanes of THIS frontier below for free
+    get_async_dispatcher().harvest(ctx)
     node_sets: List[Optional[List]] = []
     decided: List[Optional[bool]] = [None] * len(constraint_sets)
 
@@ -667,6 +727,14 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
             dispatch_stats.host_probe_sat += 1
     stats.probe_s += time.monotonic() - probe_began
 
+    if getattr(args, "proof_log", False):
+        # --proof-log certifies every UNSAT verdict by replaying the
+        # CDCL's proof stream; device-kernel refutations have no such
+        # certificate, so the run stays CPU-pure (same reasoning as the
+        # learn_nogood guard in smt/bitblast.py) — a wrong device UNSAT
+        # must not hide behind a "proof check passed" line
+        return decided
+
     open_indices = [i for i, d in enumerate(decided) if d is None]
     if len(open_indices) < effective_min_lanes():
         return decided
@@ -712,6 +780,17 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         projected = len(rep_indices) * avg_native
         if projected < getattr(args, "device_min_save_s", 0.5):
             dispatch_stats.profit_skips += 1
+            if getattr(args, "async_dispatch", True):
+                # not worth BLOCKING for — but the device is idle, so
+                # prefetch the batch asynchronously: refutations and
+                # models harvested on a later call only have to beat
+                # idle time, not CPU time
+                get_async_dispatcher().launch(
+                    get_backend(), ctx,
+                    [assumption_sets[i] for i in rep_indices],
+                    [node_sets[i] for i in rep_indices],
+                    [constraint_sets[i] for i in rep_indices],
+                )
             return decided
 
     backend = get_backend()

@@ -25,6 +25,10 @@ class Args(object, metaclass=Singleton):
         # UNSAT verdict is certified (wrong-UNSAT defense, SURVEY §4);
         # CI-tier — adds memory/time, off by default
         self.proof_log = False
+        # when the profit gate declines a frontier, launch it on the
+        # device asynchronously anyway (no blocking): harvested
+        # refutations/models only need to beat idle time
+        self.async_dispatch = True
         self.batch_width = 16                # VM states stepped per scheduler round
         self.concrete_replay = True          # lockstep replay of exploit sequences
         self.batch_lanes = 64                # target lanes per TPU solver batch

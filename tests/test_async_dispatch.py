@@ -1,0 +1,114 @@
+"""Async device prefetch (ops/async_dispatch.py): when the profit gate
+declines a frontier, the batch launches without blocking and its
+results are harvested on a later call — refutations land in the UNSAT
+memo + pool nogoods, verified models in ``recent_models``, so repeated
+frontier sets are decided host-side for free."""
+
+import numpy as np
+import pytest
+
+from mythril_tpu.laser.ethereum.state.constraints import Constraints
+from mythril_tpu.smt import UGT, ULT, symbol_factory
+from mythril_tpu.smt.solver import get_blast_context, reset_blast_context
+
+
+@pytest.fixture(autouse=True)
+def fresh(monkeypatch):
+    reset_blast_context()
+    from mythril_tpu.ops.async_dispatch import async_stats, get_async_dispatcher
+
+    get_async_dispatcher().drop()
+    async_stats.reset()
+    # reach the device path on the CPU jax backend (tests only)
+    monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
+    yield
+    get_async_dispatcher().drop()
+    reset_blast_context()
+
+
+def _frontier(tag: str):
+    lanes = []
+    for i in range(6):
+        x = symbol_factory.BitVecSym(f"{tag}{i}", 16)
+        if i % 2 == 0:
+            lanes.append([x == 3 + i])
+        else:  # UNSAT: x < 2 and x > 9
+            lanes.append(
+                [ULT(x, symbol_factory.BitVecVal(2, 16)),
+                 UGT(x, symbol_factory.BitVecVal(9, 16))]
+            )
+    return lanes
+
+
+def test_profit_skip_launches_and_harvest_decides_repeats(monkeypatch):
+    from mythril_tpu.ops.async_dispatch import async_stats, get_async_dispatcher
+    from mythril_tpu.ops.batched_sat import batch_check_states, dispatch_stats
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setattr(args, "device_min_lanes", 2)
+    monkeypatch.setattr(args, "device_force_dispatch", False)
+    monkeypatch.setattr(args, "async_dispatch", True)
+    # a fresh analysis has no native_calls, so projected cost is 0 and
+    # the profit gate always declines -> async prefetch territory
+    dispatch_stats.reset()
+    lanes = _frontier("aq")
+    batch_check_states([Constraints(lane) for lane in lanes])
+    assert dispatch_stats.profit_skips >= 1
+    dispatcher = get_async_dispatcher()
+    assert async_stats.launches == 1, "profit skip should have prefetched"
+    assert dispatcher.pending is not None
+
+    # let the worker thread and the in-flight kernel finish (tests must
+    # not depend on timing)
+    import time as _time
+
+    deadline = _time.monotonic() + 120
+    while not dispatcher.pending["done"]:
+        assert _time.monotonic() < deadline, "worker thread never finished"
+        _time.sleep(0.05)
+    assert not dispatcher.pending.get("failed"), "async launch failed"
+    dispatcher.pending["status"].block_until_ready()
+
+    # the SAME frontier re-presents (frontiers repeat across rounds):
+    # the harvest at entry memoizes refutations, and this round decides
+    # the UNSAT lanes from the memo — no CDCL, no new dispatch
+    ctx = get_blast_context()
+    verdicts = batch_check_states([Constraints(lane) for lane in lanes])
+    assert async_stats.harvested == 1
+    assert async_stats.unsat >= 1
+    for i, verdict in enumerate(verdicts):
+        if i % 2 == 1:
+            assert verdict is False, f"lane {i} should come from the memo"
+    assert len(ctx.unsat_memo) >= 1
+
+
+def test_async_disabled_by_flag(monkeypatch):
+    from mythril_tpu.ops.async_dispatch import async_stats
+    from mythril_tpu.ops.batched_sat import batch_check_states, dispatch_stats
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setattr(args, "device_min_lanes", 2)
+    monkeypatch.setattr(args, "device_force_dispatch", False)
+    monkeypatch.setattr(args, "async_dispatch", False)
+    dispatch_stats.reset()
+    batch_check_states([Constraints(lane) for lane in _frontier("ad")])
+    assert async_stats.launches == 0
+
+
+def test_stale_generation_is_dropped(monkeypatch):
+    from mythril_tpu.ops.async_dispatch import async_stats, get_async_dispatcher
+    from mythril_tpu.ops.batched_sat import batch_check_states, dispatch_stats
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setattr(args, "device_min_lanes", 2)
+    monkeypatch.setattr(args, "device_force_dispatch", False)
+    monkeypatch.setattr(args, "async_dispatch", True)
+    dispatch_stats.reset()
+    batch_check_states([Constraints(lane) for lane in _frontier("sg")])
+    assert async_stats.launches == 1
+    # a context reset (new analysis) must invalidate the pending batch
+    reset_blast_context()
+    ctx = get_blast_context()
+    get_async_dispatcher().harvest(ctx)
+    assert async_stats.dropped == 1
+    assert async_stats.harvested == 0
