@@ -368,8 +368,8 @@ def _analyze_one(name, code, tx_count, execution_timeout, max_depth):
         module.reset_module()
         module.cache.clear()
     dispatch_stats.reset()
-    async_stats.reset()
-    get_async_dispatcher().drop()
+    get_async_dispatcher().drop()  # before reset: the drop belongs to
+    async_stats.reset()            # the PREVIOUS contract's row
     stats = SolverStatistics()
     stats.enabled = True
     stats.reset()

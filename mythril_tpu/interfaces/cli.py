@@ -264,6 +264,13 @@ def create_analyzer_parser(analyzer_parser: argparse.ArgumentParser) -> None:
         "docs/measurements_r3.md)",
     )
     options.add_argument(
+        "--no-async-dispatch",
+        action="store_true",
+        help="Disable the asynchronous device prefetch (profit-gate-"
+        "declined frontiers launching on the accelerator without "
+        "blocking; see ops/async_dispatch.py)",
+    )
+    options.add_argument(
         "--proof-log",
         action="store_true",
         help="Record a DRAT-style proof stream on the native solver and "
@@ -525,6 +532,7 @@ def _build_analyzer(
         device_force_dispatch=args.device_force_dispatch,
         lockstep_dispatch=args.lockstep_dispatch,
         proof_log=args.proof_log,
+        async_dispatch=not args.no_async_dispatch,
         strategy=args.strategy,
         disassembler=disassembler,
         address=address,

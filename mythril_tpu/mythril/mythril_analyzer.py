@@ -83,6 +83,7 @@ class MythrilAnalyzer:
         device_force_dispatch: bool = False,
         lockstep_dispatch: bool = False,
         proof_log: bool = False,
+        async_dispatch: bool = True,
     ):
         self.eth = disassembler.eth
         self.contracts: List[EVMContract] = disassembler.contracts or []
@@ -112,6 +113,7 @@ class MythrilAnalyzer:
         args.device_force_dispatch = device_force_dispatch
         args.lockstep_dispatch = lockstep_dispatch
         args.proof_log = proof_log
+        args.async_dispatch = async_dispatch
 
     # ------------------------------------------------------------------
     # symbolic-executor factory — single assembly point for every mode

@@ -88,6 +88,10 @@ class AsyncDispatcher:
         def work():
             try:
                 handle = runner()
+                # block on the worker, never on the host: done=True
+                # only after the kernel finished, so harvest's
+                # np.asarray is a pure copy on every jax version
+                handle["status"].block_until_ready()
                 pending["status"] = handle["status"]
                 pending["assign"] = handle["assign"]
             except Exception as exc:  # noqa: BLE001 — prefetch only
@@ -107,13 +111,9 @@ class AsyncDispatcher:
     # -- harvest -------------------------------------------------------
 
     def _ready(self) -> bool:
-        if not self.pending["done"]:
-            return False  # worker thread still compiling/launching
-        status = self.pending["status"]
-        try:
-            return bool(status.is_ready())
-        except AttributeError:  # older jax arrays: treat as ready
-            return True
+        # the worker blocks until the kernel finished before setting
+        # done, so readiness is just the flag
+        return bool(self.pending["done"])
 
     def harvest(self, ctx) -> None:
         """Consume a finished batch, if any.  Never blocks: a batch

@@ -402,9 +402,15 @@ class BatchedSatBackend:
     """Host-side orchestration of the device lockstep solver."""
 
     def __init__(self):
+        import threading
+
         self.pool = DevicePool()
         self.pool_generation = -1  # BlastContext.generation of the pool
         self._step_cache: Dict[int, object] = {}
+        # the async prefetch worker compiles steps off-thread; the lock
+        # keeps host + worker from double-compiling or evicting each
+        # other's entries
+        self._step_lock = threading.Lock()
         # adaptive fuse: consecutive engaged dispatches that decided
         # zero lanes; past the threshold the device is skipped for the
         # rest of this blast context (paying kernel-dispatch latency
@@ -451,54 +457,12 @@ class BatchedSatBackend:
                 self.device_engaged = True
                 return results
 
-        from mythril_tpu.ops.device_health import device_ok
-
-        num_vars = ctx.solver.num_vars
-        if not device_ok():
-            dispatch_stats.unhealthy_skips += 1
-            self.last_assignments = np.zeros(
-                (len(assumption_sets), num_vars + 1), np.int8
-            )
-            return [None] * len(assumption_sets)
-        from mythril_tpu.ops.device_health import backend_name
-        from mythril_tpu.ops.pallas_prop import pallas_enabled
-
-        if pallas_enabled() is None and backend_name() in (None, "cpu"):
-            # auto mode on a CPU-only host: a gather dispatch through
-            # the CPU jax backend costs more than the CDCL tail it
-            # replaces (measured +4-6s over the corpus) — skip the
-            # device entirely.  Real accelerators (tpu/gpu) keep the
-            # path; tests reach it on CPU by setting MYTHRIL_TPU_PALLAS
-            # explicitly.
-            dispatch_stats.cpu_auto_skips += 1
-            self.last_assignments = np.zeros(
-                (len(assumption_sets), num_vars + 1), np.int8
-            )
-            return [None] * len(assumption_sets)
-        if num_vars > MAX_GATHER_VARS:
-            dispatch_stats.size_bailouts += 1
-            self.last_assignments = np.zeros(
-                (len(assumption_sets), num_vars + 1), np.int8
-            )
-            return [None] * len(assumption_sets)
-        # fold clauses the CDCL tail learned since the last refresh into
-        # the pool mirror BEFORE the budget check, so the count the gate
-        # sees is the count the kernel will actually scan
-        ctx.absorb_learnts(max_width=MAX_CLAUSE_WIDTH)
-        # The gather probe scans the WHOLE pool per BCP iteration; past a
-        # few thousand clauses it costs orders of magnitude more than the
-        # incremental CDCL it is trying to save (measured: ~45 s/dispatch
-        # at 76k clauses vs ~ms per CDCL query).  Big-cone lanes go
-        # straight to the CDCL tail.  Absorbed learnt clauses get a
-        # bounded budget exemption — sharing them must not shut the
-        # device off, but an unbounded exemption would let the total
-        # pool (which the kernel scans in full) regrow the pathology.
-        absorbed = min(
-            getattr(ctx, "absorbed_learnt_count", 0), MAX_LEARNT_EXEMPTION
-        )
-        base_clauses = ctx.pool.num_clauses - absorbed
-        if base_clauses > MAX_GATHER_CLAUSES:
-            dispatch_stats.size_bailouts += 1
+        verdict, num_vars = self._gather_eligibility(ctx)
+        if verdict is not None:
+            # telemetry names the cause (a zero dispatch count must be
+            # attributable from the artifact alone)
+            setattr(dispatch_stats, verdict,
+                    getattr(dispatch_stats, verdict) + 1)
             self.last_assignments = np.zeros(
                 (len(assumption_sets), num_vars + 1), np.int8
             )
@@ -531,10 +495,7 @@ class BatchedSatBackend:
                 ctx, "absorbed_learnt_count", 0
             )
         else:
-            step = self._step_cache.get(self.pool.num_vars)
-            if step is None:
-                step = make_solve_step(self.pool.num_vars)
-                self._step_cache = {self.pool.num_vars: step}
+            step = self._cached_step(self.pool.num_vars)
             final_assign, status = step(
                 self.pool.lits, jnp.asarray(assign)
             )
@@ -549,6 +510,59 @@ class BatchedSatBackend:
             else:
                 results.append(None)  # candidate: host verifies the model
         return results
+
+    def _cached_step(self, bucket: int):
+        """Jitted solve for a pool bucket, compiled at most once per
+        bucket (thread-safe: shared by the sync path and the async
+        prefetch worker).  Bounded to a few live shapes."""
+        with self._step_lock:
+            step = self._step_cache.get(bucket)
+            if step is not None:
+                return step
+        built = make_solve_step(bucket)
+        with self._step_lock:
+            step = self._step_cache.setdefault(bucket, built)
+            if len(self._step_cache) > 4:
+                for key in list(self._step_cache):
+                    if key != bucket and len(self._step_cache) > 4:
+                        del self._step_cache[key]
+        return step
+
+    def _gather_eligibility(self, ctx):
+        """Shared gather-path gates for the sync and async dispatchers.
+        Returns (skip_counter_name | None, num_vars): None means
+        eligible.  Size reasoning: the gather probe scans the WHOLE
+        pool per BCP iteration — past a few thousand clauses it costs
+        orders of magnitude more than the incremental CDCL it is
+        trying to save (measured ~45 s/dispatch at 76k clauses vs ~ms
+        per CDCL query), so big pools go straight to the CDCL tail.
+        Absorbed learnt clauses (folded in here, BEFORE the budget
+        check, so the count the gate sees is what the kernel scans)
+        get a bounded budget exemption — sharing them must not shut
+        the device off, but an unbounded exemption would let the total
+        pool regrow the pathology."""
+        from mythril_tpu.ops.device_health import backend_name, device_ok
+        from mythril_tpu.ops.pallas_prop import pallas_enabled
+
+        num_vars = ctx.solver.num_vars
+        if not device_ok():
+            return "unhealthy_skips", num_vars
+        if pallas_enabled() is None and backend_name() in (None, "cpu"):
+            # auto mode on a CPU-only host: a gather dispatch through
+            # the CPU jax backend costs more than the CDCL tail it
+            # replaces (measured +4-6s over the corpus).  Real
+            # accelerators keep the path; tests reach it on CPU by
+            # setting MYTHRIL_TPU_PALLAS explicitly.
+            return "cpu_auto_skips", num_vars
+        if num_vars > MAX_GATHER_VARS:
+            return "size_bailouts", num_vars
+        ctx.absorb_learnts(max_width=MAX_CLAUSE_WIDTH)
+        absorbed = min(
+            getattr(ctx, "absorbed_learnt_count", 0), MAX_LEARNT_EXEMPTION
+        )
+        if ctx.pool.num_clauses - absorbed > MAX_GATHER_CLAUSES:
+            return "size_bailouts", num_vars
+        return None, num_vars
 
     def _sync_pool_and_assign(self, ctx, assumption_sets, num_vars):
         """Shared prep for the sync and async gather paths: reflect the
@@ -600,21 +614,8 @@ class BatchedSatBackend:
         frontier is ineligible."""
         if not assumption_sets:
             return None
-        from mythril_tpu.ops.device_health import backend_name, device_ok
-        from mythril_tpu.ops.pallas_prop import pallas_enabled
-
-        if not device_ok():
-            return None
-        if pallas_enabled() is None and backend_name() in (None, "cpu"):
-            return None
-        num_vars = ctx.solver.num_vars
-        if num_vars > MAX_GATHER_VARS:
-            return None
-        ctx.absorb_learnts(max_width=MAX_CLAUSE_WIDTH)
-        absorbed = min(
-            getattr(ctx, "absorbed_learnt_count", 0), MAX_LEARNT_EXEMPTION
-        )
-        if ctx.pool.num_clauses - absorbed > MAX_GATHER_CLAUSES:
+        verdict, num_vars = self._gather_eligibility(ctx)
+        if verdict is not None:
             return None
         _, jnp = _require_jax()
         assign = self._sync_pool_and_assign(ctx, assumption_sets, num_vars)
@@ -622,12 +623,9 @@ class BatchedSatBackend:
         lits = self.pool.lits  # immutable jax array: safe to capture
 
         def run():
-            step = self._step_cache.get(bucket)
-            if step is None:
-                # first compile for this bucket happens on the worker
-                # thread — the host's only budget here is idle time
-                step = make_solve_step(bucket)
-                self._step_cache = {bucket: step}
+            # first compile for this bucket happens on the worker
+            # thread — the host's only budget here is idle time
+            step = self._cached_step(bucket)
             assign_dev, status_dev = step(lits, jnp.asarray(assign))
             return {"status": status_dev, "assign": assign_dev}
 
@@ -824,6 +822,9 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     # search explores assignments the probe never saw, so it stays on
     # even for probe-filtered residues — that residue is exactly where
     # the device must pay.
+    from mythril_tpu.ops.async_dispatch import get_async_dispatcher
+
+    prefetch_inflight = get_async_dispatcher().pending is not None
     dispatch_began = time.monotonic()
     verdicts = backend.check_assumption_sets(
         ctx,
@@ -903,7 +904,14 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
                          device_decided)
         else:
             backend.futile_dispatches += 1
-            slow = dispatch_elapsed > SLOW_DISPATCH_FUSE_S
+            # a prefetch kernel in flight shares the device: its queue
+            # time inflates this dispatch, so don't let it trip the
+            # slow fuse (the prefetch is the idle-time use the fuse
+            # exists to protect)
+            slow = (
+                dispatch_elapsed > SLOW_DISPATCH_FUSE_S
+                and not prefetch_inflight
+            )
             if slow:
                 # one slow zero-yield dispatch (a cold kernel compile
                 # or a struggling tunnel) is already worse than the
