@@ -368,8 +368,7 @@ def _analyze_one(name, code, tx_count, execution_timeout, max_depth):
         module.reset_module()
         module.cache.clear()
     dispatch_stats.reset()
-    get_async_dispatcher().drop()  # before reset: the drop belongs to
-    async_stats.reset()            # the PREVIOUS contract's row
+    async_stats.reset()
     stats = SolverStatistics()
     stats.enabled = True
     stats.reset()
@@ -386,6 +385,9 @@ def _analyze_one(name, code, tx_count, execution_timeout, max_depth):
         transaction_count=tx_count,
     )
     issues = fire_lasers(sym)
+    # an unharvested prefetch belongs to THIS contract's row: drop it
+    # before the telemetry snapshot below
+    get_async_dispatcher().drop()
     found = {i.swc_id for i in issues}
     wall = time.time() - t0
     dd = dispatch_stats.as_dict()

@@ -36,6 +36,7 @@ static inline int lit_index(Lit l) {  // 2v / 2v+1 encoding for watch lists
 
 struct Clause {
   float activity = 0.0f;
+  int32_t lbd = 0;  // glue level: distinct decision levels at learn time
   bool learned = false;
   bool deleted = false;
   vector<Lit> lits;
@@ -285,6 +286,8 @@ class Solver {
   int64_t total_conflicts_ = 0;
   double deadline_ = -1.0;
   int64_t max_learned_ = 8192;
+  vector<int64_t> lbd_stamp_;
+  int64_t lbd_stamp_counter_ = 0;
   bool proof_enabled_ = false;
   bool proof_overflow_ = false;
   vector<int32_t> proof_;
@@ -385,7 +388,7 @@ class Solver {
 
   int attach(const vector<Lit>& lits, bool learned) {
     int idx = (int)clauses_.size();
-    clauses_.push_back(Clause{(float)cla_inc_, learned, false, lits});
+    clauses_.push_back(Clause{(float)cla_inc_, 0, learned, false, lits});
     attach_watchers(idx, clauses_[idx].lits);
     return idx;
   }
@@ -558,6 +561,25 @@ class Solver {
     seen_[std::abs(p)] = 0;
   }
 
+  // distinct decision levels among a clause's literals (glucose LBD):
+  // low-LBD ("glue") clauses connect few search levels and keep paying
+  // propagation long after their activity decays
+  int32_t clause_lbd(const vector<Lit>& lits) {
+    ++lbd_stamp_counter_;
+    if (lbd_stamp_.size() < (size_t)decision_level() + 2)
+      lbd_stamp_.resize(decision_level() + 2, 0);
+    int32_t distinct = 0;
+    for (Lit l : lits) {
+      int lv = level_of(l);
+      if (lv >= 0 && (size_t)lv < lbd_stamp_.size() &&
+          lbd_stamp_[lv] != lbd_stamp_counter_) {
+        lbd_stamp_[lv] = lbd_stamp_counter_;
+        ++distinct;
+      }
+    }
+    return distinct;
+  }
+
   void reduceDB() {
     vector<int> learned_idx;
     for (int i = 0; i < (int)clauses_.size(); ++i)
@@ -565,7 +587,16 @@ class Solver {
           clauses_[i].lits.size() > 2)
         learned_idx.push_back(i);
     if ((int64_t)learned_idx.size() < max_learned_) return;
+    // delete the weakest half, glue clauses (lbd <= 2) last: they
+    // connect few search levels and keep paying propagation long after
+    // their activity decays — but the trigger counts EVERYTHING, so a
+    // glue-heavy workload still has bounded memory (glue dies too once
+    // it fills more than half the budget)
     std::sort(learned_idx.begin(), learned_idx.end(), [&](int a, int b) {
+      bool glue_a = clauses_[a].lbd <= 2, glue_b = clauses_[b].lbd <= 2;
+      if (glue_a != glue_b) return glue_b;  // non-glue first
+      if (clauses_[a].lbd != clauses_[b].lbd)
+        return clauses_[a].lbd > clauses_[b].lbd;
       return clauses_[a].activity < clauses_[b].activity;
     });
     vector<int8_t> locked(clauses_.size(), 0);
@@ -621,6 +652,9 @@ class Solver {
           return -1;
         }
         int back_level = analyze(confl, learnt);
+        // LBD must be measured BEFORE the backjump: cancelUntil clears
+        // assignments but leaves stale level_ entries behind
+        int32_t learnt_lbd = clause_lbd(learnt);
         proof_event(1, learnt.data(), learnt.size());
         cancelUntil(std::max(back_level, 0));
         if (learnt.size() == 1) {
@@ -637,6 +671,7 @@ class Solver {
           }
         } else {
           int ci = attach(learnt, true);
+          clauses_[ci].lbd = learnt_lbd;
           uncheckedEnqueue(learnt[0], ci);
         }
         var_decay();

@@ -822,8 +822,6 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     # search explores assignments the probe never saw, so it stays on
     # even for probe-filtered residues — that residue is exactly where
     # the device must pay.
-    from mythril_tpu.ops.async_dispatch import get_async_dispatcher
-
     prefetch_inflight = get_async_dispatcher().pending is not None
     dispatch_began = time.monotonic()
     verdicts = backend.check_assumption_sets(
