@@ -461,9 +461,11 @@ class NativePool:
     # ---- cone of influence ----
 
     def relevant_cone(self, root_lits) -> None:
-        """Compute the var union of the roots' cones (incrementally
-        cached against the previous call's root set) and install it as
-        the CDCL decision restriction — no host-side fetch."""
+        """Install the CDCL decision restriction for a query: each
+        root's memoized cone vars are marked straight into the
+        solver's relevance bitmap natively (no union materialization,
+        no host-side fetch).  An empty/all-constant root set lifts the
+        restriction."""
         arr = (ctypes.c_int32 * len(root_lits))(*root_lits)
         self._lib.pool_relevant_cone(self._handle, arr, len(root_lits))
 

@@ -126,8 +126,19 @@ class Solver {
   void set_relevant(const int32_t* vars, int64_t n) {
     restricted_ = n > 0;
     if (!restricted_) return;
+    relevant_begin();
+    relevant_mark(vars, n);
+  }
+
+  // Incremental variant: the pool marks per-root cone var sets
+  // directly (no union materialization — at deep-analysis scale the
+  // sorted union vectors cost more than the whole CDCL search).
+  void relevant_begin() {
+    restricted_ = true;
     relevant_.assign(assigns_.size(), 0);
     if (relevant_.size() > 1) relevant_[1] = 1;  // TRUE anchor
+  }
+  void relevant_mark(const int32_t* vars, int64_t n) {
     for (int64_t i = 0; i < n; ++i) {
       int32_t v = vars[i];
       if (v > 0 && (size_t)v < relevant_.size()) relevant_[v] = 1;
@@ -785,6 +796,10 @@ int64_t cdcl_learnt_clauses(void* s, int32_t max_width, int64_t from,
 }
 void cdcl_set_relevant(void* s, const int32_t* vars, int64_t n) {
   ((Solver*)s)->set_relevant(vars, n);
+}
+void cdcl_relevant_begin(void* s) { ((Solver*)s)->relevant_begin(); }
+void cdcl_relevant_mark(void* s, const int32_t* vars, int64_t n) {
+  ((Solver*)s)->relevant_mark(vars, n);
 }
 void cdcl_proof_enable(void* s) { ((Solver*)s)->proof_enable(); }
 int32_t cdcl_proof_enabled(void* s) {

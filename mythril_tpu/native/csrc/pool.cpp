@@ -38,6 +38,8 @@ int32_t cdcl_add_clause(void* s, const int32_t* lits, int32_t n);
 int64_t cdcl_learnt_clauses(void* s, int32_t max_width, int64_t from,
                             int32_t* out, int64_t cap, int64_t* next);
 void cdcl_set_relevant(void* s, const int32_t* vars, int64_t n);
+void cdcl_relevant_begin(void* s);
+void cdcl_relevant_mark(void* s, const int32_t* vars, int64_t n);
 }
 
 namespace {
@@ -534,80 +536,31 @@ class Pool {
     return ins.first->second;
   }
 
-  // Decision-restriction fast path: compute the var union of the
-  // roots' cones and hand it straight to the CDCL's set_relevant —
-  // no clause union, no ctypes fetch.  Queries arrive as incrementally
-  // growing assumption sets (paths add one branch condition at a
-  // time), so the union is cached against the previous root set: when
-  // the new set is a superset, only the delta roots' cones merge in.
+  // Decision-restriction fast path: mark each root's memoized cone
+  // vars straight into the CDCL's relevance bitmap — no union vector.
+  // A sorted/unique union at deep-analysis scale (hundreds of
+  // thousands of vars, re-built or copied per query) cost more than
+  // the searches it was restricting; bitmap marking is one sequential
+  // pass over the per-root cones (overlap between sibling roots just
+  // re-marks the same bytes).
   void relevant_cone(const int32_t* roots, int64_t n) {
-    vector<int32_t> root_vars;
-    root_vars.reserve(n);
+    bool any = false;
     for (int64_t i = 0; i < n; ++i) {
-      int32_t v = roots[i] < 0 ? -roots[i] : roots[i];
-      if (v > 1) root_vars.push_back(v);
-    }
-    std::sort(root_vars.begin(), root_vars.end());
-    root_vars.erase(std::unique(root_vars.begin(), root_vars.end()),
-                    root_vars.end());
-    // Queries round-robin across sibling frontier states, each state's
-    // sets growing by appending — so the best incremental base is
-    // rarely the *immediately* previous set.  A small ring of recent
-    // (roots, union) entries catches the interleaving: the largest
-    // cached subset of the new set seeds the union and only the delta
-    // roots' cones merge in.
-    int best = -1;
-    size_t best_size = 0;
-    for (size_t k = 0; k < relevant_cache_.size(); ++k) {
-      const auto& entry = relevant_cache_[k];
-      if (entry.roots.empty() || entry.roots.size() < best_size ||
-          entry.roots.size() > root_vars.size())
-        continue;
-      if (std::includes(root_vars.begin(), root_vars.end(),
-                        entry.roots.begin(), entry.roots.end())) {
-        best = (int)k;
-        best_size = entry.roots.size();
+      int32_t var = roots[i] < 0 ? -roots[i] : roots[i];
+      if (var <= 1) continue;
+      if (!any) {
+        cdcl_relevant_begin(solver_);
+        any = true;
       }
+      const ConeEntry& e = cone_of_var(var);
+      cdcl_relevant_mark(solver_, e.vars.data(), (int64_t)e.vars.size());
+      cdcl_relevant_mark(solver_, &var, 1);
     }
-    vector<int32_t> uni;
-    vector<int32_t> fresh;
-    if (best >= 0) {
-      const auto& entry = relevant_cache_[best];
-      uni = entry.vars;
-      std::set_difference(root_vars.begin(), root_vars.end(),
-                          entry.roots.begin(), entry.roots.end(),
-                          std::back_inserter(fresh));
-    } else {
-      fresh = root_vars;
-    }
-    // pool growth since a cache entry was built can extend cached
-    // cones only for roots not yet unioned (cone_of_var memoizes per
-    // root), so stale unions are subsets — which only weakens the
-    // decision restriction (sound; see Solver::set_relevant).
-    if (!fresh.empty()) {
-      size_t before = uni.size();
-      for (int32_t v : fresh) {
-        const ConeEntry& e = cone_of_var(v);
-        uni.insert(uni.end(), e.vars.begin(), e.vars.end());
-        uni.push_back(v);
-      }
-      std::sort(uni.begin() + before, uni.end());
-      std::inplace_merge(uni.begin(), uni.begin() + before, uni.end());
-      uni.erase(std::unique(uni.begin(), uni.end()), uni.end());
-    }
-    cdcl_set_relevant(solver_, uni.data(), (int64_t)uni.size());
-    if (fresh.empty()) return;  // exact hit: don't fill the ring with dups
-    // deep multi-transaction frontiers keep ~dozens of live states
-    // whose query sets interleave; the ring must span them or every
-    // query rebuilds its union from scratch
-    constexpr size_t kRing = 64;
-    if (relevant_cache_.size() < kRing) {
-      relevant_cache_.push_back({std::move(root_vars), std::move(uni)});
-    } else {
-      relevant_cache_[relevant_cursor_ % kRing] = {std::move(root_vars),
-                                                   std::move(uni)};
-      ++relevant_cursor_;
-    }
+    if (!any)
+      // no real roots (empty / all-constant query): lift the
+      // restriction — an empty bitmap would fake-SAT with a
+      // default-valued model instead of searching the full pool
+      cdcl_set_relevant(solver_, nullptr, 0);
   }
 
   // Union of per-root cones + covered nogoods; result parked in
@@ -729,9 +682,6 @@ class Pool {
   std::unordered_map<vector<int32_t>, int8_t, VecHash> nogood_seen_;
   std::unordered_map<int32_t, ConeEntry> cone_cache_;
   vector<std::pair<int64_t, vector<int32_t>>> nogoods_;
-  struct RelevantEntry { vector<int32_t> roots; vector<int32_t> vars; };
-  vector<RelevantEntry> relevant_cache_;  // recent set_relevant unions
-  size_t relevant_cursor_ = 0;
   vector<int64_t> var_epoch_;
   vector<int64_t> clause_epoch_;
   int64_t var_epoch_counter_ = 0;

@@ -609,10 +609,9 @@ class BlastContext:
         t0 = time.monotonic()
         if getattr(_args, "cone_decisions", True):
             try:
-                # one native call: cone-var union (incrementally cached
-                # against the previous query's roots — sets grow by
-                # appending) installed straight into the CDCL decision
-                # restriction, no host-side fetch
+                # one native call: each root's memoized cone vars are
+                # marked straight into the CDCL relevance bitmap (no
+                # union materialization, no host-side fetch)
                 self.pool.relevant_cone(assumptions)
             except Exception:  # noqa: BLE001 — optimization only
                 self.solver.set_relevant([])
