@@ -117,6 +117,41 @@ def test_false_lit_assumption_certifies():
     assert stats["unsat_verdicts"] == 1
 
 
+def test_wide_frontier_analysis_certifies():
+    """Certification at wide-frontier scale: the bench's scale
+    scenario (binary dispatch tree + guard leaves) produces a pool an
+    order of magnitude past the toy instances (~40k original clauses,
+    a dozen-plus UNSAT verdicts) — the checker must stay sound and
+    cheap there, not just on unit CNFs."""
+    import bench
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+    from mythril_tpu.smt.drat import check_proof
+    from mythril_tpu.smt.solver import get_blast_context, reset_blast_context
+    from mythril_tpu.support.model import clear_model_cache
+    from mythril_tpu.support.support_args import args
+
+    prior = getattr(args, "proof_log", False)
+    args.proof_log = True
+    try:
+        _found, _row = bench._analyze_one(
+            "scale_cert", bench.scale_contract(depth=4), 1,
+            execution_timeout=60, max_depth=256,
+        )
+        assert "106" in _found
+        solver = get_blast_context().solver
+        assert not solver.proof_overflowed
+        stats = check_proof(solver.fetch_proof())
+        assert stats["orig"] > 10_000
+        assert stats["unsat_verdicts"] >= 5
+    finally:
+        args.proof_log = prior
+        reset_blast_context()
+        clear_model_cache()
+        for module in ModuleLoader().get_detection_modules():
+            module.reset_module()
+            module.cache.clear()
+
+
 def test_end_to_end_analysis_certifies():
     """Full pipeline under args.proof_log: analyze a real contract,
     then certify every UNSAT the run produced (this is the CI-tier
