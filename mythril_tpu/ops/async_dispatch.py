@@ -60,6 +60,10 @@ class AsyncDispatcher:
 
     def __init__(self):
         self.pending = None
+        # the worker of the last launch, tracked INDEPENDENTLY of
+        # pending: a dropped batch must still be joinable at exit, or
+        # finalization kills the thread mid-XLA (abort, exit 134)
+        self._live_thread = None
 
     # -- launch --------------------------------------------------------
 
@@ -70,6 +74,10 @@ class AsyncDispatcher:
         so even a first-per-bucket jit compile never blocks the host.
         Returns True when a batch went in flight."""
         if self.pending is not None:
+            return False
+        if self._live_thread is not None and self._live_thread.is_alive():
+            # a dropped batch's worker is still inside the device stack:
+            # never run two kernels' worth of prefetch concurrently
             return False
         began = time.monotonic()
         runner = backend.prepare_gather(ctx, rep_assumption_sets)
@@ -103,6 +111,8 @@ class AsyncDispatcher:
 
         thread = threading.Thread(target=work, daemon=True)
         thread.start()
+        self._live_thread = thread
+        _register_shutdown_join()
         self.pending = pending
         async_stats.launches += 1
         async_stats.launch_s += time.monotonic() - began
@@ -166,6 +176,33 @@ class AsyncDispatcher:
         if self.pending is not None:
             self.pending = None
             async_stats.dropped += 1
+
+
+_shutdown_join_registered = False
+
+
+def _register_shutdown_join() -> None:
+    """CPython finalization kills daemon threads at arbitrary points;
+    a worker torn down inside XLA's C++ aborts the whole process
+    (observed: exit 134, 'FATAL: exception not rethrown').  Join the
+    in-flight worker at exit — bounded, because it only blocks until
+    the launched kernel finishes; a wedged device falls through after
+    the timeout to the same teardown we'd have had anyway."""
+    global _shutdown_join_registered
+    if _shutdown_join_registered:
+        return
+    _shutdown_join_registered = True
+    import atexit
+
+    def join_pending():
+        dispatcher = _dispatcher
+        if dispatcher is None:
+            return
+        thread = dispatcher._live_thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=60.0)
+
+    atexit.register(join_pending)
 
 
 _dispatcher: Optional[AsyncDispatcher] = None

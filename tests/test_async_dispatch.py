@@ -82,6 +82,45 @@ def test_profit_skip_launches_and_harvest_decides_repeats(monkeypatch):
     assert len(ctx.unsat_memo) >= 1
 
 
+def test_harvested_models_feed_the_probe(monkeypatch):
+    """SAT lanes completed by the prefetched kernel must come back as
+    verified models in ``recent_models`` (the probe's fuel).  8-bit
+    multiplier guards: probe-resistant (so they reach the dispatch
+    path) but small enough for the gather DPLL to complete."""
+    import time as _time
+
+    from mythril_tpu.ops.async_dispatch import async_stats, get_async_dispatcher
+    from mythril_tpu.ops.batched_sat import batch_check_states, dispatch_stats
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setattr(args, "device_min_lanes", 2)
+    monkeypatch.setattr(args, "device_force_dispatch", False)
+    monkeypatch.setattr(args, "async_dispatch", True)
+    dispatch_stats.reset()
+    odd = symbol_factory.BitVecVal(0x2B, 8)
+    lanes = []
+    for i in range(6):
+        x = symbol_factory.BitVecSym(f"hm{i}", 8)
+        lanes.append(
+            [(x * odd) == symbol_factory.BitVecVal((0x34 + 37 * i) & 0xFF, 8)]
+        )
+    ctx = get_blast_context()
+    batch_check_states([Constraints(lane) for lane in lanes])
+    assert async_stats.launches == 1
+    dispatcher = get_async_dispatcher()
+    deadline = _time.monotonic() + 120
+    while dispatcher.pending and not dispatcher.pending["done"]:
+        assert _time.monotonic() < deadline
+        _time.sleep(0.05)
+    assert not dispatcher.pending.get("failed"), "async launch failed"
+    before = len(ctx.recent_models)
+    batch_check_states([Constraints(lane) for lane in lanes])
+    assert async_stats.harvested == 1
+    assert async_stats.models >= 1, "no device models verified"
+    # recent_models is truncated to 6 entries (_remember_model keep=6)
+    assert len(ctx.recent_models) >= min(before + 1, 6)
+
+
 def test_async_disabled_by_flag(monkeypatch):
     from mythril_tpu.ops.async_dispatch import async_stats
     from mythril_tpu.ops.batched_sat import batch_check_states, dispatch_stats
