@@ -97,6 +97,16 @@ class SolverStatistics:
                     f"{ds.undecided} to CDCL); "
                     f"host-probe SAT: {ds.host_probe_sat}"
                 )
+            from mythril_tpu.ops.async_dispatch import async_stats
+
+            if async_stats.launches:
+                base += (
+                    f"\nAsync prefetch: {async_stats.launches} launched, "
+                    f"{async_stats.harvested} harvested "
+                    f"({async_stats.unsat} refutations, "
+                    f"{async_stats.models} models), "
+                    f"{async_stats.dropped} dropped"
+                )
         except Exception:  # telemetry must never break reporting
             pass
         return base
