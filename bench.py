@@ -765,6 +765,16 @@ def main() -> None:
         summary["ablation_wall_s"] = {
             m: round(results[m][0], 2) for m in results
         }
+        if "nodevice" in results:
+            # nodevice disables the whole batched frontier path (shared
+            # probe memos included), not just accelerator dispatch — on
+            # a cpu-only/unhealthy host the full-vs-nodevice delta is a
+            # HOST-side batching win and must not be read as device work
+            summary["ablation_note"] = (
+                "nodevice = batched frontier path off entirely; "
+                "device contribution is attributable only via "
+                "device_s/dispatches with device_status=healthy"
+            )
     if t3_rows:
         summary["t3_wall_s"] = round(sum(r["wall_s"] for r in t3_rows), 2)
         summary["t3_rows"] = [
