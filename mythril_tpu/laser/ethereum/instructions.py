@@ -1001,7 +1001,25 @@ class Instruction:
     # memory / storage / flow
     # ------------------------------------------------------------------
 
-    @StateTransition()
+    @staticmethod
+    def _charge_memory_op(global_state, opcode: str, concrete: bool) -> None:
+        """Exact-when-concrete gas for MLOAD/MSTORE/MSTORE8: with a
+        concrete offset the expansion cost was already metered exactly by
+        mem_extend, so the op itself costs its flat 3 (keeping the
+        min==max interval tight — the GAS opcode concretizes only while
+        the interval is tight, see gas_); a symbolic offset falls back to
+        the table's bracketed upper bound."""
+        state = global_state.mstate
+        if concrete:
+            state.min_gas_used += 3
+            state.max_gas_used += 3
+        else:
+            min_gas, max_gas = get_opcode_gas(opcode)
+            state.min_gas_used += min_gas
+            state.max_gas_used += max_gas
+        StateTransition.check_gas_usage_limit(global_state)
+
+    @StateTransition(enable_gas=False)
     def mload_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
         offset = state.stack.pop()
@@ -1009,14 +1027,16 @@ class Instruction:
         try:
             concrete_offset = util.get_concrete_int(offset)
         except TypeError:
+            self._charge_memory_op(global_state, "MLOAD", concrete=False)
             state.stack.append(
                 global_state.new_bitvec(f"mload_{hash(offset)}", 256)
             )
             return [global_state]
+        self._charge_memory_op(global_state, "MLOAD", concrete=True)
         state.stack.append(state.memory.get_word_at(concrete_offset))
         return [global_state]
 
-    @StateTransition()
+    @StateTransition(enable_gas=False)
     def mstore_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
         mstart, value = state.stack.pop(), state.stack.pop()
@@ -1024,12 +1044,14 @@ class Instruction:
             state.mem_extend(mstart, 32)
             concrete_start = util.get_concrete_int(mstart)
         except TypeError:
+            self._charge_memory_op(global_state, "MSTORE", concrete=False)
             log.debug("MSTORE with symbolic offset not supported")
             return [global_state]
+        self._charge_memory_op(global_state, "MSTORE", concrete=True)
         state.memory.write_word_at(concrete_start, value)
         return [global_state]
 
-    @StateTransition()
+    @StateTransition(enable_gas=False)
     def mstore8_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
         offset, value = state.stack.pop(), state.stack.pop()
@@ -1037,8 +1059,10 @@ class Instruction:
             state.mem_extend(offset, 1)
             concrete_offset = util.get_concrete_int(offset)
         except TypeError:
+            self._charge_memory_op(global_state, "MSTORE8", concrete=False)
             log.debug("MSTORE8 with symbolic offset not supported")
             return [global_state]
+        self._charge_memory_op(global_state, "MSTORE8", concrete=True)
         try:
             value_to_write = util.get_concrete_int(value) % 256
         except TypeError:
@@ -1046,22 +1070,54 @@ class Instruction:
         state.memory[concrete_offset] = value_to_write
         return [global_state]
 
-    @StateTransition()
+    @StateTransition(enable_gas=False)
     def sload_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
         index = util.pop_bitvec(state)
+        # Under exact gas tracking the conformance vectors are
+        # frontier-era, where SLOAD costs 50 (it grew to 200/800/2100 in
+        # later forks); the min bound must not exceed the era's actual
+        # charge or the min<=used oracle fails.  Symbolic analyses keep
+        # the table's Istanbul-era constant.
+        from mythril_tpu.support.support_args import args as _args
+
+        min_gas, max_gas = get_opcode_gas("SLOAD")
+        if getattr(_args, "exact_gas_tracking", False):
+            min_gas = 50
+        state.min_gas_used += min_gas
+        state.max_gas_used += max_gas
+        StateTransition.check_gas_usage_limit(global_state)
         state.stack.append(
             global_state.environment.active_account.storage[index]
         )
         return [global_state]
 
-    @StateTransition(is_state_mutation_instruction=True)
+    @StateTransition(is_state_mutation_instruction=True, enable_gas=False)
     def sstore_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
         index, value = util.pop_bitvec(state), state.stack.pop()
-        global_state.environment.active_account.storage[index] = util.to_bitvec(
-            value
-        )
+        storage = global_state.environment.active_account.storage
+        new_value = util.to_bitvec(value)
+        # Exact-when-known minimum: a zero -> nonzero write costs at
+        # least SSTORE_SET (20000) in every fork from Frontier through
+        # Berlin, so when the old and new values are both concrete the
+        # 5000 table minimum is provably too low.  This is what makes
+        # the out-of-gas VMTests (sstore_load_2 and friends) terminate
+        # where the yellow paper says they must; the 25000 table maximum
+        # stays as the symbolic-case bracket.
+        min_gas, max_gas = get_opcode_gas("SSTORE")
+        if index.value is not None and new_value.value is not None:
+            old_value = storage[index]
+            if (
+                getattr(old_value, "value", None) is not None
+                and old_value.value == 0
+                and new_value.value != 0
+            ):
+                min_gas = 20000
+        state.min_gas_used += min_gas
+        state.max_gas_used += max_gas
+        StateTransition.check_gas_usage_limit(global_state)
+        storage[index] = new_value
         return [global_state]
 
     @StateTransition(increment_pc=False, enable_gas=False)
@@ -1168,7 +1224,30 @@ class Instruction:
 
     @StateTransition()
     def gas_(self, global_state: GlobalState) -> List[GlobalState]:
-        global_state.mstate.stack.append(global_state.new_bitvec("gas", 256))
+        # Under exact gas tracking (concolic conformance runs — see
+        # transaction/concolic.py) the remaining gas is exactly known
+        # whenever the min/max interval is still tight: push the
+        # concrete value (GAS itself costs 2, charged by the decorator
+        # after this handler).  Symbolic analyses keep the fresh symbol
+        # the reference pushes (evm_test gas0/gas1 are the consumers).
+        state = global_state.mstate
+        from mythril_tpu.support.support_args import args as _args
+
+        tx_gas_limit = global_state.current_transaction.gas_limit
+        if isinstance(tx_gas_limit, BitVec):
+            tx_gas_limit = tx_gas_limit.value
+        if (
+            getattr(_args, "exact_gas_tracking", False)
+            and state.min_gas_used == state.max_gas_used
+            and isinstance(tx_gas_limit, int)
+        ):
+            remaining = tx_gas_limit - state.min_gas_used - 2
+            if remaining >= 0:
+                state.stack.append(
+                    symbol_factory.BitVecVal(remaining, 256)
+                )
+                return [global_state]
+        state.stack.append(global_state.new_bitvec("gas", 256))
         return [global_state]
 
     @StateTransition(is_state_mutation_instruction=True)

@@ -115,9 +115,16 @@ class ConcreteCalldata(BaseCalldata):
         super().__init__(tx_id)
 
     def _load(self, item: Union[int, BitVec]) -> BitVec:
-        item = (
-            symbol_factory.BitVecVal(item, 256) if isinstance(item, int) else item
-        )
+        if isinstance(item, int):
+            # calldata offsets are naturals in the yellow paper (μs[1]+i
+            # does NOT wrap at 2^256): an out-of-range read is zero.
+            # Converting through BitVecVal first would truncate mod
+            # 2^256 and alias huge offsets back onto real data
+            # (calldatacopy_DataIndexTooHigh reads d[2^256-6 .. +249]
+            # and must see zeros, not a wrapped copy of the calldata).
+            if item >= (1 << 256) or item >= len(self._concrete_calldata):
+                return symbol_factory.BitVecVal(0, 8)
+            item = symbol_factory.BitVecVal(item, 256)
         return simplify(self._calldata[item])
 
     @property

@@ -34,6 +34,8 @@ def execute_message_call(
     the reference harness skips those tests
     (reference evm_test.py:33-60); with this hook they pass.
     """
+    from mythril_tpu.support.support_args import args as _args
+
     open_states = laser_evm.open_states[:]
     del laser_evm.open_states[:]
     for open_world_state in open_states:
@@ -53,7 +55,15 @@ def execute_message_call(
         _setup_global_state_for_execution(
             laser_evm, transaction, block_number=block_number
         )
-    return laser_evm.exec(track_gas=track_gas)
+    # exact-gas mode lets the GAS opcode concretize while the metering
+    # interval is tight (gas0/gas1 conformance); scoped with try/finally
+    # so a symbolic analysis later in the same process never sees it
+    prior = getattr(_args, "exact_gas_tracking", False)
+    _args.exact_gas_tracking = bool(track_gas)
+    try:
+        return laser_evm.exec(track_gas=track_gas)
+    finally:
+        _args.exact_gas_tracking = prior
 
 
 def _setup_global_state_for_execution(

@@ -11,6 +11,7 @@ from mythril_tpu.support.support_utils import Singleton
 class Args(object, metaclass=Singleton):
     def __init__(self):
         self.solver_timeout = 10000          # ms per query
+        self.exact_gas_tracking = False      # concolic conformance runs only
         self.sparse_pruning = False
         self.unconstrained_storage = False
         self.parallel_solving = False

@@ -39,19 +39,16 @@ TEST_TYPES = [
 ]
 
 # The reference harness skips 19 vectors (evm_test.py:33-60).  This
-# build passes 15 of them: the dynamic-jump family needed only a
+# build passes all of them: the dynamic-jump family needed only a
 # concrete block number (concolic execute_message_call grew a
 # block_number hook), loop_stacklimit_1020 needed the real 1024-item
-# stack limit (the reference stops at 1023), and log1MemExp needed LOG
-# to meter its memory expansion.  The remaining four need exact
-# frontier-era gas metering (our opcode table charges later-fork
-# constants, e.g. SLOAD 200 vs 50), which the min/max range model
-# deliberately brackets instead of reproducing per fork.
-SKIPPED_TEST_NAMES = {
-    "gas0", "gas1",                  # GAS pushes the exact remaining gas
-    "jumpTo1InstructionafterJump",   # out-of-gas only under exact SSTORE
-    "sstore_load_2",                 # out-of-gas only under exact SSTORE
-}
+# stack limit (the reference stops at 1023), log1MemExp needed LOG to
+# meter its memory expansion, gas0/gas1 needed the GAS opcode to
+# concretize while the exact-gas interval is tight (instructions.gas_),
+# and jumpTo1InstructionafterJump / sstore_load_2 needed the SSTORE_SET
+# minimum (20000 for a known zero->nonzero write — instructions.sstore_)
+# so the out-of-gas point lands where the yellow paper says.
+SKIPPED_TEST_NAMES: set = set()
 
 
 def load_test_data():
