@@ -72,6 +72,11 @@ def load() -> ctypes.CDLL:
         ]
         lib.cdcl_conflicts.argtypes = [ctypes.c_void_p]
         lib.cdcl_conflicts.restype = ctypes.c_int64
+        for name in ("cdcl_propagations", "cdcl_decisions", "cdcl_restarts",
+                     "cdcl_reduces", "cdcl_vivified_lits"):
+            fn = getattr(lib, name)
+            fn.argtypes = [ctypes.c_void_p]
+            fn.restype = ctypes.c_int64
         lib.cdcl_num_clauses.argtypes = [ctypes.c_void_p]
         lib.cdcl_num_clauses.restype = ctypes.c_int64
         lib.cdcl_learnt_clauses.argtypes = [
@@ -306,6 +311,26 @@ class SatSolver:
     @property
     def conflicts(self) -> int:
         return self._lib.cdcl_conflicts(self._handle)
+
+    @property
+    def propagations(self) -> int:
+        return self._lib.cdcl_propagations(self._handle)
+
+    @property
+    def decisions(self) -> int:
+        return self._lib.cdcl_decisions(self._handle)
+
+    @property
+    def restarts(self) -> int:
+        return self._lib.cdcl_restarts(self._handle)
+
+    @property
+    def reduces(self) -> int:
+        return self._lib.cdcl_reduces(self._handle)
+
+    @property
+    def vivified_lits(self) -> int:
+        return self._lib.cdcl_vivified_lits(self._handle)
 
     @property
     def num_clauses(self) -> int:

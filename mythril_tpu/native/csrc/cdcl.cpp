@@ -39,6 +39,18 @@ struct Clause {
   int32_t lbd = 0;  // glue level: distinct decision levels at learn time
   bool learned = false;
   bool deleted = false;
+  // learned-clause tier (CaDiCaL-style three-tier management):
+  //   0 = core  (lbd <= 2): kept forever — glue clauses connect few
+  //       search levels and keep paying propagation indefinitely.
+  //       Bounded: past kCoreCap immortal clauses, fresh glue lands in
+  //       tier2 instead (memory stays bounded on glue-heavy runs);
+  //   1 = tier2 (lbd <= 6): kept while used; a clause that sat out one
+  //       whole reduce round demotes to local (with one round's grace
+  //       before it becomes a deletion candidate);
+  //   2 = local: activity-sorted, weakest half deleted each reduce.
+  uint8_t tier = 2;
+  uint8_t used = 0;      // touched in conflict analysis since last reduce
+  uint8_t vivified = 0;  // already probed by vivify(): skip next rounds
   vector<Lit> lits;
 };
 
@@ -50,6 +62,19 @@ struct Watcher {
 class Solver {
  public:
   Solver() {
+    // Opt-in experiments, env-gated, DEFAULT OFF.  Round-5 bisection on
+    // batchtoken -t3 (docs/measurements_r5.md): each of these perturbs
+    // which model the solver returns, and the analysis pipeline's
+    // recent-model probe is so load-bearing that a ~20% probe hit-rate
+    // drop (444 -> 319 SAT probes) swamps any in-solver win.  The
+    // tiered clause DB + lazy reduce below are kept on: they preserve
+    // search dynamics and measured 458.9s -> 415.6s.
+    const char* e = getenv("MYTHRIL_CDCL_CONE_PROP");
+    cone_prop_ = e && e[0] == '1';
+    e = getenv("MYTHRIL_CDCL_VIVIFY");
+    vivify_enabled_ = e && e[0] == '1';
+    e = getenv("MYTHRIL_CDCL_ADAPTIVE_RESTART");
+    adaptive_restart_ = e && e[0] == '1';
     new_var();  // var 1 is the constant-true anchor used by the blaster
     vector<Lit> unit{1};
     add_clause(unit);
@@ -133,22 +158,42 @@ class Solver {
   // Incremental variant: the pool marks per-root cone var sets
   // directly (no union materialization — at deep-analysis scale the
   // sorted union vectors cost more than the whole CDCL search).
+  // Epoch-stamped: starting a new cone bumps the epoch instead of
+  // clearing the bitmap (O(1), not O(num_vars)).
   void relevant_begin() {
     restricted_ = true;
-    relevant_.assign(assigns_.size(), 0);
-    if (relevant_.size() > 1) relevant_[1] = 1;  // TRUE anchor
+    ++relevant_epoch_;
+    if (relevant_.size() < assigns_.size()) relevant_.resize(assigns_.size(), 0);
+    if (relevant_.size() > 1) relevant_[1] = relevant_epoch_;  // TRUE anchor
   }
   void relevant_mark(const int32_t* vars, int64_t n) {
     for (int64_t i = 0; i < n; ++i) {
       int32_t v = vars[i];
-      if (v > 0 && (size_t)v < relevant_.size()) relevant_[v] = 1;
+      if (v > 0 && (size_t)v < relevant_.size()) relevant_[v] = relevant_epoch_;
     }
+  }
+  bool is_relevant(Var v) const {
+    return (size_t)v < relevant_.size() && relevant_[v] == relevant_epoch_;
   }
 
   int solve(const Lit* assumps, int n_assumps, int64_t conflict_budget,
             double time_budget_s) {
     conflict_core_.clear();
     if (!ok_) { proof_event(5, nullptr, 0); return -1; }
+    // inprocessing on a conflict cadence: strengthening runs at level 0,
+    // so it forfeits this call's assumption-prefix reuse — acceptable
+    // every ~20k conflicts (a query stack that hot repeats few prefixes)
+    if (vivify_enabled_ && total_conflicts_ >= next_viv_at_ && !learnts_.empty()) {
+      cancelUntil(0);
+      prev_assumptions_.clear();
+      // vivification derives GLOBAL strengthenings: run unrestricted
+      bool was_restricted = restricted_;
+      restricted_ = false;
+      vivify();
+      restricted_ = was_restricted;
+      next_viv_at_ = total_conflicts_ + kVivInterval;
+      if (!ok_) { proof_event(5, nullptr, 0); return -1; }
+    }
     // Assumption-prefix trail reuse: queries arrive as incrementally
     // growing path-constraint sets, so consecutive calls usually share
     // a long assumption prefix.  Decision level i+1 always holds
@@ -171,7 +216,11 @@ class Solver {
     int restart = 0;
     int status = 0;
     while (status == 0) {
-      int64_t luby_len = 100 * luby(restart++);
+      // Luby restarts drive the search by default; when the env-gated
+      // adaptive (glucose) policy is on it fires first and Luby becomes
+      // a slow backstop
+      int64_t luby_len =
+          (adaptive_restart_ ? 1024 : 100) * luby(restart++);
       status = search(luby_len);
       if (budget_conflicts_ >= 0 && conflicts_this_call_ >= budget_conflicts_)
         { if (status == 0) break; }
@@ -212,6 +261,11 @@ class Solver {
   int64_t conflicts() const { return total_conflicts_; }
   int64_t num_clauses() const { return (int64_t)clauses_.size(); }
   int32_t num_vars() const { return (int32_t)assigns_.size() - 1; }
+  int64_t propagations() const { return propagations_; }
+  int64_t decisions() const { return decisions_; }
+  int64_t restarts() const { return restarts_; }
+  int64_t reduces() const { return reduces_; }
+  int64_t vivified_lits() const { return vivified_lits_; }
 
   // ---- proof logging (wrong-UNSAT defense, SURVEY §4) ----
   //
@@ -287,18 +341,44 @@ class Solver {
   vector<int> heap_pos_;
   vector<Lit> assumptions_;
   vector<Lit> prev_assumptions_;  // for assumption-prefix trail reuse
-  vector<uint8_t> relevant_;      // decision restriction (see set_relevant)
+  // decision restriction (see set_relevant): epoch-stamped so installing
+  // a new cone is O(cone), not O(num_vars) — at deep-analysis scale the
+  // per-query memset over millions of vars costs more than small solves
+  vector<int64_t> relevant_;
+  int64_t relevant_epoch_ = 0;
   bool restricted_ = false;
+  bool cone_prop_ = true;
+  bool vivify_enabled_ = true;
+  bool adaptive_restart_ = true;
   vector<Var> stash_;             // irrelevant vars parked during a solve
   vector<Lit> conflict_core_;
   vector<int8_t> model_;
   int64_t budget_conflicts_ = -1;
   int64_t conflicts_this_call_ = 0;
   int64_t total_conflicts_ = 0;
+  int64_t propagations_ = 0;
+  int64_t decisions_ = 0;
+  int64_t restarts_ = 0;
+  int64_t reduces_ = 0;
+  int64_t vivified_lits_ = 0;
   double deadline_ = -1.0;
-  int64_t max_learned_ = 8192;
+  int64_t max_local_ = 8192;      // local-tier budget (see reduceDB)
+  vector<int> learnts_;           // indices of tier1/tier2 learned clauses
+  // glucose-style adaptive restarts: restart when the recent learnt-LBD
+  // EMA runs above the long-run EMA (search is thrashing), blocked when
+  // the trail is much deeper than usual (likely closing in on SAT)
+  double lbd_ema_fast_ = 0.0;
+  double lbd_ema_slow_ = 0.0;
+  double trail_ema_ = 0.0;
+  int64_t conflicts_since_restart_ = 0;
   vector<int64_t> lbd_stamp_;
   int64_t lbd_stamp_counter_ = 0;
+  int64_t next_reduce_at_ = kReduceInterval;
+  static constexpr int64_t kReduceInterval = 4096;
+  int64_t next_viv_at_ = kVivInterval;
+  static constexpr int64_t kVivInterval = 20000;
+  int64_t core_count_ = 0;
+  static constexpr int64_t kCoreCap = 65536;
   bool proof_enabled_ = false;
   bool proof_overflow_ = false;
   vector<int32_t> proof_;
@@ -319,6 +399,23 @@ class Solver {
     struct timespec ts;
     clock_gettime(CLOCK_MONOTONIC, &ts);
     return ts.tv_sec + 1e-9 * ts.tv_nsec;
+  }
+
+  // Glucose-style adaptive restart: fire when the recent learnt-LBD
+  // EMA runs well above the long-run average (the current search
+  // region is producing weak clauses), blocked while the trail is much
+  // deeper than usual (deep consistent trails suggest an imminent SAT
+  // answer a restart would throw away).
+  bool restart_now(int32_t /*learnt_lbd*/) const {
+    if (!adaptive_restart_) return false;
+    if (conflicts_since_restart_ < 64) return false;
+    if (lbd_ema_fast_ * 0.8 <= lbd_ema_slow_) return false;
+    // trail blocker only once its EMA has warmed up — cold (near-zero)
+    // trail_ema_ would otherwise block every restart for the first few
+    // thousand conflicts, inverting the policy
+    if (total_conflicts_ > 4096 &&
+        (double)trail_.size() > 1.4 * trail_ema_) return false;  // blocked
+    return true;
   }
 
   static int64_t luby(int x) {
@@ -399,7 +496,7 @@ class Solver {
 
   int attach(const vector<Lit>& lits, bool learned) {
     int idx = (int)clauses_.size();
-    clauses_.push_back(Clause{(float)cla_inc_, 0, learned, false, lits});
+    clauses_.push_back(Clause{(float)cla_inc_, 0, learned, false, 2, 0, 0, lits});
     attach_watchers(idx, clauses_[idx].lits);
     return idx;
   }
@@ -416,13 +513,26 @@ class Solver {
   int propagate() {
     while (qhead_ < trail_.size()) {
       Lit p = trail_[qhead_++];
+      ++propagations_;
       // binary implications first: p true forces w.blocker for every
       // entry; no watch moving, no Clause access
       auto& bws = bin_watches_[lit_index(p)];
       for (const Watcher& w : bws) {
         int v = value(w.blocker);
         if (v == -1) return w.clause;  // conflict
-        if (v == 0) uncheckedEnqueue(w.blocker, w.clause);
+        if (v == 0) {
+          // cone-restricted propagation: an implication into a variable
+          // outside the query's cone is skipped, so cascades die at the
+          // cone boundary instead of flooding the shared pool's entire
+          // downstream circuit.  Soundness mirrors the decision
+          // restriction (see set_relevant): the skipped variable stays
+          // unassigned for the whole query, so its clauses can never be
+          // fully falsified — no conflict can be missed, and the
+          // definitional-completion argument for early SAT still holds.
+          if (cone_prop_ && restricted_ && !is_relevant(std::abs(w.blocker)))
+            continue;
+          uncheckedEnqueue(w.blocker, w.clause);
+        }
       }
       auto& ws = watches_[lit_index(p)];
       size_t i = 0, j = 0;
@@ -450,6 +560,16 @@ class Solver {
           while (i < ws.size()) ws[j++] = ws[i++];
           ws.resize(j);
           return w.clause;
+        }
+        // cone-restricted propagation (see the binary path above): a
+        // unit implication into an out-of-cone variable stays dormant.
+        // The watcher is kept; if the variable is ever falsified later
+        // (a different query's cone) the normal watch machinery still
+        // sees it, so conflicts cannot be missed.
+        if (cone_prop_ && restricted_ && !is_relevant(std::abs(first))) {
+          ws[j++] = {w.clause, first};
+          ++i;
+          continue;
         }
         uncheckedEnqueue(first, w.clause);
         ws[j++] = {w.clause, first};
@@ -493,7 +613,25 @@ class Solver {
     int c = confl;
     do {
       Clause& cl = clauses_[c];
-      if (cl.learned) cla_bump(c);
+      if (cl.learned) {
+        cla_bump(c);
+        cl.used = 1;
+        // LBD refresh on use (glucose): a clause whose literals now sit
+        // on fewer distinct levels than at learn time has become
+        // stronger — keep the lower value and promote across tiers
+        if (cl.lbd > 2 && cl.lits.size() > 2) {
+          int32_t fresh = clause_lbd(cl.lits);
+          if (fresh < cl.lbd) {
+            cl.lbd = fresh;
+            if (fresh <= 2 && core_count_ < kCoreCap) {
+              cl.tier = 0;  // now core: kept forever (bounded by cap)
+              ++core_count_;
+            } else if (fresh <= 6 && cl.tier == 2) {
+              cl.tier = 1;
+            }
+          }
+        }
+      }
       for (size_t k = 0; k < cl.lits.size(); ++k) {
         Lit q = cl.lits[k];
         // skip the implied literal by identity, not position: binary
@@ -591,48 +729,189 @@ class Solver {
     return distinct;
   }
 
+  // A clause is locked while it is the reason of its asserting literal.
+  // Propagation always enqueues lits[0] with the clause as reason (the
+  // watch code swaps the implied literal into slot 0 for >2-lit
+  // clauses), so the check is O(1) — no O(pool) locked bitmap.
+  bool is_locked(int ci) const {
+    const Clause& c = clauses_[ci];
+    if (c.lits.empty()) return false;
+    Var v = std::abs(c.lits[0]);
+    return assigns_[v] != 0 && reason_[v] == ci;
+  }
+
+  void delete_clause(int ci) {
+    Clause& c = clauses_[ci];
+    c.deleted = true;
+    proof_event(2, c.lits.data(), c.lits.size());
+    c.lits.clear();
+    c.lits.shrink_to_fit();
+  }
+
+  // Tiered reduction (CaDiCaL-style): core (lbd <= 2) is never touched,
+  // tier2 clauses unused for two consecutive reduce rounds demote to
+  // local, and the weakest (lbd, activity) half of local dies.  Deleted
+  // clauses are purged from watch lists lazily during propagation — the
+  // old full watch rebuild was an O(pool) scan per reduce, which at the
+  // 4.6M-clause pools of -t3 analyses dwarfed the search it served.
   void reduceDB() {
-    vector<int> learned_idx;
-    for (int i = 0; i < (int)clauses_.size(); ++i)
-      if (clauses_[i].learned && !clauses_[i].deleted &&
-          clauses_[i].lits.size() > 2)
-        learned_idx.push_back(i);
-    if ((int64_t)learned_idx.size() < max_learned_) return;
-    // delete the weakest half, glue clauses (lbd <= 2) last: they
-    // connect few search levels and keep paying propagation long after
-    // their activity decays — but the trigger counts EVERYTHING, so a
-    // glue-heavy workload still has bounded memory (glue dies too once
-    // it fills more than half the budget)
-    std::sort(learned_idx.begin(), learned_idx.end(), [&](int a, int b) {
-      bool glue_a = clauses_[a].lbd <= 2, glue_b = clauses_[b].lbd <= 2;
-      if (glue_a != glue_b) return glue_b;  // non-glue first
+    ++reduces_;
+    vector<int> local_idx;
+    size_t keep = 0;
+    for (int ci : learnts_) {
+      Clause& c = clauses_[ci];
+      if (c.deleted) continue;   // compact out
+      if (c.tier == 0) continue; // promoted to core: leaves the pool
+      if (c.tier == 1) {
+        if (!c.used) {
+          // demoted after a full unused round, with one more round of
+          // grace before it can be killed (not a candidate this round)
+          c.tier = 2;
+          learnts_[keep++] = ci;
+          continue;
+        }
+        c.used = 0;
+        learnts_[keep++] = ci;
+        continue;
+      }
+      c.used = 0;
+      local_idx.push_back(ci);
+      learnts_[keep++] = ci;
+    }
+    learnts_.resize(keep);
+    if ((int64_t)local_idx.size() < max_local_) return;
+    std::sort(local_idx.begin(), local_idx.end(), [&](int a, int b) {
       if (clauses_[a].lbd != clauses_[b].lbd)
         return clauses_[a].lbd > clauses_[b].lbd;
       return clauses_[a].activity < clauses_[b].activity;
     });
-    vector<int8_t> locked(clauses_.size(), 0);
-    for (Lit l : trail_) {
-      int r = reason_[std::abs(l)];
-      if (r != -1) locked[r] = 1;
-    }
-    size_t kill = learned_idx.size() / 2;
+    size_t kill = local_idx.size() / 2;
+    size_t killed = 0;
     for (size_t i = 0; i < kill; ++i) {
-      int ci = learned_idx[i];
-      if (locked[ci]) continue;
-      clauses_[ci].deleted = true;
-      proof_event(2, clauses_[ci].lits.data(), clauses_[ci].lits.size());
+      int ci = local_idx[i];
+      if (is_locked(ci)) continue;
+      delete_clause(ci);
+      ++killed;
+    }
+    if (killed) {
+      keep = 0;
+      for (int ci : learnts_)
+        if (!clauses_[ci].deleted) learnts_[keep++] = ci;
+      learnts_.resize(keep);
+    }
+    max_local_ += max_local_ / 20;
+  }
+
+  // Clause vivification (inprocessing): for a learned clause
+  // (l1 ∨ … ∨ lk), assert ¬l1, ¬l2, … one decision level at a time and
+  // propagate.  A conflict after i decisions proves (l1 ∨ … ∨ li) — a
+  // strict strengthening; a literal already false under the prefix is
+  // redundant and drops; a literal already true ends the clause there.
+  // Every result (even an unchanged clause) is re-attached as a FRESH
+  // clause and the original deleted: the original's watchers may have
+  // been lazily dropped while it was masked during the probe, and
+  // re-attaching fresh is the only state that cannot leave a clause
+  // silently unwatched.  Proof order: LEARN new (RUP — it was derived
+  // by unit propagation over the live DB), then DELETE old.
+  // Precondition: decision level 0, propagation at fixpoint.
+  void vivify() {
+    int64_t prop_budget = 3000000;
+    int64_t scanned = 0;
+    size_t bound = learnts_.size();  // snapshot: re-attached copies are
+                                     // appended and must not be re-walked
+    for (size_t i = 0; i < bound && prop_budget > 0 && scanned < 4000; ++i) {
+      int ci = learnts_[i];
+      if (clauses_[ci].deleted || clauses_[ci].vivified) continue;
+      if (clauses_[ci].lits.size() < 3 || clauses_[ci].lits.size() > 32)
+        continue;
+      if (is_locked(ci)) continue;
+      ++scanned;
+      vector<Lit> lits = clauses_[ci].lits;  // copy: attach may realloc
+      clauses_[ci].deleted = true;  // mask from its own derivation
+      vector<Lit> kept;
+      bool satisfied = false, conflicted = false;
+      for (size_t li = 0; li < lits.size(); ++li) {
+        Lit l = lits[li];
+        int v = value(l);
+        if (v == 1) { kept.push_back(l); satisfied = true; break; }
+        if (v == -1) continue;  // ¬prefix ⊨ ¬l: drop
+        kept.push_back(l);
+        trail_lim_.push_back((int)trail_.size());
+        uncheckedEnqueue(-l, -1);
+        int64_t before = propagations_;
+        int confl = propagate();
+        prop_budget -= (propagations_ - before);
+        if (confl != -1) { conflicted = true; break; }
+        if (prop_budget <= 0) {
+          // out of budget mid-clause: the unexamined tail has NOT been
+          // proven redundant — keep it verbatim (v==-1 drops above
+          // remain sound on their own)
+          kept.insert(kept.end(), lits.begin() + li + 1, lits.end());
+          break;
+        }
+      }
+      cancelUntil(0);
+      if (satisfied && kept.size() == 1 && value(kept[0]) == 1 &&
+          level_of(kept[0]) == 0) {
+        // satisfied at level 0 forever: drop the clause outright
+        proof_event(2, lits.data(), lits.size());
+        clauses_[ci].lits.clear();
+        clauses_[ci].lits.shrink_to_fit();
+        vivified_lits_ += (int64_t)lits.size();
+        continue;
+      }
+      if (!conflicted && !satisfied && kept.size() == lits.size()) {
+        // walked off the end (or out of budget) with nothing learned:
+        // re-attach an identical fresh copy (see comment above)
+        clauses_[ci].deleted = false;
+        int fresh = attach(lits, true);
+        Clause& fc = clauses_[fresh];
+        fc.lbd = clauses_[ci].lbd;
+        fc.tier = clauses_[ci].tier;
+        fc.vivified = 1;
+        if (fc.tier > 0) learnts_.push_back(fresh);
+        clauses_[ci].deleted = true;
+        clauses_[ci].lits.clear();
+        clauses_[ci].lits.shrink_to_fit();
+        continue;
+      }
+      vivified_lits_ += (int64_t)(lits.size() - kept.size());
+      proof_event(1, kept.data(), kept.size());
+      if (kept.size() == 1) {
+        clauses_[ci].deleted = false;  // keep live for the unit's RUP
+        if (value(kept[0]) == 0) {
+          uncheckedEnqueue(kept[0], -1);
+          if (propagate() != -1) ok_ = false;
+        } else if (value(kept[0]) == -1) {
+          ok_ = false;
+        }
+        clauses_[ci].deleted = true;
+        proof_event(2, lits.data(), lits.size());
+        clauses_[ci].lits.clear();
+        clauses_[ci].lits.shrink_to_fit();
+        if (!ok_) return;
+        continue;
+      }
+      int fresh = attach(kept, true);
+      Clause& fc = clauses_[fresh];
+      int32_t lbd = clauses_[ci].lbd;
+      fc.lbd = std::min<int32_t>(lbd, (int32_t)kept.size() - 1);
+      fc.vivified = 1;
+      if (kept.size() > 2) {
+        if (fc.lbd <= 2 && core_count_ < kCoreCap) {
+          fc.tier = 0;
+          ++core_count_;
+        } else {
+          fc.tier = fc.lbd <= 6 ? 1 : 2;
+        }
+        if (fc.tier > 0) learnts_.push_back(fresh);
+      } else {
+        fc.tier = 0;  // binary: permanent (binary watches skip `deleted`)
+      }
+      proof_event(2, lits.data(), lits.size());
       clauses_[ci].lits.clear();
       clauses_[ci].lits.shrink_to_fit();
     }
-    // rebuild watches
-    for (auto& ws : watches_) ws.clear();
-    for (auto& ws : bin_watches_) ws.clear();
-    for (int i = 0; i < (int)clauses_.size(); ++i) {
-      Clause& c = clauses_[i];
-      if (c.deleted || c.lits.empty()) continue;
-      attach_watchers(i, c.lits);
-    }
-    max_learned_ += max_learned_ / 10;
   }
 
   // returns 1 sat / -1 unsat / 0 keep going (restart or budget)
@@ -643,6 +922,7 @@ class Solver {
       int confl = propagate();
       if (confl != -1) {
         ++local_conflicts; ++conflicts_this_call_; ++total_conflicts_;
+        ++conflicts_since_restart_;
         if (decision_level() == 0) { ok_ = false; return -1; }
         if (decision_level() <= (int)assumptions_.size()) {
           // Conflict with only assumption decisions on the trail: the
@@ -666,6 +946,11 @@ class Solver {
         // LBD must be measured BEFORE the backjump: cancelUntil clears
         // assignments but leaves stale level_ entries behind
         int32_t learnt_lbd = clause_lbd(learnt);
+        // adaptive-restart signals (glucose): recent-vs-long-run learnt
+        // LBD, and the trail depth at conflict time for the SAT blocker
+        lbd_ema_fast_ += (1.0 / 32.0) * ((double)learnt_lbd - lbd_ema_fast_);
+        lbd_ema_slow_ += (1.0 / 8192.0) * ((double)learnt_lbd - lbd_ema_slow_);
+        trail_ema_ += (1.0 / 4096.0) * ((double)trail_.size() - trail_ema_);
         proof_event(1, learnt.data(), learnt.size());
         cancelUntil(std::max(back_level, 0));
         if (learnt.size() == 1) {
@@ -682,21 +967,42 @@ class Solver {
           }
         } else {
           int ci = attach(learnt, true);
-          clauses_[ci].lbd = learnt_lbd;
+          Clause& lc = clauses_[ci];
+          lc.lbd = learnt_lbd;
+          // tier at learn time; binary learnts stay out of learnts_ —
+          // the binary-watch fast path never checks `deleted`, so
+          // binary clauses must be permanent (they are glue anyway)
+          if (learnt.size() > 2) {
+            if (learnt_lbd <= 2 && core_count_ < kCoreCap) {
+              lc.tier = 0;
+              ++core_count_;
+            } else {
+              lc.tier = learnt_lbd <= 6 ? 1 : 2;
+            }
+            if (lc.tier > 0) learnts_.push_back(ci);
+          } else {
+            lc.tier = 0;  // binary: permanent regardless (watch scheme)
+          }
           uncheckedEnqueue(learnt[0], ci);
         }
         var_decay();
         cla_inc_ *= 1.001;
-        if (conflicts_this_call_ % 4096 == 0) reduceDB();
+        if (total_conflicts_ >= next_reduce_at_) {
+          reduceDB();
+          next_reduce_at_ = total_conflicts_ + kReduceInterval;
+        }
         if (budget_conflicts_ >= 0 && conflicts_this_call_ >= budget_conflicts_)
           return 0;
         if (deadline_ > 0 && (conflicts_this_call_ & 255) == 0 &&
             now() > deadline_)
           return 0;
-        if (local_conflicts >= conflicts_allowed) {
+        if (local_conflicts >= conflicts_allowed ||
+            restart_now(learnt_lbd)) {
           // restart: undo search decisions but keep the assumption
           // levels — re-propagating a large assumption cone on every
           // restart dwarfs the restart's benefit
+          ++restarts_;
+          conflicts_since_restart_ = 0;
           cancelUntil(std::min(decision_level(),
                                (int)assumptions_.size()));
           return 0;  // restart
@@ -718,12 +1024,12 @@ class Solver {
           continue;
         }
         // normal decision (restricted to the assumption cone when set)
+        ++decisions_;
         Var next = 0;
         while (!heap_.empty()) {
           Var cand = heap_pop();
           if (assigns_[cand] != 0) continue;
-          if (restricted_ &&
-              ((size_t)cand >= relevant_.size() || !relevant_[cand])) {
+          if (restricted_ && !is_relevant(cand)) {
             stash_.push_back(cand);
             continue;
           }
@@ -788,6 +1094,11 @@ int32_t cdcl_model_value(void* s, int32_t var) {
   return ((Solver*)s)->model_value(var);
 }
 int64_t cdcl_conflicts(void* s) { return ((Solver*)s)->conflicts(); }
+int64_t cdcl_propagations(void* s) { return ((Solver*)s)->propagations(); }
+int64_t cdcl_decisions(void* s) { return ((Solver*)s)->decisions(); }
+int64_t cdcl_restarts(void* s) { return ((Solver*)s)->restarts(); }
+int64_t cdcl_reduces(void* s) { return ((Solver*)s)->reduces(); }
+int64_t cdcl_vivified_lits(void* s) { return ((Solver*)s)->vivified_lits(); }
 int64_t cdcl_num_clauses(void* s) { return ((Solver*)s)->num_clauses(); }
 int32_t cdcl_num_vars(void* s) { return ((Solver*)s)->num_vars(); }
 int64_t cdcl_learnt_clauses(void* s, int32_t max_width, int64_t from,

@@ -1,0 +1,71 @@
+"""Profiling harness for the -t3 depth rows (CDCL iteration loop).
+
+Runs one contract at transaction depth 3 with NO execution cap and
+prints the wall, the solver split, native-CDCL counters, and (with
+MYTHRIL_CONE_HISTO=1) the per-query cone-size histogram.
+
+Usage:  JAX_PLATFORMS=cpu python scripts/profile_t3.py [ether_send|overflow|batchtoken]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def main() -> None:
+    import logging
+
+    logging.basicConfig(level=logging.CRITICAL)
+    logging.disable(logging.CRITICAL)
+
+    which = sys.argv[1] if len(sys.argv) > 1 else "batchtoken"
+    timeout = int(sys.argv[2]) if len(sys.argv) > 2 else 3600
+
+    if which == "batchtoken":
+        code = bench.batchtoken_contract()
+        expected = {"101"}
+    else:
+        path = os.path.join(bench.REFERENCE_INPUTS, f"{which}.sol.o")
+        code = open(path).read().strip()
+        expected = {"101", "105"} if which == "ether_send" else {"101"}
+
+    from mythril_tpu.support.support_args import args
+
+    for key, value in bench.MODES["full"].items():
+        setattr(args, key, value)
+
+    bench.DEVICE_STATUS = "cpu-only"
+    t0 = time.time()
+    found, row = bench._analyze_one(
+        f"{which}_t3", code, 3, execution_timeout=timeout, max_depth=128
+    )
+    row["total_wall_s"] = round(time.time() - t0, 2)
+    row["expected_ok"] = bool(expected & found)
+
+    from mythril_tpu.smt.solver import get_blast_context
+
+    ctx = get_blast_context()
+    solver = ctx.solver
+    row["cdcl_conflicts"] = solver.conflicts
+    row["pool_clauses"] = ctx.clause_count
+    try:
+        row["cdcl_propagations"] = solver.propagations
+        row["cdcl_decisions"] = solver.decisions
+        row["cdcl_restarts"] = solver.restarts
+        row["cdcl_reduces"] = solver.reduces
+        row["cdcl_vivified"] = solver.vivified_lits
+    except AttributeError:
+        pass
+    histo = getattr(ctx, "cone_histogram", None)
+    if histo:
+        row["cone_histogram"] = histo
+    print(json.dumps(row, indent=1))
+
+
+if __name__ == "__main__":
+    main()
