@@ -122,7 +122,7 @@ class ConcreteCalldata(BaseCalldata):
             # 2^256 and alias huge offsets back onto real data
             # (calldatacopy_DataIndexTooHigh reads d[2^256-6 .. +249]
             # and must see zeros, not a wrapped copy of the calldata).
-            if item >= (1 << 256) or item >= len(self._concrete_calldata):
+            if item >= len(self._concrete_calldata):
                 return symbol_factory.BitVecVal(0, 8)
             item = symbol_factory.BitVecVal(item, 256)
         return simplify(self._calldata[item])

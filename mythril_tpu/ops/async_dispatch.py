@@ -149,12 +149,29 @@ class AsyncDispatcher:
         assign = np.asarray(pending["assign"])
         from mythril_tpu.ops.batched_sat import _env_from_assignment
 
+        from mythril_tpu.support.support_args import args as _args
+
+        proof_log = getattr(_args, "proof_log", False)
         for lane, node_set in enumerate(pending["node_sets"]):
             if status[lane] == 2:
+                if proof_log:
+                    # the memo/nogood channel ships UNSAT verdicts that
+                    # later queries consume WITHOUT a fresh solve, so a
+                    # certificate must exist first: a small host solve
+                    # records the ASSUMPTION_CONFLICT event (this is an
+                    # opportunistic prefetch — an unconfirmed lane is
+                    # simply dropped, never decided)
+                    if not ctx.confirm_unsat(
+                        pending["assumption_sets"][lane],
+                        conflict_budget=1000,
+                    ):
+                        continue
                 # sound UNSAT: permanent memo + pool nogood, so the
                 # CDCL and later dispatches inherit the refutation
                 ctx.note_unsat(node_set)
-                ctx.learn_nogood(pending["assumption_sets"][lane])
+                ctx.learn_nogood(
+                    pending["assumption_sets"][lane], certified=proof_log
+                )
                 async_stats.unsat += 1
             elif status[lane] == 1:
                 env = _env_from_assignment(ctx, assign[lane])

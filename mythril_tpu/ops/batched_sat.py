@@ -725,13 +725,16 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
             dispatch_stats.host_probe_sat += 1
     stats.probe_s += time.monotonic() - probe_began
 
-    if getattr(args, "proof_log", False):
-        # --proof-log certifies every UNSAT verdict by replaying the
-        # CDCL's proof stream; device-kernel refutations have no such
-        # certificate, so the run stays CPU-pure (same reasoning as the
-        # learn_nogood guard in smt/bitblast.py) — a wrong device UNSAT
-        # must not hide behind a "proof check passed" line
-        return decided
+    proof_log = getattr(args, "proof_log", False)
+    # --proof-log no longer disables the accelerator (VERDICT r4 #6):
+    # device SAT lanes were always certificate-clean (the model is
+    # verified by term evaluation before it decides anything), and
+    # device UNSAT lanes are now host-confirmed by a bounded CDCL solve
+    # of the same cube BEFORE they decide a state — the confirming
+    # solve records the ASSUMPTION_CONFLICT proof event that makes the
+    # verdict independently checkable (smt/drat.py).  A wrong device
+    # UNSAT cannot ship: it would fail confirmation and leave the lane
+    # to the authoritative CDCL tail.
 
     open_indices = [i for i, d in enumerate(decided) if d is None]
     if len(open_indices) < effective_min_lanes():
@@ -843,6 +846,7 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
 
     counted_lanes = set()  # per-verdict counters tally device lanes,
     # not original states (several states can share one deduped lane)
+    lane_confirmations: Dict[int, bool] = {}  # proof-log: lane -> certified
     device_decided = 0  # lanes THIS dispatch decided (fuse accounting)
     for pos, i in enumerate(open_indices):
         lane = lane_of[pos]
@@ -850,6 +854,19 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         counted_lanes.add(lane)
         verdict = verdicts[lane]
         if verdict is False:
+            if proof_log:
+                # certify before deciding: one bounded host solve per
+                # deduped lane; its UNSAT answer records the proof
+                # event (see BlastContext.confirm_unsat)
+                confirmed = lane_confirmations.get(lane)
+                if confirmed is None:
+                    confirmed = ctx.confirm_unsat(
+                        assumption_sets[rep_indices[lane]]
+                    )
+                    lane_confirmations[lane] = confirmed
+                if not confirmed:
+                    decided[i] = None  # tail re-solves with full budget
+                    continue
             decided[i] = False
             # device UNSAT is permanent (the pool only gains implied
             # clauses): memoize the verdict and learn the assumption
@@ -857,7 +874,10 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
             # refutation — the cross-dispatch learning channel
             ctx.note_unsat(node_sets[i])
             if first_for_lane:
-                ctx.learn_nogood(assumption_sets[rep_indices[lane]])
+                ctx.learn_nogood(
+                    assumption_sets[rep_indices[lane]],
+                    certified=proof_log,
+                )
                 dispatch_stats.unsat += 1
                 device_decided += 1
             continue

@@ -163,6 +163,14 @@ class BlastContext:
         # a node-id cache for "contains a read/UF" nesting checks
         self._reads_matrix_cache = None
         self._theory_node_cache: Dict[int, bool] = {}
+        # cone-size telemetry (VERDICT r4 #4): with MYTHRIL_CONE_HISTO=1
+        # every CDCL-reaching query also records its cone's clause/var
+        # counts, bucketed by power of two — the measurement that decides
+        # whether the device path is addressable at -t3 depths
+        import os as _os
+
+        self.cone_histo_enabled = bool(_os.environ.get("MYTHRIL_CONE_HISTO"))
+        self.cone_histogram: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
     # pool facade (the store itself is native; see csrc/pool.cpp)
@@ -228,7 +236,9 @@ class BlastContext:
                 del self.unsat_memo[stale]
         self.unsat_memo[key] = True
 
-    def learn_nogood(self, assumption_lits: Sequence[int]) -> None:
+    def learn_nogood(
+        self, assumption_lits: Sequence[int], certified: bool = False
+    ) -> None:
         """Record a device-refuted assumption set as a pool clause.
 
         If ``pool ∧ a1 ∧ … ∧ ak`` is UNSAT (proved by the device DPLL),
@@ -242,14 +252,37 @@ class BlastContext:
         clause for the cone subset-append."""
         from mythril_tpu.support.support_args import args as _args
 
-        if getattr(_args, "proof_log", False):
-            # a device refutation is not replayable by the proof
-            # checker's unit propagation; absorbing it would plant an
-            # unverifiable axiom under later certified verdicts.  The
-            # nogood is an optimization only — skip it and keep the
-            # proof airtight.
+        if getattr(_args, "proof_log", False) and not certified:
+            # an unconfirmed device refutation is not replayable by the
+            # proof checker's unit propagation; absorbing it would plant
+            # an unverifiable axiom under later certified verdicts.
+            # ``certified=True`` callers (ops/batched_sat.py) confirm
+            # the cube with a host CDCL solve FIRST, so the recorded
+            # stream carries the ASSUMPTION_CONFLICT event that makes
+            # the nogood's content independently checkable.
             return
         self.pool.nogood(list(assumption_lits))
+
+    def confirm_unsat(
+        self, assumption_lits: Sequence[int], conflict_budget: int = 4000
+    ) -> bool:
+        """Host-confirm a device refutation under ``--proof-log``: a
+        bounded native CDCL solve of the same assumption cube.  On
+        UNSAT the solver records its own ASSUMPTION_CONFLICT proof
+        event, giving the device verdict an independently checkable
+        certificate (smt/drat.py replays it); anything else (SAT —
+        which would mean a device soundness bug — or budget out)
+        returns False and the caller must leave the lane undecided.
+        Device-refuted cubes usually re-refute far below the budget:
+        the pool already contains every clause the device saw."""
+        try:
+            self.pool.relevant_cone(list(assumption_lits))
+        except Exception:  # noqa: BLE001 — optimization only
+            self.solver.set_relevant([])
+        status = self.solver.solve(
+            list(assumption_lits), conflict_budget=conflict_budget
+        )
+        return status == SatSolver.UNSAT
 
     def new_lit(self) -> int:
         return self.pool.new_var()
@@ -606,6 +639,20 @@ class BlastContext:
         # restrict CDCL decisions to the query's cone: against a large
         # shared pool, VSIDS otherwise wanders into foreign gates and
         # pays full-pool propagation per irrelevant decision
+        if self.cone_histo_enabled:
+            try:
+                cone_clauses, cone_vars = self.cone(
+                    assumptions, need_clauses=True
+                )
+                bucket = (
+                    f"c{max(1, int(cone_clauses.size)).bit_length()}"
+                    f"/v{max(1, int(cone_vars.size)).bit_length()}"
+                )
+                self.cone_histogram[bucket] = (
+                    self.cone_histogram.get(bucket, 0) + 1
+                )
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
         t0 = time.monotonic()
         if getattr(_args, "cone_decisions", True):
             try:

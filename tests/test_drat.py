@@ -198,3 +198,101 @@ def test_end_to_end_analysis_certifies():
         args.proof_log = reset
         reset_blast_context()
         clear_model_cache()
+
+
+def test_device_dispatch_stays_on_under_proof_log(monkeypatch):
+    """VERDICT r4 #6: --proof-log must keep the accelerator.  A forced
+    dispatch (CPU jax backend) refutes lanes on the device; each
+    refutation is host-confirmed by a bounded CDCL solve whose
+    ASSUMPTION_CONFLICT event certifies it, so the checker stays green
+    with dispatches > 0 and the refuted lanes still decide False."""
+    from mythril_tpu.laser.ethereum.state.constraints import Constraints
+    from mythril_tpu.ops.batched_sat import batch_check_states, dispatch_stats
+    from mythril_tpu.smt import UGT, ULT, symbol_factory
+    from mythril_tpu.smt.drat import check_proof
+    from mythril_tpu.smt.solver import get_blast_context, reset_blast_context
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
+    monkeypatch.setattr(args, "proof_log", True)
+    monkeypatch.setattr(args, "device_min_lanes", 2)
+    monkeypatch.setattr(args, "device_force_dispatch", True)
+    monkeypatch.setattr(args, "batched_solving", True)
+    reset_blast_context()
+    try:
+        dispatch_stats.reset()
+        lanes = []
+        for i in range(8):
+            x = symbol_factory.BitVecSym(f"plog_dev{i}", 16)
+            if i % 2 == 0:
+                lanes.append([x == 41 + i])
+            else:  # UNSAT: x < 3 and x > 11
+                lanes.append(
+                    [ULT(x, symbol_factory.BitVecVal(3, 16)),
+                     UGT(x, symbol_factory.BitVecVal(11, 16))]
+                )
+        verdicts = batch_check_states([Constraints(lane) for lane in lanes])
+        assert dispatch_stats.dispatches > 0, "device path never engaged"
+        assert dispatch_stats.unsat > 0, "no device refutation to certify"
+        for i in range(1, 8, 2):
+            assert verdicts[i] is False
+        ctx = get_blast_context()
+        assert ctx.solver.proof_enabled and not ctx.solver.proof_overflowed
+        stats = check_proof(ctx.solver.fetch_proof())
+        assert stats["unsat_verdicts"] >= dispatch_stats.unsat
+    finally:
+        reset_blast_context()
+
+
+def test_async_harvest_confirms_refutations_under_proof_log(monkeypatch):
+    """The async prefetch channel feeds the UNSAT memo that later
+    queries consume without a fresh solve — under --proof-log a
+    harvested refutation must carry a certificate too (or be dropped,
+    never silently trusted)."""
+    from mythril_tpu.laser.ethereum.state.constraints import Constraints
+    from mythril_tpu.ops.async_dispatch import async_stats, get_async_dispatcher
+    from mythril_tpu.ops.batched_sat import batch_check_states, dispatch_stats
+    from mythril_tpu.smt import UGT, ULT, symbol_factory
+    from mythril_tpu.smt.drat import check_proof
+    from mythril_tpu.smt.solver import get_blast_context, reset_blast_context
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
+    monkeypatch.setattr(args, "proof_log", True)
+    monkeypatch.setattr(args, "device_min_lanes", 2)
+    monkeypatch.setattr(args, "device_force_dispatch", False)
+    monkeypatch.setattr(args, "async_dispatch", True)
+    monkeypatch.setattr(args, "batched_solving", True)
+    monkeypatch.setattr(args, "device_min_save_s", 1e9)  # always declined
+    reset_blast_context()
+    dispatcher = get_async_dispatcher()
+    dispatcher.drop()
+    async_stats.reset()
+    try:
+        dispatch_stats.reset()
+        lanes = []
+        for i in range(6):
+            x = symbol_factory.BitVecSym(f"plog_async{i}", 16)
+            if i % 2 == 0:
+                lanes.append([x == 7 + i])
+            else:
+                lanes.append(
+                    [ULT(x, symbol_factory.BitVecVal(2, 16)),
+                     UGT(x, symbol_factory.BitVecVal(9, 16))]
+                )
+        constraint_sets = [Constraints(lane) for lane in lanes]
+        batch_check_states(constraint_sets)  # declined -> async launch
+        assert async_stats.launches == 1
+        if dispatcher._live_thread is not None:
+            dispatcher._live_thread.join(timeout=120)
+        ctx = get_blast_context()
+        dispatcher.harvest(ctx)
+        assert async_stats.harvested == 1
+        assert async_stats.unsat > 0, "no refutation harvested"
+        # every harvested refutation was certified before entering the
+        # memo: the stream replays green
+        stats = check_proof(ctx.solver.fetch_proof())
+        assert stats["unsat_verdicts"] >= async_stats.unsat
+    finally:
+        dispatcher.drop()
+        reset_blast_context()
