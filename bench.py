@@ -476,60 +476,42 @@ def _run_t3():
 
 
 def _mesh_scale_child():
-    """Child-process body for the mesh row: one forced dispatch of a
-    multi-lane frontier through the dp×cp sharded path, over a real
-    blasted pool (multiplier circuits + comparison chains).  A full
-    scale-contract analysis through the interpret-mode shard_map costs
-    tens of minutes on virtual CPU devices, so the row pins the
-    production dispatch machinery (batch_check_states -> gather backend
-    -> parallel/mesh.py) on one bounded frontier instead."""
+    """Child-process body for the mesh row: a REAL scale-contract
+    analysis (binary dispatch tree + MUL guard leaves, depth 3 so the
+    interpret-mode shard_map stays bounded on virtual CPU devices)
+    routed through the dp×cp sharded path via the union-cone gather
+    tier — production machinery end to end (svm -> batch_check_states
+    -> gather backend -> parallel/mesh.py), with the detection oracle
+    (SWC-106) as the parity check."""
     import logging
     import time as _time
 
     logging.disable(logging.CRITICAL)
-    from mythril_tpu.laser.ethereum.state.constraints import Constraints
-    from mythril_tpu.ops.batched_sat import batch_check_states, dispatch_stats
-    from mythril_tpu.smt import UGT, ULT, symbol_factory
-    from mythril_tpu.smt.solver import get_blast_context
     from mythril_tpu.support.support_args import args
 
+    for key, value in MODES["device"].items():
+        setattr(args, key, value)
     args.device_min_lanes = 2
-    args.device_force_dispatch = True
-    ctx = get_blast_context()
-    # realistic pool: a 16-bit multiplier search (also generates CDCL
-    # learnts, exercising the absorb channel into the sharded scan)
-    x = symbol_factory.BitVecSym("mesh_x", 16)
-    y = symbol_factory.BitVecSym("mesh_y", 16)
-    ctx.check([
-        (x * y == 0x8001).raw,
-        ULT(x, symbol_factory.BitVecVal(0x100, 16)).raw,
-        UGT(x, symbol_factory.BitVecVal(2, 16)).raw,
-    ])
-    lanes = []
-    for i in range(16):
-        z = symbol_factory.BitVecSym(f"mesh_l{i}", 16)
-        if i % 2 == 0:
-            lanes.append([z == 3 + i])
-        else:
-            lanes.append([
-                ULT(z, symbol_factory.BitVecVal(2, 16)),
-                UGT(z, symbol_factory.BitVecVal(9, 16)),
-            ])
-    dispatch_stats.reset()
+    global DEVICE_STATUS
+    DEVICE_STATUS = "cpu-only"
     began = _time.time()
-    verdicts = batch_check_states([Constraints(lane) for lane in lanes])
+    found, row = _analyze_one(
+        "mesh_scale", scale_contract(depth=3, guard="mul"), 1,
+        execution_timeout=300, max_depth=512,
+    )
     import jax
 
-    unsat_ok = all(
-        verdicts[i] is False for i in range(1, len(lanes), 2)
-    )
     print(json.dumps({
         "wall_s": round(_time.time() - began, 2),
-        "mesh_dispatches": dispatch_stats.mesh_dispatches,
-        "mesh_pool_rows": dispatch_stats.mesh_pool_rows,
-        "mesh_absorbed": dispatch_stats.mesh_absorbed,
-        "lanes": len(lanes),
-        "unsat_lanes_proved": unsat_ok,
+        "mesh_dispatches": row["mesh_dispatches"],
+        "mesh_pool_rows": row["mesh_pool_rows"],
+        "mesh_absorbed": row["mesh_absorbed"],
+        "lanes": row["lanes"],
+        "queries": row["queries"],
+        "found": sorted(found),
+        "unsat_lanes": row["unsat"],
+        "sat_verified": row["sat_verified"],
+        "findings_parity": "106" in found,
         "devices": len(jax.devices()),
     }))
 
@@ -819,9 +801,11 @@ def main() -> None:
     if "t3_wall_s" in summary:
         headline["t3_wall_s"] = summary["t3_wall_s"]
     if isinstance(mesh_scale, dict) and "skipped" not in mesh_scale:
-        headline["mesh_row_ok"] = bool(
-            mesh_scale.get("unsat_lanes_proved")
-        ) and "error" not in mesh_scale
+        headline["mesh_row_ok"] = (
+            bool(mesh_scale.get("findings_parity"))
+            and mesh_scale.get("mesh_dispatches", 0) > 0
+            and "error" not in mesh_scale
+        )
     if isinstance(microbench, dict) and "device_warm_s" in microbench:
         headline["microbench_device_warm_s"] = microbench["device_warm_s"]
         headline["microbench_speedup"] = microbench.get("speedup")

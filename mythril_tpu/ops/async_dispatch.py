@@ -101,7 +101,19 @@ class AsyncDispatcher:
                 # np.asarray is a pure copy on every jax version
                 handle["status"].block_until_ready()
                 pending["status"] = handle["status"]
-                pending["assign"] = handle["assign"]
+                if "cone_vars" in handle:
+                    # cone-tier runner: expand the compact assignment
+                    # back to full var space on the worker thread so
+                    # harvest's _env_from_assignment works unchanged
+                    compact = np.asarray(handle["assign"])
+                    cone_vars = handle["cone_vars"]
+                    full = np.zeros(
+                        (compact.shape[0], handle["full_width"]), np.int8
+                    )
+                    full[:, cone_vars] = compact[:, 1:cone_vars.size + 1]
+                    pending["assign"] = full
+                else:
+                    pending["assign"] = handle["assign"]
             except Exception as exc:  # noqa: BLE001 — prefetch only
                 log.debug("async dispatch failed: %s", exc)
                 pending["failed"] = True

@@ -41,6 +41,16 @@ GATHER_STEPS = 768     # DPLL sweep budget (one clause scan per step)
 GATHER_DECISIONS = 256  # decision-stack depth before bailing to CDCL
 MAX_GATHER_CLAUSES = 8192  # beyond this the full-pool gather probe loses
 MAX_GATHER_VARS = 8192     # to the CDCL tail outright (see check_assumption_sets)
+# Union-cone gather tier (VERDICT r4 #4/#7): when the POOL outgrows the
+# caps above but the batch's union defining cone still fits these, the
+# dispatch ships only the cone (subset CSR, vars compacted to dense
+# ids).  Measured cone histograms (docs/measurements_r5.md): scale-
+# scenario frontiers stay ~10k clauses while their pools pass 40k, so
+# this is the tier that keeps wide frontiers on the device as the pool
+# deepens; -t3 cones measure 0.5M-2M clauses and stay host-bound by
+# design.
+MAX_CONE_GATHER_CLAUSES = 16384
+MAX_CONE_GATHER_VARS = 8192
 MAX_LEARNT_EXEMPTION = 8192  # absorbed-learnt budget exemption cap
 FUTILE_DISPATCH_FUSE = 3   # consecutive zero-decision dispatches before
                            # the device is skipped for the context
@@ -459,6 +469,12 @@ class BatchedSatBackend:
 
         verdict, num_vars = self._gather_eligibility(ctx)
         if verdict is not None:
+            if verdict == "size_bailouts":
+                # the POOL is too big, but the batch's union cone may
+                # still fit the cone tier — ship just the cone
+                cone_result = self.check_cone_gather(ctx, assumption_sets)
+                if cone_result is not None:
+                    return cone_result
             # telemetry names the cause (a zero dispatch count must be
             # attributable from the artifact alone)
             setattr(dispatch_stats, verdict,
@@ -527,6 +543,133 @@ class BatchedSatBackend:
                     if key != bucket and len(self._step_cache) > 4:
                         del self._step_cache[key]
         return step
+
+    def _build_cone_batch(self, ctx, assumption_sets):
+        """Device inputs for the union-cone tier: (rows [N,K] int32
+        with literals remapped to compact var ids, assign [B,n+1]
+        int8, cone_vars [n] int64 original ids) — or None when the
+        union cone exceeds the tier caps (or is empty).
+
+        Soundness matches the per-lane cone contract documented on
+        BlastContext.cone: every shipped clause holds globally, so a
+        kernel UNSAT is sound; a completed assignment is only a
+        candidate and is verified against the original terms by the
+        caller.  Clauses wider than MAX_CLAUSE_WIDTH are dropped
+        (weakens BCP, never soundness)."""
+        roots = sorted({lit for lane in assumption_sets for lit in lane})
+        if not roots:
+            return None
+        try:
+            clause_ids, cone_vars = ctx.pool.cone(roots)
+        except Exception:  # noqa: BLE001 — optimization tier only
+            return None
+        if (
+            clause_ids.size == 0
+            or clause_ids.size > MAX_CONE_GATHER_CLAUSES
+            or cone_vars.size > MAX_CONE_GATHER_VARS
+        ):
+            return None
+        lits, indptr = ctx.pool.subset_csr(clause_ids)
+        cone_vars = np.union1d(
+            np.asarray(cone_vars, dtype=np.int64), [1]
+        )  # the TRUE anchor must be mappable (see the synthetic row)
+        n = int(cone_vars.size)
+        widths = np.diff(indptr)
+        keep = widths <= MAX_CLAUSE_WIDTH
+        kept_widths = widths[keep]
+        # bucket the row count to a power of two (all-zero rows are
+        # inert padding for the kernels, same as DevicePool.refresh):
+        # union cones change size every round, and an exact row count
+        # would retrace the jitted solve / shard_map per dispatch
+        row_count = DevicePool._bucket(int(keep.sum()) + 1)
+        rows = np.zeros((row_count, MAX_CLAUSE_WIDTH), np.int32)
+        if lits.size:
+            mask = np.arange(MAX_CLAUSE_WIDTH)[None, :] < kept_widths[:, None]
+            flat_keep = np.repeat(keep, widths)
+            kept_lits = lits[flat_keep]
+            pos = np.searchsorted(
+                cone_vars, np.abs(kept_lits).astype(np.int64)
+            )
+            pos_clipped = np.minimum(pos, n - 1)
+            if not np.all(cone_vars[pos_clipped] == np.abs(kept_lits)):
+                # a subset clause references a var outside the walked
+                # cone (late congruence attach): remapping it would be
+                # silently unsound — decline the tier for this batch
+                return None
+            compact = pos + 1
+            rows[: len(kept_widths)][mask] = np.where(
+                kept_lits < 0, -compact, compact
+            ).astype(np.int32)
+        # synthetic anchor unit {TRUE}: guarantees a lane asserting the
+        # FALSE literal conflicts in BCP instead of "completing"
+        anchor = int(np.searchsorted(cone_vars, 1)) + 1
+        rows[len(kept_widths), 0] = anchor
+        assign = np.zeros((len(assumption_sets), n + 1), np.int8)
+        assign[:, anchor] = 1
+        for lane, assumptions in enumerate(assumption_sets):
+            for lit in assumptions:
+                var = abs(lit)
+                pos = int(np.searchsorted(cone_vars, var))
+                if pos < n and cone_vars[pos] == var:
+                    assign[lane, pos + 1] = 1 if lit > 0 else -1
+        return rows, assign, cone_vars
+
+    def check_cone_gather(self, ctx, assumption_sets):
+        """Dispatch the batch against its union cone only.  Multi-
+        device processes route through the dp x cp sharded mesh —
+        this is the production path that puts mesh_dispatches on real
+        analyze runs (VERDICT r4 #7); single-chip runs use the jitted
+        lockstep step over the compact cone.  Returns per-lane
+        verdicts like check_assumption_sets, or None when the cone
+        does not fit the tier."""
+        built = self._build_cone_batch(ctx, assumption_sets)
+        if built is None:
+            return None
+        rows, assign, cone_vars = built
+        jax, jnp = _require_jax()
+        n = int(cone_vars.size)
+        self.device_engaged = True
+        if len(jax.devices()) > 1:
+            from mythril_tpu.parallel.mesh import (
+                get_mesh, sharded_frontier_solve,
+            )
+
+            final_assign, status = sharded_frontier_solve(
+                get_mesh(), rows, assign
+            )
+            dispatch_stats.mesh_dispatches += 1
+            dispatch_stats.mesh_pool_rows = int(rows.shape[0])
+            dispatch_stats.mesh_absorbed = getattr(
+                ctx, "absorbed_learnt_count", 0
+            )
+        else:
+            bucket = DevicePool._bucket(n)
+            if bucket + 1 > assign.shape[1]:
+                # nonexistent padding vars preassigned true: they must
+                # never consume DPLL decisions (same rule as the
+                # full-pool tier's `used` trick)
+                assign = np.concatenate(
+                    [assign,
+                     np.ones((assign.shape[0],
+                              bucket + 1 - assign.shape[1]), np.int8)],
+                    axis=1,
+                )
+            step = self._cached_step(bucket)
+            final_assign, status = step(
+                jnp.asarray(rows), jnp.asarray(assign)
+            )
+        status = np.asarray(status)
+        final_assign = np.asarray(final_assign)
+        # expand the compact assignment back to full var space so the
+        # caller's model extraction works unchanged
+        V1 = ctx.solver.num_vars + 1
+        full = np.zeros((len(assumption_sets), V1), np.int8)
+        full[:, cone_vars] = final_assign[:, 1:n + 1]
+        self.last_assignments = full
+        return [
+            False if status[lane] == 2 else None
+            for lane in range(len(assumption_sets))
+        ]
 
     def _gather_eligibility(self, ctx):
         """Shared gather-path gates for the sync and async dispatchers.
@@ -615,6 +758,41 @@ class BatchedSatBackend:
         if not assumption_sets:
             return None
         verdict, num_vars = self._gather_eligibility(ctx)
+        if verdict == "size_bailouts":
+            # the prefetch channel must not go dark in the oversized-
+            # pool regime the cone tier serves (deep analyses live
+            # there): prepare a cone-tier runner instead
+            built = self._build_cone_batch(ctx, assumption_sets)
+            if built is None:
+                return None
+            rows, assign, cone_vars = built
+            _, jnp = _require_jax()
+            n = int(cone_vars.size)
+            bucket = DevicePool._bucket(n)
+            if bucket + 1 > assign.shape[1]:
+                assign = np.concatenate(
+                    [assign,
+                     np.ones((assign.shape[0],
+                              bucket + 1 - assign.shape[1]), np.int8)],
+                    axis=1,
+                )
+            full_width = ctx.solver.num_vars + 1
+
+            def run_cone():
+                step = self._cached_step(bucket)
+                assign_dev, status_dev = step(
+                    jnp.asarray(rows), jnp.asarray(assign)
+                )
+                # cone_vars/full_width let the harvester expand the
+                # compact assignment back to full var space
+                return {
+                    "status": status_dev,
+                    "assign": assign_dev,
+                    "cone_vars": cone_vars,
+                    "full_width": full_width,
+                }
+
+            return run_cone
         if verdict is not None:
             return None
         _, jnp = _require_jax()

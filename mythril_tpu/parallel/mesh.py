@@ -26,8 +26,14 @@ log = logging.getLogger(__name__)
 # so the step budget trades collective latency against search depth.
 # Enough to complete full-pool assignments on dryrun/test-scale pools;
 # production frontiers lean on the CDCL tail past this.
-MAX_STEPS = 1536
-MAX_DECISIONS = 384
+# Matches the dense tier's calibration (ops/pallas_prop.py): the
+# captured scale-scenario dispatch (10.5k cone clauses, 8 lanes)
+# completes in ~1.7-2k sweeps / ~700 decisions, so the old 1536-sweep
+# budget bailed on exactly the frontiers the mesh exists for.  The
+# while_loop exits early on decided batches — a budget is a cap, not a
+# cost — so small dispatches don't pay for the headroom.
+MAX_STEPS = 4096
+MAX_DECISIONS = 1024
 
 
 _mesh_cache = None
