@@ -51,7 +51,14 @@ struct Clause {
   uint8_t tier = 2;
   uint8_t used = 0;      // touched in conflict analysis since last reduce
   uint8_t vivified = 0;  // already probed by vivify(): skip next rounds
-  vector<Lit> lits;
+  // literals live in the solver's shared arena (cache-dense BCP; the
+  // per-clause heap vector this replaces cost a pointer chase per
+  // clause touch and >40 bytes of overhead per clause on 23M-clause
+  // pools).  size == 0 marks a deleted clause; its arena span becomes
+  // a dead hole until the bounded compaction pass (see compact_arena,
+  // triggered from reduceDB) rewrites live offsets.
+  int64_t offset = 0;
+  int32_t size = 0;
 };
 
 struct Watcher {
@@ -216,11 +223,13 @@ class Solver {
     int restart = 0;
     int status = 0;
     while (status == 0) {
-      // Luby restarts drive the search by default; when the env-gated
-      // adaptive (glucose) policy is on it fires first and Luby becomes
-      // a slow backstop
-      int64_t luby_len =
-          (adaptive_restart_ ? 1024 : 100) * luby(restart++);
+      // Luby restarts drive the search; x1024 base is the schedule the
+      // adopted round-5 configuration was measured under (assumption-
+      // incremental queries keep their prefix across restarts, so slow
+      // restarts lose little and re-propagation is the real cost).
+      // When the env-gated adaptive (glucose) policy is on it fires
+      // first and Luby is only a backstop.
+      int64_t luby_len = 1024 * luby(restart++);
       status = search(luby_len);
       if (budget_conflicts_ >= 0 && conflicts_this_call_ >= budget_conflicts_)
         { if (status == 0) break; }
@@ -310,10 +319,11 @@ class Solver {
     for (; idx < (int64_t)clauses_.size(); ++idx) {
       const Clause& c = clauses_[idx];
       if (!c.learned || c.deleted) continue;
-      int32_t n = (int32_t)c.lits.size();
+      int32_t n = c.size;
       if (n == 0 || n > max_width) continue;
       if (written + n + 1 > cap) break;
-      for (Lit l : c.lits) out[written++] = l;
+      const Lit* ls = clause_lits(c);
+      for (int32_t k = 0; k < n; ++k) out[written++] = ls[k];
       out[written++] = 0;
     }
     if (next) *next = idx;
@@ -324,6 +334,36 @@ class Solver {
   // ---- state ----
   bool ok_ = true;
   vector<Clause> clauses_;
+  vector<Lit> arena_;  // all clause literals, contiguous (see Clause)
+  int64_t arena_dead_ = 0;  // dead literal slots (deleted-clause holes)
+
+  inline Lit* clause_lits(Clause& c) { return arena_.data() + c.offset; }
+  inline const Lit* clause_lits(const Clause& c) const {
+    return arena_.data() + c.offset;
+  }
+
+  // Compact the arena when dead holes outweigh live literals: clause
+  // INDICES are the only references watchers, reasons and learnts_
+  // hold, so compaction just rewrites each live clause's offset.
+  // Callers must not hold clause_lits pointers across this (reduceDB's
+  // call site holds none).
+  void compact_arena() {
+    if (arena_dead_ < (int64_t)1 << 20 ||
+        arena_dead_ < (int64_t)arena_.size() / 2)
+      return;
+    vector<Lit> fresh;
+    fresh.reserve(arena_.size() - arena_dead_);
+    for (Clause& c : clauses_) {
+      if (c.deleted || c.size == 0) continue;
+      int64_t at = (int64_t)fresh.size();
+      fresh.insert(fresh.end(), arena_.begin() + c.offset,
+                   arena_.begin() + c.offset + c.size);
+      c.offset = at;
+    }
+    arena_.swap(fresh);
+    arena_.shrink_to_fit();
+    arena_dead_ = 0;
+  }
   vector<vector<Watcher>> watches_;   // indexed by lit_index
   vector<vector<Watcher>> bin_watches_;  // binary-clause implications
   vector<int8_t> assigns_;            // var -> 0/1/-1
@@ -378,7 +418,11 @@ class Solver {
   int64_t next_viv_at_ = kVivInterval;
   static constexpr int64_t kVivInterval = 20000;
   int64_t core_count_ = 0;
-  static constexpr int64_t kCoreCap = 65536;
+  // Bounds immortal-glue memory without forfeiting its pruning power:
+  // capping at 64k measured 3x the conflicts of the unbounded tier on
+  // batchtoken -t3 (599.9k vs 204.8k — glue re-derivation), while 1M
+  // core clauses cost only ~40 MB in the arena representation.
+  static constexpr int64_t kCoreCap = 1 << 20;
   bool proof_enabled_ = false;
   bool proof_overflow_ = false;
   vector<int32_t> proof_;
@@ -488,16 +532,22 @@ class Solver {
   // object (most of the pool is 2-lit Tseitin gate clauses, so this is
   // the hot path of every BCP pass).  Shared by attach() and the
   // reduceDB watch rebuild so the routing rule cannot drift.
-  void attach_watchers(int idx, const vector<Lit>& lits) {
-    auto& target = lits.size() == 2 ? bin_watches_ : watches_;
+  void attach_watchers(int idx, const Lit* lits, int32_t n) {
+    auto& target = n == 2 ? bin_watches_ : watches_;
     target[lit_index(-lits[0])].push_back({idx, lits[1]});
     target[lit_index(-lits[1])].push_back({idx, lits[0]});
   }
 
   int attach(const vector<Lit>& lits, bool learned) {
     int idx = (int)clauses_.size();
-    clauses_.push_back(Clause{(float)cla_inc_, 0, learned, false, 2, 0, 0, lits});
-    attach_watchers(idx, clauses_[idx].lits);
+    Clause c;
+    c.activity = (float)cla_inc_;
+    c.learned = learned;
+    c.offset = (int64_t)arena_.size();
+    c.size = (int32_t)lits.size();
+    arena_.insert(arena_.end(), lits.begin(), lits.end());
+    clauses_.push_back(c);
+    attach_watchers(idx, clause_lits(clauses_[idx]), c.size);
     return idx;
   }
 
@@ -541,15 +591,16 @@ class Solver {
         if (value(w.blocker) == 1) { ws[j++] = ws[i++]; continue; }
         Clause& c = clauses_[w.clause];
         if (c.deleted) { ++i; continue; }
-        // ensure c.lits[1] is the false literal (-p)
-        if (c.lits[0] == -p) std::swap(c.lits[0], c.lits[1]);
-        Lit first = c.lits[0];
+        Lit* cl = clause_lits(c);
+        // ensure cl[1] is the false literal (-p)
+        if (cl[0] == -p) std::swap(cl[0], cl[1]);
+        Lit first = cl[0];
         if (value(first) == 1) { ws[j++] = {w.clause, first}; ++i; continue; }
         bool moved = false;
-        for (size_t k = 2; k < c.lits.size(); ++k) {
-          if (value(c.lits[k]) != -1) {
-            std::swap(c.lits[1], c.lits[k]);
-            watches_[lit_index(-c.lits[1])].push_back({w.clause, first});
+        for (int32_t k = 2; k < c.size; ++k) {
+          if (value(cl[k]) != -1) {
+            std::swap(cl[1], cl[k]);
+            watches_[lit_index(-cl[1])].push_back({w.clause, first});
             moved = true;
             break;
           }
@@ -619,8 +670,8 @@ class Solver {
         // LBD refresh on use (glucose): a clause whose literals now sit
         // on fewer distinct levels than at learn time has become
         // stronger — keep the lower value and promote across tiers
-        if (cl.lbd > 2 && cl.lits.size() > 2) {
-          int32_t fresh = clause_lbd(cl.lits);
+        if (cl.lbd > 2 && cl.size > 2) {
+          int32_t fresh = clause_lbd(clause_lits(cl), cl.size);
           if (fresh < cl.lbd) {
             cl.lbd = fresh;
             if (fresh <= 2 && core_count_ < kCoreCap) {
@@ -632,8 +683,9 @@ class Solver {
           }
         }
       }
-      for (size_t k = 0; k < cl.lits.size(); ++k) {
-        Lit q = cl.lits[k];
+      const Lit* cls = clause_lits(cl);
+      for (int32_t k = 0; k < cl.size; ++k) {
+        Lit q = cls[k];
         // skip the implied literal by identity, not position: binary
         // implications enqueue the watcher's blocker, which need not
         // be lits[0]
@@ -670,8 +722,10 @@ class Solver {
       bool redundant = false;
       if (r != -1) {
         redundant = true;
-        for (Lit q : clauses_[r].lits) {
-          Var qv = std::abs(q);
+        const Clause& rc = clauses_[r];
+        const Lit* rls = clause_lits(rc);
+        for (int32_t k = 0; k < rc.size; ++k) {
+          Var qv = std::abs(rls[k]);
           if (qv == v) continue;
           if (!seen_[qv] && level_[qv] > 0) { redundant = false; break; }
         }
@@ -702,8 +756,10 @@ class Solver {
       if (reason_[v] == -1) {
         if (level_[v] > 0) conflict_core_.push_back(-trail_[i]);
       } else {
-        for (Lit q : clauses_[reason_[v]].lits)
-          if (level_of(q) > 0) seen_[std::abs(q)] = 1;
+        const Clause& rc = clauses_[reason_[v]];
+        const Lit* rls = clause_lits(rc);
+        for (int32_t k = 0; k < rc.size; ++k)
+          if (level_of(rls[k]) > 0) seen_[std::abs(rls[k])] = 1;
       }
       seen_[v] = 0;
     }
@@ -714,11 +770,15 @@ class Solver {
   // low-LBD ("glue") clauses connect few search levels and keep paying
   // propagation long after their activity decays
   int32_t clause_lbd(const vector<Lit>& lits) {
+    return clause_lbd(lits.data(), (int32_t)lits.size());
+  }
+  int32_t clause_lbd(const Lit* lits, int32_t n) {
     ++lbd_stamp_counter_;
     if (lbd_stamp_.size() < (size_t)decision_level() + 2)
       lbd_stamp_.resize(decision_level() + 2, 0);
     int32_t distinct = 0;
-    for (Lit l : lits) {
+    for (int32_t li = 0; li < n; ++li) {
+      Lit l = lits[li];
       int lv = level_of(l);
       if (lv >= 0 && (size_t)lv < lbd_stamp_.size() &&
           lbd_stamp_[lv] != lbd_stamp_counter_) {
@@ -735,17 +795,17 @@ class Solver {
   // clauses), so the check is O(1) — no O(pool) locked bitmap.
   bool is_locked(int ci) const {
     const Clause& c = clauses_[ci];
-    if (c.lits.empty()) return false;
-    Var v = std::abs(c.lits[0]);
+    if (c.size == 0) return false;
+    Var v = std::abs(clause_lits(c)[0]);
     return assigns_[v] != 0 && reason_[v] == ci;
   }
 
   void delete_clause(int ci) {
     Clause& c = clauses_[ci];
     c.deleted = true;
-    proof_event(2, c.lits.data(), c.lits.size());
-    c.lits.clear();
-    c.lits.shrink_to_fit();
+    proof_event(2, clause_lits(c), c.size);
+    arena_dead_ += c.size;
+    c.size = 0;  // the hole is reclaimed by compact_arena on cadence
   }
 
   // Tiered reduction (CaDiCaL-style): core (lbd <= 2) is never touched,
@@ -800,6 +860,7 @@ class Solver {
       learnts_.resize(keep);
     }
     max_local_ += max_local_ / 20;
+    compact_arena();
   }
 
   // Clause vivification (inprocessing): for a learned clause
@@ -822,11 +883,13 @@ class Solver {
     for (size_t i = 0; i < bound && prop_budget > 0 && scanned < 4000; ++i) {
       int ci = learnts_[i];
       if (clauses_[ci].deleted || clauses_[ci].vivified) continue;
-      if (clauses_[ci].lits.size() < 3 || clauses_[ci].lits.size() > 32)
+      if (clauses_[ci].size < 3 || clauses_[ci].size > 32)
         continue;
       if (is_locked(ci)) continue;
       ++scanned;
-      vector<Lit> lits = clauses_[ci].lits;  // copy: attach may realloc
+      // copy out of the arena: attach below appends to it
+      vector<Lit> lits(clause_lits(clauses_[ci]),
+                       clause_lits(clauses_[ci]) + clauses_[ci].size);
       clauses_[ci].deleted = true;  // mask from its own derivation
       vector<Lit> kept;
       bool satisfied = false, conflicted = false;
@@ -855,8 +918,8 @@ class Solver {
           level_of(kept[0]) == 0) {
         // satisfied at level 0 forever: drop the clause outright
         proof_event(2, lits.data(), lits.size());
-        clauses_[ci].lits.clear();
-        clauses_[ci].lits.shrink_to_fit();
+        arena_dead_ += (int64_t)lits.size();
+        clauses_[ci].size = 0;
         vivified_lits_ += (int64_t)lits.size();
         continue;
       }
@@ -871,8 +934,8 @@ class Solver {
         fc.vivified = 1;
         if (fc.tier > 0) learnts_.push_back(fresh);
         clauses_[ci].deleted = true;
-        clauses_[ci].lits.clear();
-        clauses_[ci].lits.shrink_to_fit();
+        arena_dead_ += (int64_t)lits.size();
+        clauses_[ci].size = 0;
         continue;
       }
       vivified_lits_ += (int64_t)(lits.size() - kept.size());
@@ -887,8 +950,8 @@ class Solver {
         }
         clauses_[ci].deleted = true;
         proof_event(2, lits.data(), lits.size());
-        clauses_[ci].lits.clear();
-        clauses_[ci].lits.shrink_to_fit();
+        arena_dead_ += (int64_t)lits.size();
+        clauses_[ci].size = 0;
         if (!ok_) return;
         continue;
       }
@@ -909,8 +972,8 @@ class Solver {
         fc.tier = 0;  // binary: permanent (binary watches skip `deleted`)
       }
       proof_event(2, lits.data(), lits.size());
-      clauses_[ci].lits.clear();
-      clauses_[ci].lits.shrink_to_fit();
+      arena_dead_ += (int64_t)lits.size();
+      clauses_[ci].size = 0;
     }
   }
 
