@@ -151,3 +151,40 @@ def test_stale_generation_is_dropped(monkeypatch):
     get_async_dispatcher().harvest(ctx)
     assert async_stats.dropped == 1
     assert async_stats.harvested == 0
+
+
+def test_prefetch_uses_cone_tier_on_oversized_pool(monkeypatch):
+    """The prefetch channel must not go dark when the pool outgrows
+    the full-pool gather caps (the steady state of a deep analysis):
+    prepare_gather falls back to a union-cone runner, and the harvest
+    expands the compact assignment so refutations and models land in
+    the memo/probe exactly like full-pool harvests."""
+    from mythril_tpu.ops import batched_sat as BS
+    from mythril_tpu.ops.async_dispatch import async_stats, get_async_dispatcher
+    from mythril_tpu.ops.batched_sat import batch_check_states
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setattr(args, "device_min_lanes", 2)
+    monkeypatch.setattr(args, "device_force_dispatch", False)
+    monkeypatch.setattr(args, "async_dispatch", True)
+    monkeypatch.setattr(args, "batched_solving", True)
+    monkeypatch.setattr(args, "device_min_save_s", 1e9)  # always declined
+    ctx = get_blast_context()
+    for i in range(3):  # push the pool past MAX_GATHER_CLAUSES
+        w = symbol_factory.BitVecSym(f"acone_fat{i}", 64)
+        ctx.blast_lit(
+            (w * symbol_factory.BitVecVal(0x6D2B + 2 * i, 64)
+             == symbol_factory.BitVecVal(4321 + i, 64)).raw
+        )
+    assert ctx.pool.num_clauses > BS.MAX_GATHER_CLAUSES
+    from mythril_tpu.laser.ethereum.state.constraints import Constraints
+
+    lanes = _frontier("acone")
+    batch_check_states([Constraints(lane) for lane in lanes])
+    assert async_stats.launches == 1, "cone-tier prefetch never launched"
+    dispatcher = get_async_dispatcher()
+    if dispatcher._live_thread is not None:
+        dispatcher._live_thread.join(timeout=120)
+    dispatcher.harvest(ctx)
+    assert async_stats.harvested == 1
+    assert async_stats.unsat > 0, "harvest consumed no cone refutations"
