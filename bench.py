@@ -95,7 +95,11 @@ def _corpus():
     return [
         ("killbilly", killbilly, 1, {"106"}),
         ("overflow_token", overflow_token, 2, {"101"}),
-        ("origin_gate", origin_gate, 1, {"115", "106"}),
+        # origin_gate: SWC-115 only — the SUICIDE behind the
+        # tx.origin == 0x42 gate is NOT killable-by-anyone (the suicide
+        # module requires caller == origin == attacker, exactly like
+        # the reference's modules/suicide.py), so no SWC-106 here
+        ("origin_gate", origin_gate, 1, {"115"}),
     ]
 
 
