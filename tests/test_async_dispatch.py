@@ -16,9 +16,21 @@ from mythril_tpu.smt.solver import get_blast_context, reset_blast_context
 def fresh(monkeypatch):
     reset_blast_context()
     from mythril_tpu.ops.async_dispatch import async_stats, get_async_dispatcher
+    from mythril_tpu.smt.solver import SolverStatistics
 
-    get_async_dispatcher().drop()
+    dispatcher = get_async_dispatcher()
+    dispatcher.drop()
+    # drain any worker another test file left in flight: launch()
+    # declines while a previous worker lives ("never two kernels'
+    # worth of prefetch concurrently"), which would fail every launch
+    # assertion here depending on file order
+    if dispatcher._live_thread is not None:
+        dispatcher._live_thread.join(timeout=120)
     async_stats.reset()
+    # the adaptive profit gate projects residue cost from the
+    # SolverStatistics singleton; native time accumulated by OTHER test
+    # files would flip these tests' profit-skip path to a sync dispatch
+    SolverStatistics().reset()
     # reach the device path on the CPU jax backend (tests only)
     monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
     yield

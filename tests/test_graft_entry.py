@@ -1,0 +1,39 @@
+"""Driver entry points (__graft_entry__.py): these are what the
+external driver compile-checks and dry-runs, so regressions here cost
+a whole round's multichip artifact.  The dryrun is the real thing —
+symbolic execution of the scale contract, union-cone extraction, a
+dp x cp sharded mesh dispatch on 8 virtual devices, and per-lane
+verdict parity against the host CDCL."""
+
+import importlib
+import sys
+
+import pytest
+
+
+def _graft():
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as graft
+
+    importlib.reload(graft)
+    return graft
+
+
+def test_entry_compiles_and_runs():
+    graft = _graft()
+    fn, example_args = graft.entry()
+    out = fn(*example_args)
+    assert out[0].shape[0] == 8  # 8 lanes
+    assert out[1].shape == (8,)  # per-lane status
+
+
+def test_dryrun_multichip_on_virtual_mesh(capsys):
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh (conftest)")
+    graft = _graft()
+    graft.dryrun_multichip(8)  # raises on any parity violation
+    tail = capsys.readouterr().out
+    assert "dryrun_multichip OK" in tail
+    assert "EVM-derived lanes" in tail
