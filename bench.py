@@ -623,7 +623,7 @@ def _solver_microbench():
 def _scale_summary(row):
     keys = (
         "wall_s", "dispatches", "lanes", "unsat", "sat_verified",
-        "undecided", "size_bailouts", "fused", "device_sweeps",
+        "undecided", "size_bailouts", "cone_bailouts", "fused", "device_sweeps",
         "device_s", "found", "unhealthy_skips", "cpu_auto_skips",
         "profit_skips", "mesh_dispatches", "device_status",
     )

@@ -99,6 +99,12 @@ class DispatchStats:
         # for the dense kernel AND pool too large for the gather probe):
         # explains a zero dispatch count on small-contract corpora
         self.size_bailouts = 0
+        # union-cone tier declines (cone itself past MAX_CONE_GATHER_*,
+        # or an unwalked-var remap decline): names the fate of launch
+        # attempts on deep pools, where -t3 cones measure 0.5M-2M
+        # clauses (docs/measurements_r5.md) — a zero async_launches
+        # count on a -t3 row is this counter, not a dead channel
+        self.cone_bailouts = 0
         # True when the adaptive fuse disabled device dispatch for a
         # context after FUTILE_DISPATCH_FUSE zero-decision dispatches
         self.fused = False
@@ -475,6 +481,7 @@ class BatchedSatBackend:
                 cone_result = self.check_cone_gather(ctx, assumption_sets)
                 if cone_result is not None:
                     return cone_result
+                dispatch_stats.cone_bailouts += 1
             # telemetry names the cause (a zero dispatch count must be
             # attributable from the artifact alone)
             setattr(dispatch_stats, verdict,
