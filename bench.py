@@ -171,6 +171,61 @@ def batchtoken_contract() -> str:
     )
 
 
+def chaos_tree_contract() -> str:
+    """Depth-2 selector-bit dispatch tree with 16-bit multiplier-guard
+    leaves and one SWC-106 suicide leaf: the smallest contract whose
+    frontier reliably reaches the device dispatch path (the guards
+    resist the word probe; the tree forks lanes in bulk).  Shared by
+    the chaos tests (tests/test_faults.py) and the chaos soak driver
+    (scripts/chaos_corpus.py) as the workload where injected dispatch
+    faults actually fire — the embedded corpus contracts' frontiers
+    are too narrow to dispatch, which would make chaos runs vacuous."""
+    from mythril_tpu.support.assembler import asm
+
+    return asm(
+        """
+        PUSH 0; CALLDATALOAD; PUSH 0xe0; SHR
+        DUP1; PUSH 1; AND; PUSH @n1; JUMPI
+        PUSH @n0; JUMP
+      n0:
+        JUMPDEST
+        DUP1; PUSH 2; AND; PUSH @l01; JUMPI
+        PUSH @l00; JUMP
+      n1:
+        JUMPDEST
+        DUP1; PUSH 2; AND; PUSH @l11; JUMPI
+        PUSH @l10; JUMP
+      l00:
+        JUMPDEST
+        PUSH 4; CALLDATALOAD; PUSH 0xFFFF; AND
+        PUSH 0x6D2B; MUL; PUSH 0xFFFF; AND
+        PUSH 0x1234; EQ; PUSH @ok0; JUMPI
+        PUSH 0; PUSH 0; REVERT
+      ok0:
+        JUMPDEST; PUSH 1; PUSH 0; SSTORE; STOP
+      l01:
+        JUMPDEST
+        PUSH 4; CALLDATALOAD; PUSH 0xFFFF; AND
+        PUSH 0x2B11; MUL; PUSH 0xFFFF; AND
+        PUSH 0x4321; EQ; PUSH @kill; JUMPI
+        PUSH 0; PUSH 0; REVERT
+      kill:
+        JUMPDEST; CALLER; SUICIDE
+      l10:
+        JUMPDEST
+        PUSH 1; PUSH 1; SSTORE; STOP
+      l11:
+        JUMPDEST
+        PUSH 4; CALLDATALOAD; PUSH 0xFFFF; AND
+        PUSH 0x0D2B; MUL; PUSH 0xFFFF; AND
+        PUSH 0x2222; EQ; PUSH @ok3; JUMPI
+        PUSH 0; PUSH 0; REVERT
+      ok3:
+        JUMPDEST; PUSH 1; PUSH 2; SSTORE; STOP
+        """
+    )
+
+
 # Multi-transaction depth rows (BASELINE.md protocol items 3-5 at the
 # state-space scale the corpus's small 1-2-tx contracts never reach).
 def _t3_corpus():
@@ -626,6 +681,7 @@ def _scale_summary(row):
         "undecided", "size_bailouts", "cone_bailouts", "fused", "device_sweeps",
         "device_s", "found", "unhealthy_skips", "cpu_auto_skips",
         "profit_skips", "mesh_dispatches", "device_status",
+        "watchdog_trips", "dispatch_retries", "demotions",
     )
     return {k: row[k] for k in keys if k in row}
 
@@ -646,6 +702,10 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
         "device_dispatches": summary["device_dispatches"],
         "device_s": summary["solver_split"]["device_s"],
         "mesh_dispatches": summary["mesh_dispatches"],
+        # degradation ladder counters: nonzero under injected faults /
+        # flaky hardware (the acceptance signal for chaos runs)
+        "watchdog_trips": summary.get("watchdog_trips", 0),
+        "demotions": summary.get("demotions", 0),
     }
     if "t3_wall_s" in summary:
         headline["t3_wall_s"] = summary["t3_wall_s"]
@@ -663,7 +723,8 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
     line = json.dumps(headline)
     if len(line) > 500:  # hard cap so the tail capture can never lose it
         for key in ("microbench_speedup", "microbench_device_warm_s",
-                    "mesh_row_ok", "t3_wall_s", "error"):
+                    "mesh_row_ok", "t3_wall_s", "error",
+                    "watchdog_trips", "demotions"):
             headline.pop(key, None)
             line = json.dumps(headline)
             if len(line) <= 500:
@@ -782,6 +843,13 @@ def main() -> None:
         "cpu_auto_skips": sum(r["cpu_auto_skips"] for r in rows),
         "profit_skips": sum(r["profit_skips"] for r in rows),
         "mesh_dispatches": sum(r["mesh_dispatches"] for r in rows),
+        # degradation ladder telemetry (resilience/): a faulted or
+        # flaky-device round is attributable from the artifact alone
+        "watchdog_trips": sum(r.get("watchdog_trips", 0) for r in rows),
+        "dispatch_retries": sum(r.get("dispatch_retries", 0) for r in rows),
+        "demotions": sum(r.get("demotions", 0) for r in rows),
+        "rpc_retries": sum(r.get("rpc_retries", 0) for r in rows),
+        "faults_fired": sum(r.get("faults_fired", 0) for r in rows),
         "solver_split": {
             k: round(sum(r[k] for r in rows), 2)
             for k in ("probe_s", "blast_s", "cone_s", "native_s",

@@ -244,13 +244,28 @@ class Report:
                     },
                 }
             )
+        meta = self._get_exception_data()
+        try:
+            # degradation telemetry: a report produced by a demoted run
+            # says so in-band (findings are identical either way — the
+            # CDCL tail re-solves demoted lanes — but a consumer
+            # correlating wall-clock needs to see the speedup was lost)
+            from mythril_tpu.resilience.telemetry import resilience_stats
+
+            degraded = {
+                k: v for k, v in resilience_stats.as_dict().items() if v
+            }
+            if degraded:
+                meta["resilience"] = degraded
+        except Exception:  # noqa: BLE001 — telemetry never breaks reports
+            pass
         result = [
             {
                 "issues": issues,
                 "sourceType": self.source.source_type,
                 "sourceFormat": self.source.source_format,
                 "sourceList": self.source.source_list,
-                "meta": self._get_exception_data(),
+                "meta": meta,
             }
         ]
         return json.dumps(result, sort_keys=True)

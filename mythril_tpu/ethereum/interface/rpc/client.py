@@ -4,10 +4,20 @@ mythril/ethereum/interface/rpc/client.py).
 Only the read methods the analyzer needs.  Uses urllib from the stdlib;
 all errors surface as ClientError so DynLoader degrades gracefully when
 no node is reachable (the common case in this environment).
+
+Transient failures — dropped connections (``OSError``) and HTTP 5xx —
+are retried up to :data:`RPC_MAX_ATTEMPTS` times with exponential
+backoff + jitter before the error surfaces; non-transient errors (4xx,
+bad JSON, missing ``result``) fail immediately.  The transport consults
+the resilience fault plane (``rpc_error`` / ``rpc_http_500`` injection
+points), so the whole retry path is testable without a network, and
+retries land in the ``rpc_retries`` degradation counter.
 """
 
 import json
 import logging
+import random
+import time
 import urllib.error
 import urllib.request
 from typing import Any, List, Optional
@@ -15,6 +25,9 @@ from typing import Any, List, Optional
 log = logging.getLogger(__name__)
 
 JSON_MEDIA_TYPE = "application/json"
+RPC_MAX_ATTEMPTS = 3        # total tries per call (1 + 2 retries)
+RPC_BACKOFF_BASE_S = 0.05   # sleep = base * 2^attempt * (1 + jitter)
+RPC_TIMEOUT_S = 10.0
 
 
 class ClientError(Exception):
@@ -93,18 +106,7 @@ class EthJsonRpc(BaseClient):
             data=payload,
             headers={"Content-Type": JSON_MEDIA_TYPE},
         )
-        try:
-            with urllib.request.urlopen(request, timeout=10) as response:
-                if response.status != 200:
-                    raise BadStatusCodeError(str(response.status))
-                body = response.read()
-        except urllib.error.HTTPError as e:
-            # urlopen raises (rather than returns) non-2xx responses;
-            # without this branch an HTTP 500 would misclassify as a
-            # connection failure (HTTPError subclasses OSError)
-            raise BadStatusCodeError(str(e.code))
-        except OSError as e:
-            raise ConnectionError_(str(e))
+        body = self._transport(request)
         try:
             decoded = json.loads(body)
         except json.JSONDecodeError:
@@ -112,3 +114,47 @@ class EthJsonRpc(BaseClient):
         if "result" not in decoded:
             raise BadResponseError(decoded.get("error"))
         return decoded["result"]
+
+    def _transport(self, request) -> bytes:
+        """One HTTP round trip with bounded retries for transient
+        failures.  5xx and connection-level OSErrors are transient (a
+        node restarting, a flapping LB); 4xx means the request itself is
+        wrong and a retry would just repeat it."""
+        last: Optional[Exception] = None
+        for attempt in range(RPC_MAX_ATTEMPTS):
+            if attempt:
+                from mythril_tpu.resilience.telemetry import resilience_stats
+
+                resilience_stats.rpc_retries += 1
+                time.sleep(
+                    RPC_BACKOFF_BASE_S
+                    * (2 ** (attempt - 1))
+                    * (1 + random.random())
+                )
+            try:
+                from mythril_tpu.resilience import faults
+
+                faults.maybe_fault_rpc()
+                with urllib.request.urlopen(
+                    request, timeout=RPC_TIMEOUT_S
+                ) as response:
+                    if response.status != 200:
+                        raise BadStatusCodeError(str(response.status))
+                    return response.read()
+            except urllib.error.HTTPError as e:
+                # urlopen raises (rather than returns) non-2xx
+                # responses; without this branch an HTTP 500 would
+                # misclassify as a connection failure (HTTPError
+                # subclasses OSError)
+                if e.code < 500:
+                    raise BadStatusCodeError(str(e.code))
+                last = BadStatusCodeError(str(e.code))
+                log.debug("transient HTTP %s from %s (attempt %d/%d)",
+                          e.code, request.full_url, attempt + 1,
+                          RPC_MAX_ATTEMPTS)
+            except OSError as e:
+                last = ConnectionError_(str(e))
+                log.debug("transient transport error %s (attempt %d/%d)",
+                          e, attempt + 1, RPC_MAX_ATTEMPTS)
+        assert last is not None
+        raise last

@@ -88,6 +88,7 @@ class AsyncDispatcher:
             "status": None,
             "assign": None,
             "done": False,
+            "began": time.monotonic(),
             "assumption_sets": list(rep_assumption_sets),
             "node_sets": list(rep_node_sets),
             "constraint_sets": list(rep_constraint_sets),
@@ -95,6 +96,9 @@ class AsyncDispatcher:
 
         def work():
             try:
+                from mythril_tpu.resilience import faults
+
+                faults.maybe_fault_prefetch()
                 handle = runner()
                 # block on the worker, never on the host: done=True
                 # only after the kernel finished, so harvest's
@@ -152,6 +156,30 @@ class AsyncDispatcher:
             async_stats.dropped += 1
             return
         if not self._ready():
+            # prefetch watchdog: a batch in flight past the dispatch
+            # deadline cap means the kernel (or its tunnel) wedged.
+            # Abandon it — the worker stays parked inside the runtime
+            # (it blocks future launches via _live_thread, which is the
+            # degraded state: the prefetch channel goes dark, sync
+            # solving is untouched) and its lanes are simply never
+            # memoized, so nothing is lost but the idle-time win.
+            import os
+
+            deadline = float(
+                os.environ.get("MYTHRIL_TPU_DISPATCH_TIMEOUT", "120")
+            )
+            if time.monotonic() - self.pending["began"] > deadline:
+                from mythril_tpu.resilience.telemetry import resilience_stats
+
+                resilience_stats.watchdog_trips += 1
+                resilience_stats.demotions += 1
+                log.warning(
+                    "async prefetch exceeded the %.0fs dispatch deadline; "
+                    "abandoning the batch (prefetch channel demoted)",
+                    deadline,
+                )
+                self.pending = None
+                async_stats.dropped += 1
             return
         began = time.monotonic()
         pending, self.pending = self.pending, None
@@ -210,28 +238,48 @@ class AsyncDispatcher:
 _shutdown_join_registered = False
 
 
+def join_pending_at_exit() -> None:
+    """Join the in-flight worker with a BOUNDED deadline.  The old
+    unbounded-ish 60 s join meant a dispatch wedged at exit stalled
+    process teardown for a full minute per process (a corpus driver
+    fans out many); now the deadline is `MYTHRIL_TPU_SHUTDOWN_JOIN_S`
+    (default 10 s) and an abandoned dispatch is logged by name so the
+    stall is attributable.  The daemon worker then dies with the
+    process — the same teardown we'd have had, a minute sooner."""
+    import os
+
+    dispatcher = _dispatcher
+    if dispatcher is None:
+        return
+    thread = dispatcher._live_thread
+    if thread is None or not thread.is_alive():
+        return
+    try:
+        deadline = float(
+            os.environ.get("MYTHRIL_TPU_SHUTDOWN_JOIN_S", "10")
+        )
+    except ValueError:
+        deadline = 10.0
+    thread.join(timeout=deadline)
+    if thread.is_alive():
+        log.warning(
+            "abandoning in-flight async dispatch %r at exit "
+            "(did not finish within %.1fs)", thread.name, deadline,
+        )
+
+
 def _register_shutdown_join() -> None:
     """CPython finalization kills daemon threads at arbitrary points;
     a worker torn down inside XLA's C++ aborts the whole process
     (observed: exit 134, 'FATAL: exception not rethrown').  Join the
-    in-flight worker at exit — bounded, because it only blocks until
-    the launched kernel finishes; a wedged device falls through after
-    the timeout to the same teardown we'd have had anyway."""
+    in-flight worker at exit, bounded (see join_pending_at_exit)."""
     global _shutdown_join_registered
     if _shutdown_join_registered:
         return
     _shutdown_join_registered = True
     import atexit
 
-    def join_pending():
-        dispatcher = _dispatcher
-        if dispatcher is None:
-            return
-        thread = dispatcher._live_thread
-        if thread is not None and thread.is_alive():
-            thread.join(timeout=60.0)
-
-    atexit.register(join_pending)
+    atexit.register(join_pending_at_exit)
 
 
 _dispatcher: Optional[AsyncDispatcher] = None

@@ -130,9 +130,20 @@ class DispatchStats:
         # transaction seeds replaced by dispatcher pre-split states
         # (laser/ethereum/lockstep_dispatch.py)
         self.presplit_states = 0
+        # degradation counters (watchdog_trips, dispatch_retries,
+        # demotions, rpc_retries, faults_fired) live in the resilience
+        # package and reset with this object so per-contract rows stay
+        # per-contract
+        from mythril_tpu.resilience.telemetry import resilience_stats
+
+        resilience_stats.reset()
 
     def as_dict(self):
-        return dict(self.__dict__)
+        from mythril_tpu.resilience.telemetry import resilience_stats
+
+        d = dict(self.__dict__)
+        d.update(resilience_stats.as_dict())
+        return d
 
 
 dispatch_stats = DispatchStats()
@@ -457,6 +468,9 @@ class BatchedSatBackend:
         PallasSatBackend.check_assumption_sets).
         """
         from mythril_tpu.ops.pallas_prop import get_pallas_backend
+        from mythril_tpu.resilience.watchdog import (
+            DispatchAbandoned, get_watchdog,
+        )
 
         self.device_engaged = False
         pallas = get_pallas_backend()
@@ -464,9 +478,18 @@ class BatchedSatBackend:
             # fused MXU kernels over the per-call cone: dense incidence
             # matmuls, batched DPLL, no clause-width cap.  None means
             # the cone exceeded the dense caps — gather path below.
-            dense = pallas.check_assumption_sets(
-                ctx, assumption_sets, search=search
-            )
+            # Supervised: the dense path's chunk loops checkpoint
+            # raise_if_cancelled() before each ctx touch, so an
+            # abandoned worker can't race the host on the native pool.
+            try:
+                dense = get_watchdog().supervised(
+                    "pallas",
+                    lambda: pallas.check_assumption_sets(
+                        ctx, assumption_sets, search=search
+                    ),
+                )
+            except DispatchAbandoned as exc:
+                return self._abandon(ctx, exc, len(assumption_sets))
             if dense is not None:
                 results, assignments = dense
                 self.last_assignments = assignments
@@ -496,6 +519,8 @@ class BatchedSatBackend:
         batch = len(assumption_sets)
 
         self.device_engaged = True
+        from mythril_tpu.resilience import faults
+
         if len(jax.devices()) > 1:
             # multi-chip: lanes ride the dp axis, the clause pool is
             # sharded over cp with psum-merged BCP (parallel/mesh.py);
@@ -505,9 +530,24 @@ class BatchedSatBackend:
                 get_mesh, sharded_frontier_solve,
             )
 
-            final_assign, status = sharded_frontier_solve(
-                get_mesh(), self.pool.lits_np, assign,
-            )
+            pool_lits_np = self.pool.lits_np
+
+            def _solve_mesh():
+                faults.maybe_fault_dispatch()
+                fa, st = sharded_frontier_solve(
+                    get_mesh(), pool_lits_np, assign,
+                )
+                # np.asarray blocks until the kernel finished — this is
+                # exactly where a wedged tunnel parks, so it belongs
+                # inside the supervised region
+                return np.asarray(st), np.asarray(fa)
+
+            try:
+                status, final_assign = get_watchdog().supervised(
+                    "mesh", _solve_mesh
+                )
+            except DispatchAbandoned as exc:
+                return self._abandon(ctx, exc, batch)
             dispatch_stats.mesh_dispatches += 1
             # rows scanned per shard ride cp; absorbed CDCL learnts are
             # inside pool.filled (refresh folds them in above), so this
@@ -518,12 +558,24 @@ class BatchedSatBackend:
                 ctx, "absorbed_learnt_count", 0
             )
         else:
-            step = self._cached_step(self.pool.num_vars)
-            final_assign, status = step(
-                self.pool.lits, jnp.asarray(assign)
-            )
-        status = np.asarray(status)
-        final_assign = np.asarray(final_assign)
+            pool_lits = self.pool.lits
+            bucket = self.pool.num_vars
+
+            def _solve_gather():
+                faults.maybe_fault_dispatch()
+                step = self._cached_step(bucket)
+                fa, st = step(pool_lits, jnp.asarray(assign))
+                return np.asarray(st), np.asarray(fa)
+
+            try:
+                status, final_assign = get_watchdog().supervised(
+                    "gather", _solve_gather
+                )
+            except DispatchAbandoned as exc:
+                return self._abandon(ctx, exc, batch)
+        status, final_assign = faults.maybe_corrupt_lanes(
+            status, final_assign
+        )
 
         results: List[Optional[bool]] = []
         self.last_assignments = final_assign
@@ -533,6 +585,29 @@ class BatchedSatBackend:
             else:
                 results.append(None)  # candidate: host verifies the model
         return results
+
+    def _abandon(self, ctx, exc, batch: int):
+        """Terminal rung of the escalation ladder, context scope: the
+        watchdog gave up on this dispatch (and already re-probed /
+        process-demoted as warranted), so this analysis context goes to
+        the native CDCL tail — the same machinery the adaptive fuse
+        uses, with retries disabled (each fuse retry could wedge 10s+
+        again).  Every in-flight lane returns undecided, so the caller
+        re-solves it on the tail: no frontier state is dropped, findings
+        match the fault-free run, only the speedup is lost."""
+        self.device_engaged = False
+        self.futile_ctx_generation = ctx.generation
+        self.fused_generation = ctx.generation
+        self.fuse_was_slow = True
+        dispatch_stats.fused = True
+        log.warning(
+            "%s; context demoted to the native CDCL tail "
+            "(results unchanged, device speedup lost)", exc,
+        )
+        self.last_assignments = np.zeros(
+            (batch, ctx.solver.num_vars + 1), np.int8
+        )
+        return [None] * batch
 
     def _cached_step(self, bucket: int):
         """Jitted solve for a pool bucket, compiled at most once per
@@ -629,6 +704,11 @@ class BatchedSatBackend:
         lockstep step over the compact cone.  Returns per-lane
         verdicts like check_assumption_sets, or None when the cone
         does not fit the tier."""
+        from mythril_tpu.resilience import faults
+        from mythril_tpu.resilience.watchdog import (
+            DispatchAbandoned, get_watchdog,
+        )
+
         built = self._build_cone_batch(ctx, assumption_sets)
         if built is None:
             return None
@@ -641,9 +721,17 @@ class BatchedSatBackend:
                 get_mesh, sharded_frontier_solve,
             )
 
-            final_assign, status = sharded_frontier_solve(
-                get_mesh(), rows, assign
-            )
+            def _solve_mesh_cone():
+                faults.maybe_fault_dispatch()
+                fa, st = sharded_frontier_solve(get_mesh(), rows, assign)
+                return np.asarray(st), np.asarray(fa)
+
+            try:
+                status, final_assign = get_watchdog().supervised(
+                    "mesh", _solve_mesh_cone
+                )
+            except DispatchAbandoned as exc:
+                return self._abandon(ctx, exc, len(assumption_sets))
             dispatch_stats.mesh_dispatches += 1
             dispatch_stats.mesh_pool_rows = int(rows.shape[0])
             dispatch_stats.mesh_absorbed = getattr(
@@ -661,12 +749,22 @@ class BatchedSatBackend:
                               bucket + 1 - assign.shape[1]), np.int8)],
                     axis=1,
                 )
-            step = self._cached_step(bucket)
-            final_assign, status = step(
-                jnp.asarray(rows), jnp.asarray(assign)
-            )
-        status = np.asarray(status)
-        final_assign = np.asarray(final_assign)
+
+            def _solve_cone():
+                faults.maybe_fault_dispatch()
+                step = self._cached_step(bucket)
+                fa, st = step(jnp.asarray(rows), jnp.asarray(assign))
+                return np.asarray(st), np.asarray(fa)
+
+            try:
+                status, final_assign = get_watchdog().supervised(
+                    "cone", _solve_cone
+                )
+            except DispatchAbandoned as exc:
+                return self._abandon(ctx, exc, len(assumption_sets))
+        status, final_assign = faults.maybe_corrupt_lanes(
+            status, final_assign
+        )
         # expand the compact assignment back to full var space so the
         # caller's model extraction works unchanged
         V1 = ctx.solver.num_vars + 1
@@ -966,7 +1064,12 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         projected = len(rep_indices) * avg_native
         if projected < getattr(args, "device_min_save_s", 0.5):
             dispatch_stats.profit_skips += 1
-            if getattr(args, "async_dispatch", True):
+            if (
+                getattr(args, "async_dispatch", True)
+                # a demoted context must not keep feeding the wedged
+                # device through the prefetch side door
+                and get_backend().fused_generation != ctx.generation
+            ):
                 # not worth BLOCKING for — but the device is idle, so
                 # prefetch the batch asynchronously: refutations and
                 # models harvested on a later call only have to beat

@@ -7,13 +7,21 @@ This build does: the TPU is reached over a tunnel that can wedge, and
 both backend *initialization* and a ``block_until_ready`` on a wedged
 device block forever, taking the whole analysis with them.
 
-``device_ok()`` probes once per process: backend discovery plus a tiny
+``device_ok()`` probes at process start: backend discovery plus a tiny
 jitted reduction run in a daemon thread while the caller waits with a
 deadline.  On timeout the device is marked unhealthy and every device
 path (Pallas kernel, gather backend, mesh) degrades to the native CDCL
 solver — analysis results are identical, only the batching speedup is
 lost.  The probe thread is left behind on purpose: it is parked inside
 the runtime and will die with the process.
+
+The start-of-process verdict is no longer the whole failure story: a
+tunnel that wedges AFTER a healthy verdict is caught per dispatch by
+``resilience/watchdog.py``, whose escalation ladder re-probes through
+:func:`subprocess_probe_ok` and flips the cached verdict here through
+:func:`mark_unhealthy` when the device is really gone (process-level
+demotion).  The fault plane's ``probe_flap`` point drives the same
+transition deterministically in tests.
 
 Env overrides:
   MYTHRIL_TPU_HEALTH_TIMEOUT  probe deadline in seconds (default 60;
@@ -170,10 +178,27 @@ def _probe() -> bool:
     return result.get("value") == 8128
 
 
+def mark_unhealthy(reason: str) -> None:
+    """Flip the cached verdict to dead mid-run (process-level demotion,
+    the escalation ladder's last rung): every later device path
+    degrades through the existing ``unhealthy_skips`` machinery.
+    Results are unchanged — the native CDCL answers everything."""
+    global _verdict
+    with _lock:
+        _verdict = False
+    log.warning("device marked unhealthy mid-run: %s", reason)
+
+
 def device_ok() -> bool:
     """True when the default JAX backend initializes and answers a
-    trivial computation within the deadline.  Cached per process."""
+    trivial computation within the deadline.  Cached per process, but
+    the verdict can flip healthy -> dead mid-run (watchdog re-probe
+    failure, or an injected ``probe_flap``) — never dead -> healthy."""
     global _verdict
+    from mythril_tpu.resilience import faults
+
+    if faults.health_flap():
+        mark_unhealthy("injected probe flap")
     if _verdict is not None:
         return _verdict
     with _lock:

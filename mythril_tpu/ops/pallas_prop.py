@@ -878,6 +878,11 @@ class PallasSatBackend:
                 ctx, assumption_sets, union_ci, union_cv, interpret,
                 search,
             )
+        from mythril_tpu.resilience import faults
+
+        statuses, assignments = faults.maybe_corrupt_lanes(
+            statuses, assignments
+        )
         results: List[Optional[bool]] = [
             False if statuses[i] == 2 else None for i in range(batch)
         ]
@@ -917,7 +922,15 @@ class PallasSatBackend:
             DPLL_MAX_VARS_INTERPRET if interpret else DPLL_MAX_VARS
         )
         decisions = MAX_DECISIONS if (search and V <= search_ceiling) else 0
+        from mythril_tpu.resilience import faults
+        from mythril_tpu.resilience.watchdog import raise_if_cancelled
+
         for start in range(0, batch, chunk_lanes):
+            # supervised-dispatch checkpoints: an abandoned worker must
+            # bail here rather than touch shared context/device state
+            # while the host has already moved on to the CDCL tail
+            raise_if_cancelled()
+            faults.maybe_fault_dispatch()
             chunk = assumption_sets[start : start + chunk_lanes]
             B = max(8, _bucket(len(chunk), floor=8))
             A0 = np.zeros((B, V), dtype=np.float32)
@@ -983,7 +996,12 @@ class PallasSatBackend:
         decisions = (
             MAX_DECISIONS if (search and max_V <= search_ceiling) else 0
         )
+        from mythril_tpu.resilience import faults
+        from mythril_tpu.resilience.watchdog import raise_if_cancelled
+
         for start in range(0, batch, chunk_lanes):
+            raise_if_cancelled()
+            faults.maybe_fault_dispatch()
             chunk = assumption_sets[start : start + chunk_lanes]
             chunk_cones = lane_cones[start : start + chunk_lanes]
             B = _bucket(len(chunk), floor=min(8, chunk_lanes))

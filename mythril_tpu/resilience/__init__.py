@@ -1,0 +1,28 @@
+"""Resilience subsystem: fault injection, dispatch supervision, and
+degradation telemetry.
+
+The accelerator failure story used to end at process start:
+``ops/device_health.py`` probes once, and a tunnel that wedges *after*
+a healthy verdict parked ``block_until_ready`` forever.  This package
+closes that gap:
+
+- :mod:`faults` — a deterministic, env/API-configurable fault plane
+  with named injection points at every partial-failure seam (device
+  dispatch, health probe, native CDCL, async prefetch, RPC transport),
+  so every degradation path is testable on a CPU-only host;
+- :mod:`watchdog` — per-dispatch deadlines derived from the dispatch's
+  own observed latency EWMA, plus the escalation ladder a tripped
+  deadline walks (bounded retry with backoff → subprocess re-probe →
+  context demotion → process demotion);
+- :mod:`telemetry` — the counters (``watchdog_trips``,
+  ``dispatch_retries``, ``demotions``, ``rpc_retries``,
+  ``faults_fired``) threaded through the dispatch stats, the bench
+  headline, and the jsonv2 report.
+
+Design rule shared by every consumer: degradation never changes
+*results*, only who computes them — a demoted analysis re-solves every
+in-flight lane on the native CDCL tail, so findings are identical to
+the fault-free run and only the batching speedup is lost.
+"""
+
+from mythril_tpu.resilience.telemetry import resilience_stats  # noqa: F401

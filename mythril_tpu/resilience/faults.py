@@ -1,0 +1,239 @@
+"""Deterministic fault-injection plane.
+
+Every partial-failure seam in the system consults this plane through a
+named injection point, so every degradation path — watchdog trip,
+retry, re-probe, context/process demotion, RPC backoff — is testable
+on a CPU-only host with no real hardware failing.
+
+Injection points:
+
+==================  =====================================================
+``dispatch_hang``    a device dispatch blocks for ``hang_s`` seconds and
+                     then dies (models a wedged tunnel the runtime never
+                     returns from; the watchdog must trip first)
+``dispatch_error``   a device dispatch raises (stands in for
+                     ``XlaRuntimeError`` — the retry rung's territory)
+``dispatch_garbage`` a device dispatch returns corrupted lanes: every
+                     status flips to "SAT candidate" with a garbage
+                     assignment, which host-side model verification must
+                     reject (validates the safety net on the candidate
+                     path — device UNSAT soundness is a kernel contract,
+                     not something garbage can silently forge into
+                     findings)
+``probe_flap``       the health probe flips healthy → dead mid-run
+                     (``device_ok()`` starts answering False)
+``cdcl_error``       the native CDCL raises on solve (the authoritative
+                     tail's own retry rung)
+``prefetch_error``   the async prefetch worker raises mid-flight (the
+                     batch must be dropped, never decided)
+``rpc_error``        the RPC transport raises a transient ``OSError``
+``rpc_http_500``     the RPC transport answers HTTP 500
+==================  =====================================================
+
+Faults are armed either through the API (:meth:`FaultPlane.arm`) or the
+environment::
+
+    MYTHRIL_TPU_FAULT="dispatch_hang:3:1,rpc_error"
+
+Each comma-separated spec is ``point[:times[:skip]]`` — fire ``times``
+shots (default 1) after letting ``skip`` clean hits through (default 0,
+so ``skip`` is how a fault lands *mid*-analysis instead of on the first
+dispatch).  ``MYTHRIL_TPU_FAULT_HANG_S`` sets the hang duration
+(default 30 s — far past any test deadline, so an untripped watchdog is
+a loud failure, not a flake).
+
+Firing is deterministic: a shot is consumed per hit of the point, under
+a lock, with no randomness — the same schedule fires the same faults in
+the same order on every run.
+"""
+
+import logging
+import os
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from mythril_tpu.resilience.telemetry import resilience_stats
+
+log = logging.getLogger(__name__)
+
+FAULT_POINTS = (
+    "dispatch_hang",
+    "dispatch_error",
+    "dispatch_garbage",
+    "probe_flap",
+    "cdcl_error",
+    "prefetch_error",
+    "rpc_error",
+    "rpc_http_500",
+)
+
+DEFAULT_HANG_S = 30.0
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed error fault (stands in for XlaRuntimeError,
+    a native-solver abort, or a dropped socket, depending on the
+    injection point)."""
+
+
+class FaultPlane:
+    """Armed fault shots, keyed by injection point."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed: Dict[str, dict] = {}
+        self.fired: Dict[str, int] = {}
+        self._load_env()
+
+    # -- arming --------------------------------------------------------
+
+    def arm(self, point: str, times: int = 1, skip: int = 0,
+            hang_s: Optional[float] = None) -> None:
+        """Arm ``times`` shots of ``point``, skipping the first ``skip``
+        hits (a skip is how a fault lands mid-run)."""
+        if point not in FAULT_POINTS:
+            raise ValueError(
+                f"unknown fault point {point!r} (choose from {FAULT_POINTS})"
+            )
+        with self._lock:
+            self._armed[point] = {
+                "times": times, "skip": skip, "hang_s": hang_s,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._armed.clear()
+            self.fired.clear()
+
+    def _load_env(self) -> None:
+        spec = os.environ.get("MYTHRIL_TPU_FAULT", "").strip()
+        if not spec:
+            return
+        for part in spec.split(","):
+            fields = part.strip().split(":")
+            if not fields[0]:
+                continue
+            try:
+                self.arm(
+                    fields[0],
+                    times=int(fields[1]) if len(fields) > 1 else 1,
+                    skip=int(fields[2]) if len(fields) > 2 else 0,
+                )
+            except (ValueError, IndexError) as exc:
+                log.warning("ignoring bad MYTHRIL_TPU_FAULT spec %r (%s)",
+                            part, exc)
+
+    # -- firing --------------------------------------------------------
+
+    def fire(self, point: str) -> Optional[dict]:
+        """Consume one hit of ``point``.  Returns the armed spec when a
+        shot fires, None when the point is unarmed or the hit was a
+        configured skip.  The caller applies the effect."""
+        with self._lock:
+            spec = self._armed.get(point)
+            if spec is None:
+                return None
+            if spec["skip"] > 0:
+                spec["skip"] -= 1
+                return None
+            if spec["times"] <= 0:
+                return None
+            spec["times"] -= 1
+            self.fired[point] = self.fired.get(point, 0) + 1
+            resilience_stats.faults_fired += 1
+        log.info("fault plane: firing %s", point)
+        return spec
+
+
+_plane: Optional[FaultPlane] = None
+
+
+def get_fault_plane() -> FaultPlane:
+    global _plane
+    if _plane is None:
+        _plane = FaultPlane()
+    return _plane
+
+
+def reset_for_tests() -> None:
+    global _plane
+    _plane = None
+
+
+# ---------------------------------------------------------------------------
+# Seam helpers: each injection point's effect, applied where it fires
+# ---------------------------------------------------------------------------
+
+
+def _hang_s(spec: dict) -> float:
+    if spec.get("hang_s") is not None:
+        return float(spec["hang_s"])
+    return float(os.environ.get("MYTHRIL_TPU_FAULT_HANG_S", DEFAULT_HANG_S))
+
+
+def maybe_fault_dispatch() -> None:
+    """Device-dispatch seam: called inside the watchdog-supervised
+    thunk, so a hang is tripped by the deadline and an error lands in
+    the retry rung.  A hang sleeps and then RAISES (never falls through
+    to the real dispatch): a real wedge parks the worker inside the
+    runtime forever, so the worker resuming and racing the host would
+    be an artifact of injection, not a behavior to simulate."""
+    plane = get_fault_plane()
+    spec = plane.fire("dispatch_hang")
+    if spec is not None:
+        import time
+
+        time.sleep(_hang_s(spec))
+        raise FaultInjected("injected dispatch hang expired")
+    if plane.fire("dispatch_error") is not None:
+        raise FaultInjected(
+            "injected XlaRuntimeError: device dispatch failed"
+        )
+
+
+def maybe_corrupt_lanes(status: np.ndarray, assign: np.ndarray):
+    """Garbage-lane seam: when ``dispatch_garbage`` fires, every lane
+    claims a complete SAT candidate over a garbage assignment.  Host
+    model verification must reject them (lanes fall to the CDCL tail);
+    any other outcome is a detection-oracle failure the chaos tests
+    catch."""
+    if get_fault_plane().fire("dispatch_garbage") is None:
+        return status, assign
+    status = np.ones_like(status)
+    garbage = np.ones_like(assign)
+    garbage[..., ::2] = -1
+    return status, garbage
+
+
+def health_flap() -> bool:
+    """Health-probe seam: True when ``probe_flap`` fires — the caller
+    (ops/device_health.py) flips its cached verdict to dead."""
+    return get_fault_plane().fire("probe_flap") is not None
+
+
+def maybe_fault_cdcl() -> None:
+    """Native-CDCL seam (smt/bitblast.py check): raises when armed."""
+    if get_fault_plane().fire("cdcl_error") is not None:
+        raise FaultInjected("injected native CDCL abort")
+
+
+def maybe_fault_prefetch() -> None:
+    """Async-prefetch seam (ops/async_dispatch.py worker)."""
+    if get_fault_plane().fire("prefetch_error") is not None:
+        raise FaultInjected("injected prefetch worker failure")
+
+
+def maybe_fault_rpc() -> None:
+    """RPC-transport seam: raises the same exception types the real
+    transport does, so the injected failure walks the client's own
+    classification and retry path."""
+    if get_fault_plane().fire("rpc_error") is not None:
+        raise OSError("injected connection reset")
+    if get_fault_plane().fire("rpc_http_500") is not None:
+        import urllib.error
+
+        raise urllib.error.HTTPError(
+            "http://injected", 500, "injected server error", None, None
+        )

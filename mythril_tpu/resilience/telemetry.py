@@ -1,0 +1,34 @@
+"""Degradation telemetry counters.
+
+Kept in a leaf module (no imports beyond the stdlib) so the fault
+plane, the watchdog, the RPC client, and the dispatch stats can all
+increment/merge the same counters without import cycles.
+``DispatchStats.as_dict`` (ops/batched_sat.py) merges these into every
+per-contract bench row, ``bench.py`` sums them into the summary and
+headline, and the jsonv2 report attaches the nonzero subset to its
+``meta`` block — a degraded run is attributable from the artifact
+alone.
+"""
+
+
+class ResilienceStats:
+    """Process-wide degradation counters (reset per analyzed contract
+    alongside ``DispatchStats``)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.watchdog_trips = 0     # dispatch deadlines exceeded
+        self.dispatch_retries = 0   # ladder retries spent (device + CDCL)
+        self.demotions = 0          # contexts/channels demoted to the
+        #                             native CDCL tail (or prefetch
+        #                             channel abandoned)
+        self.rpc_retries = 0        # transient RPC failures retried
+        self.faults_fired = 0       # injected faults actually fired
+
+    def as_dict(self):
+        return dict(self.__dict__)
+
+
+resilience_stats = ResilienceStats()

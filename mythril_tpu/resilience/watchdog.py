@@ -1,0 +1,240 @@
+"""Per-dispatch watchdog and the escalation ladder.
+
+``ops/device_health.py`` probes the accelerator once per process; a
+tunnel that wedges *after* that healthy verdict used to park
+``block_until_ready`` forever and take the whole analysis with it (the
+zero-decision fuse in ops/batched_sat.py only catches dispatches that
+return).  This module bounds every device dispatch:
+
+- the dispatch thunk runs on a supervised worker thread joined with a
+  **deadline derived from the dispatch's own observed latency EWMA**
+  (``min(cap, max(floor, ewma * mult))``, cap =
+  ``MYTHRIL_TPU_DISPATCH_TIMEOUT``); a cold key (first dispatch of a
+  shape — jit compile dominates) gets the full cap;
+- a tripped deadline or a raised dispatch walks the **escalation
+  ladder**: bounded retry with exponential backoff + jitter →
+  killable-subprocess re-probe of the device → demote this analysis
+  context to the native CDCL tail (the caller's job, signaled by
+  :class:`DispatchAbandoned`) → demote the whole process when the
+  re-probe says the device is gone (``device_health.mark_unhealthy``,
+  which routes every later device path through the existing
+  ``unhealthy_skips`` machinery).
+
+Lanes in flight on an abandoned dispatch are returned as undecided, so
+the caller's CDCL tail re-solves them — no frontier state is ever
+dropped and findings are identical to the fault-free run; only the
+batching speedup is lost.
+
+A tripped worker is left parked on purpose (same policy as the health
+probe's thread): it is stuck inside the runtime and dies with the
+process.  Cooperative code that the worker would run *after* the
+runtime returns (host-side chunk loops that touch the blast context)
+must call :func:`raise_if_cancelled` between chunks so an abandoned
+worker can never race the host on shared native state.
+
+Env knobs:
+  MYTHRIL_TPU_DISPATCH_TIMEOUT   deadline cap in seconds (default 120;
+                                 first compile of a shape can be slow)
+  MYTHRIL_TPU_DISPATCH_RETRIES   ladder retries per dispatch (default 2)
+  MYTHRIL_TPU_DISPATCH_BACKOFF_S retry backoff base (default 0.05)
+  MYTHRIL_TPU_REPROBE_TIMEOUT    subprocess re-probe deadline (default 20)
+  MYTHRIL_TPU_REPROBE=0          skip the re-probe rung entirely
+"""
+
+import logging
+import os
+import random
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from mythril_tpu.resilience.telemetry import resilience_stats
+
+log = logging.getLogger(__name__)
+
+DEADLINE_FLOOR_S = 5.0   # warm deadlines never drop below this
+DEADLINE_MULT = 8.0      # deadline = EWMA x this (dispatch latency has
+#                          heavy tails: pool refresh, cache miss)
+EWMA_ALPHA = 0.3
+
+
+class WatchdogTimeout(RuntimeError):
+    """A supervised dispatch exceeded its deadline."""
+
+
+class WatchdogCancelled(RuntimeError):
+    """Raised inside an abandoned worker at its next cancellation
+    checkpoint (see :func:`raise_if_cancelled`)."""
+
+
+class DispatchAbandoned(RuntimeError):
+    """The escalation ladder gave up on this dispatch: the caller must
+    demote its context and leave every lane to the CDCL tail."""
+
+    def __init__(self, message: str, process_demoted: bool = False):
+        super().__init__(message)
+        self.process_demoted = process_demoted
+
+
+_tls = threading.local()
+
+
+def raise_if_cancelled() -> None:
+    """Cooperative cancellation checkpoint for supervised thunks.
+
+    Host-side stages inside a supervised dispatch (per-chunk cone
+    remaps etc.) call this before touching shared context state; after
+    the watchdog abandons the dispatch the next checkpoint raises, so a
+    late-waking worker can never race the host on the native pool."""
+    event = getattr(_tls, "cancel_event", None)
+    if event is not None and event.is_set():
+        raise WatchdogCancelled("dispatch abandoned by watchdog")
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class DispatchWatchdog:
+    """Deadline supervision + the escalation ladder, with a per-key
+    latency EWMA (keys name dispatch shapes: 'gather', 'cone', 'mesh',
+    'pallas' — their latency regimes differ by orders of magnitude)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ewma: Dict[str, float] = {}
+
+    # -- deadline model ------------------------------------------------
+
+    def deadline_for(self, key: str) -> float:
+        cap = _env_f("MYTHRIL_TPU_DISPATCH_TIMEOUT", 120.0)
+        ewma = self._ewma.get(key)
+        if ewma is None:
+            return cap  # cold key: jit compile dominates, grant the cap
+        return min(cap, max(DEADLINE_FLOOR_S, ewma * DEADLINE_MULT))
+
+    def observe(self, key: str, elapsed_s: float) -> None:
+        with self._lock:
+            prev = self._ewma.get(key)
+            self._ewma[key] = (
+                elapsed_s if prev is None
+                else prev + EWMA_ALPHA * (elapsed_s - prev)
+            )
+
+    # -- one supervised attempt ----------------------------------------
+
+    def run(self, key: str, thunk: Callable):
+        """One attempt of ``thunk`` on a worker thread, joined with the
+        key's deadline.  Success records the latency; a deadline miss
+        raises :class:`WatchdogTimeout` (the worker is left parked and
+        flagged cancelled); a thunk exception re-raises here."""
+        deadline = self.deadline_for(key)
+        cancel = threading.Event()
+        box: dict = {}
+
+        def work():
+            _tls.cancel_event = cancel
+            try:
+                box["result"] = thunk()
+            except BaseException as exc:  # noqa: BLE001 — re-raised on host
+                box["error"] = exc
+
+        thread = threading.Thread(
+            target=work, daemon=True, name=f"dispatch-watchdog-{key}"
+        )
+        began = time.monotonic()
+        thread.start()
+        thread.join(deadline)
+        if thread.is_alive():
+            cancel.set()
+            raise WatchdogTimeout(
+                f"{key} dispatch exceeded its {deadline:.1f}s deadline"
+            )
+        if "error" in box:
+            raise box["error"]
+        self.observe(key, time.monotonic() - began)
+        return box["result"]
+
+    # -- the escalation ladder -----------------------------------------
+
+    def supervised(self, key: str, thunk: Callable):
+        """Run ``thunk`` under the full ladder; returns its result or
+        raises :class:`DispatchAbandoned` after every rung failed."""
+        retries = int(_env_f("MYTHRIL_TPU_DISPATCH_RETRIES", 2))
+        backoff = _env_f("MYTHRIL_TPU_DISPATCH_BACKOFF_S", 0.05)
+        last: Optional[BaseException] = None
+        for attempt in range(retries + 1):
+            if attempt:
+                resilience_stats.dispatch_retries += 1
+                # exponential backoff + jitter: a struggling (not dead)
+                # tunnel gets air between attempts, and concurrent
+                # analyzer processes don't re-dispatch in lockstep
+                time.sleep(
+                    backoff * (2 ** (attempt - 1)) * (1 + random.random())
+                )
+            try:
+                return self.run(key, thunk)
+            except WatchdogTimeout as exc:
+                resilience_stats.watchdog_trips += 1
+                last = exc
+                log.warning("%s (attempt %d/%d)", exc, attempt + 1,
+                            retries + 1)
+            except WatchdogCancelled:
+                raise  # only ever raised inside workers, never here
+            except Exception as exc:  # noqa: BLE001 — device/runtime error
+                last = exc
+                log.warning(
+                    "%s dispatch raised (%s: %s) (attempt %d/%d)",
+                    key, type(exc).__name__, exc, attempt + 1, retries + 1,
+                )
+        process_demoted = self._reprobe_and_maybe_demote(key, last)
+        resilience_stats.demotions += 1
+        raise DispatchAbandoned(
+            f"{key} dispatch abandoned after {retries + 1} attempts "
+            f"({last})",
+            process_demoted=process_demoted,
+        )
+
+    def _reprobe_and_maybe_demote(self, key: str, last) -> bool:
+        """Ladder rung 3: ask a killable subprocess whether the device
+        still answers.  A dead probe demotes the whole process (every
+        later device path degrades via ``unhealthy_skips``); a live one
+        demotes only the calling context (the caller's job).  Skipped
+        on CPU-pinned processes — there is no tunnel to probe, the
+        failure is local."""
+        if os.environ.get("MYTHRIL_TPU_REPROBE", "1").lower() in ("0", "off"):
+            return False
+        if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+            return False
+        from mythril_tpu.ops.device_health import (
+            mark_unhealthy, subprocess_probe_ok,
+        )
+
+        if subprocess_probe_ok(
+            timeout_s=_env_f("MYTHRIL_TPU_REPROBE_TIMEOUT", 20.0)
+        ):
+            log.warning(
+                "device re-probe healthy after abandoned %s dispatch; "
+                "demoting this context only", key,
+            )
+            return False
+        mark_unhealthy(f"re-probe failed after abandoned {key} dispatch")
+        return True
+
+
+_watchdog: Optional[DispatchWatchdog] = None
+
+
+def get_watchdog() -> DispatchWatchdog:
+    global _watchdog
+    if _watchdog is None:
+        _watchdog = DispatchWatchdog()
+    return _watchdog
+
+
+def reset_for_tests() -> None:
+    global _watchdog
+    _watchdog = None

@@ -667,7 +667,7 @@ class BlastContext:
             self.solver.set_relevant([])
         stats.cone_s += time.monotonic() - t0
         t0 = time.monotonic()
-        status = self.solver.solve(assumptions, conflict_budget, timeout_s)
+        status = self._solve_native(assumptions, conflict_budget, timeout_s)
         stats.native_s += time.monotonic() - t0
         stats.native_calls += 1
         if status != SatSolver.SAT:
@@ -679,6 +679,36 @@ class BlastContext:
         env = self._extract_model()
         self._remember_model(env)
         return status, env
+
+    def _solve_native(self, assumptions, conflict_budget, timeout_s) -> int:
+        """One native CDCL solve with the tail's own resilience rung:
+        the CDCL is the authoritative LAST rung of the degradation
+        ladder (everything above demotes onto it), so a raise here gets
+        one bounded retry; a second failure degrades the single query
+        to UNKNOWN (callers over-approximate: the state stays feasible,
+        the detection oracle is never starved by a dropped lane) rather
+        than killing the whole analysis."""
+        from mythril_tpu.resilience import faults
+
+        try:
+            faults.maybe_fault_cdcl()
+            return self.solver.solve(assumptions, conflict_budget, timeout_s)
+        except Exception as exc:  # noqa: BLE001 — native abort / injected
+            from mythril_tpu.resilience.telemetry import resilience_stats
+
+            resilience_stats.dispatch_retries += 1
+            log.warning("native CDCL solve raised (%s); retrying once", exc)
+            try:
+                faults.maybe_fault_cdcl()
+                return self.solver.solve(
+                    assumptions, conflict_budget, timeout_s
+                )
+            except Exception as exc2:  # noqa: BLE001
+                log.error(
+                    "native CDCL solve failed twice (%s); answering "
+                    "UNKNOWN for this query", exc2,
+                )
+                return SatSolver.UNKNOWN
 
     # ------------------------------------------------------------------
     # word-level candidate probing (pre-CDCL fast path)

@@ -1,0 +1,143 @@
+"""Chaos soak: run the bench corpus under a randomized fault schedule.
+
+Manual driver (not CI — the deterministic tier-1 chaos tests live in
+tests/test_faults.py).  Each round analyzes the embedded corpus with a
+randomly drawn fault armed on the resilience plane mid-run, then checks
+the two ladder invariants:
+
+- findings identical to the fault-free reference run;
+- the matching degradation counter moved.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/chaos_corpus.py [--rounds N] [--seed S]
+
+Exit status is nonzero when any round broke findings parity, so the
+script doubles as a soak gate before hardware rounds.
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# fault -> (arm kwargs, env overrides, args overrides for the round)
+SCHEDULE = {
+    "dispatch_hang": (
+        {"times": 99, "hang_s": 1.0},
+        {"MYTHRIL_TPU_DISPATCH_TIMEOUT": "0.4"},
+        {},
+    ),
+    "dispatch_error": ({"times": 99}, {}, {}),
+    "dispatch_garbage": ({"times": 99}, {}, {}),
+    "probe_flap": ({"times": 1, "skip": 1}, {}, {}),
+    "cdcl_error": ({"times": 1}, {}, {}),
+    # prefetch only launches when the profit gate declines a frontier,
+    # so this round must not force dispatch
+    "prefetch_error": ({"times": 99}, {}, {"device_force_dispatch": False}),
+}
+
+
+def _analyze_corpus():
+    """One pass over the embedded corpus plus the wide-frontier chaos
+    tree (the contract whose dispatches the faults actually hit);
+    returns {name: found_swcs} plus the summed resilience counters."""
+    import bench
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+    from mythril_tpu.resilience.telemetry import resilience_stats
+
+    cases = bench._corpus() + [
+        ("chaos_tree", bench.chaos_tree_contract(), 1, {"106"})
+    ]
+    results = {}
+    counters = dict.fromkeys(resilience_stats.as_dict(), 0)
+    for name, code, tx_count, _expected in cases:
+        found, row = bench._analyze_one(
+            name, code, tx_count, execution_timeout=120, max_depth=128
+        )
+        results[name] = sorted(found)
+        for key in counters:
+            counters[key] += row.get(key, 0)
+    dispatch_stats.reset()
+    return results, counters
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--rounds", type=int, default=6)
+    parser.add_argument("--seed", type=int, default=1337)
+    args_ns = parser.parse_args()
+    rng = random.Random(args_ns.seed)
+
+    import logging
+
+    logging.basicConfig(level=logging.ERROR)
+    from mythril_tpu.resilience import faults
+    from mythril_tpu.support.support_args import args
+
+    # the chaos schedule must actually reach the device paths
+    args.device_min_lanes = 2
+    args.device_force_dispatch = True
+    args.word_probing = False
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        # auto mode refuses gather dispatch on a CPU-only host (the
+        # cpu_auto_skips gate); pin the gather path explicitly so the
+        # injected dispatch faults have a dispatch to hit
+        os.environ.setdefault("MYTHRIL_TPU_PALLAS", "off")
+
+    print("reference (fault-free) pass ...", file=sys.stderr)
+    reference, _ = _analyze_corpus()
+    print(json.dumps({"reference": reference}), file=sys.stderr)
+
+    failures = []
+    for round_no in range(args_ns.rounds):
+        fault = rng.choice(sorted(SCHEDULE))
+        arm_kwargs, env, arg_overrides = SCHEDULE[fault]
+        saved = {k: os.environ.get(k) for k in env}
+        saved_args = {k: getattr(args, k) for k in arg_overrides}
+        os.environ.update(env)
+        for key, value in arg_overrides.items():
+            setattr(args, key, value)
+        faults.reset_for_tests()
+        faults.get_fault_plane().arm(fault, **arm_kwargs)
+        began = time.time()
+        try:
+            found, counters = _analyze_corpus()
+        finally:
+            faults.reset_for_tests()
+            for key, value in saved.items():
+                if value is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = value
+            for key, value in saved_args.items():
+                setattr(args, key, value)
+            from mythril_tpu.ops import device_health
+
+            device_health.reset_for_tests()  # undo probe flaps
+        parity = found == reference
+        row = {
+            "round": round_no,
+            "fault": fault,
+            "wall_s": round(time.time() - began, 1),
+            "findings_parity": parity,
+            "counters": {k: v for k, v in counters.items() if v},
+        }
+        print(json.dumps(row))
+        if not parity:
+            failures.append(
+                {"round": round_no, "fault": fault,
+                 "found": found, "reference": reference}
+            )
+    if failures:
+        print(json.dumps({"chaos_failures": failures}))
+        return 1
+    print(json.dumps({"chaos_ok": True, "rounds": args_ns.rounds}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

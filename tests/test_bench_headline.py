@@ -62,3 +62,18 @@ def test_headline_mesh_row_not_ok_without_dispatches():
         bench.build_headline_line(dict(BASE_SUMMARY), mesh, None)
     )
     assert payload["mesh_row_ok"] is False
+
+
+def test_headline_carries_degradation_counters():
+    """Chaos/flaky-hardware rounds are judged on the headline alone, so
+    the ladder counters must ride it (and default to 0 when a summary
+    predates them)."""
+    payload = json.loads(
+        bench.build_headline_line(dict(BASE_SUMMARY), None, None)
+    )
+    assert payload["watchdog_trips"] == 0
+    assert payload["demotions"] == 0
+    summary = dict(BASE_SUMMARY, watchdog_trips=4, demotions=2)
+    payload = json.loads(bench.build_headline_line(summary, None, None))
+    assert payload["watchdog_trips"] == 4
+    assert payload["demotions"] == 2

@@ -129,3 +129,96 @@ def test_request_ids_increment():
             client.eth_getCode("0x" + "55" * 20)
         ids.append(captured["payload"]["id"])
     assert ids == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# transient-failure retries (resilience satellite): bounded attempts,
+# exponential backoff, fault-plane injection without a network
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    import mythril_tpu.ethereum.interface.rpc.client as rpc_client
+    from mythril_tpu.resilience import faults
+    from mythril_tpu.resilience.telemetry import resilience_stats
+
+    monkeypatch.setattr(rpc_client, "RPC_BACKOFF_BASE_S", 0.001)
+    faults.reset_for_tests()
+    resilience_stats.reset()
+    yield
+    faults.reset_for_tests()
+    resilience_stats.reset()
+
+
+def test_transient_oserror_is_retried_to_success():
+    from mythril_tpu.resilience.telemetry import resilience_stats
+
+    client = EthJsonRpc()
+    calls = {"n": 0}
+    good = json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "result": "0x6001"}
+    ).encode()
+
+    def flaky_urlopen(request, timeout=None):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("connection reset by peer")
+        return _Response(good)
+
+    with mock.patch("urllib.request.urlopen", side_effect=flaky_urlopen):
+        assert client.eth_getCode("0x" + "66" * 20) == "0x6001"
+    assert calls["n"] == 3
+    assert resilience_stats.rpc_retries == 2
+
+
+def test_persistent_5xx_exhausts_retries():
+    import urllib.error
+
+    calls = {"n": 0}
+
+    def always_500(request, timeout=None):
+        calls["n"] += 1
+        raise urllib.error.HTTPError("http://n", 500, "boom", None, None)
+
+    with mock.patch("urllib.request.urlopen", side_effect=always_500):
+        with pytest.raises(BadStatusCodeError):
+            EthJsonRpc().eth_getCode("0x" + "66" * 20)
+    from mythril_tpu.ethereum.interface.rpc.client import RPC_MAX_ATTEMPTS
+
+    assert calls["n"] == RPC_MAX_ATTEMPTS
+
+
+def test_4xx_fails_immediately_without_retry():
+    import urllib.error
+
+    calls = {"n": 0}
+
+    def not_found(request, timeout=None):
+        calls["n"] += 1
+        raise urllib.error.HTTPError("http://n", 404, "nope", None, None)
+
+    with mock.patch("urllib.request.urlopen", side_effect=not_found):
+        with pytest.raises(BadStatusCodeError):
+            EthJsonRpc().eth_getCode("0x" + "66" * 20)
+    assert calls["n"] == 1, "4xx is not transient; retrying repeats it"
+
+
+def test_fault_plane_injects_transient_failures_without_a_network():
+    """The rpc_error injection point raises before the transport is
+    touched, so the retry path is exercised hermetically — the second
+    attempt reaches the (mocked) network and succeeds."""
+    from mythril_tpu.resilience import faults
+    from mythril_tpu.resilience.telemetry import resilience_stats
+
+    faults.get_fault_plane().arm("rpc_error", times=1)
+    client = EthJsonRpc()
+    with _transport(result="0xabc") as captured:
+        assert client.eth_getCode("0x" + "77" * 20) == "0xabc"
+    assert captured["payload"]["method"] == "eth_getCode"
+    assert resilience_stats.rpc_retries == 1
+
+    faults.get_fault_plane().arm("rpc_http_500", times=1)
+    with _transport(result="0xdef"):
+        assert client.eth_getCode("0x" + "77" * 20) == "0xdef"
+    assert resilience_stats.rpc_retries == 2
