@@ -682,8 +682,18 @@ def _scale_summary(row):
         "device_s", "found", "unhealthy_skips", "cpu_auto_skips",
         "profit_skips", "mesh_dispatches", "device_status",
         "watchdog_trips", "dispatch_retries", "demotions",
+        # straggler-aware sweep scheduling (round ladder + coalescer)
+        "rounds", "repacks", "coalesced_dispatches", "coalesce_deferred",
+        "lane_sweeps_active", "lane_sweeps_total",
+        "lane_slots_filled", "lane_slots_total",
     )
-    return {k: row[k] for k in keys if k in row}
+    out = {k: row[k] for k in keys if k in row}
+    total = out.get("lane_sweeps_total", 0)
+    if total:
+        out["sweep_util"] = round(
+            out.get("lane_sweeps_active", 0) / total, 3
+        )
+    return out
 
 
 def build_headline_line(summary, mesh_scale, microbench) -> str:
@@ -706,6 +716,11 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
         # flaky hardware (the acceptance signal for chaos runs)
         "watchdog_trips": summary.get("watchdog_trips", 0),
         "demotions": summary.get("demotions", 0),
+        # sweep utilization: lane_sweeps_active / lane_sweeps_total
+        # over every dispatching pass of the round (straggler-aware
+        # scheduling headline; 1.0 = no lane ever idled through a
+        # sibling's search, null = nothing dispatched)
+        "sweep_util": summary.get("sweep_util"),
     }
     if "t3_wall_s" in summary:
         headline["t3_wall_s"] = summary["t3_wall_s"]
@@ -723,7 +738,7 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
     line = json.dumps(headline)
     if len(line) > 500:  # hard cap so the tail capture can never lose it
         for key in ("microbench_speedup", "microbench_device_warm_s",
-                    "mesh_row_ok", "t3_wall_s", "error",
+                    "mesh_row_ok", "sweep_util", "t3_wall_s", "error",
                     "watchdog_trips", "demotions"):
             headline.pop(key, None)
             line = json.dumps(headline)
@@ -843,6 +858,20 @@ def main() -> None:
         "cpu_auto_skips": sum(r["cpu_auto_skips"] for r in rows),
         "profit_skips": sum(r["profit_skips"] for r in rows),
         "mesh_dispatches": sum(r["mesh_dispatches"] for r in rows),
+        # straggler-aware sweep scheduling: budgeted rounds, survivor
+        # re-packs, coalesced dispatches, and the lane-sweep split the
+        # headline sweep_util ratio is computed from
+        "rounds": sum(r.get("rounds", 0) for r in rows),
+        "repacks": sum(r.get("repacks", 0) for r in rows),
+        "coalesced_dispatches": sum(
+            r.get("coalesced_dispatches", 0) for r in rows
+        ),
+        "lane_sweeps_active": sum(
+            r.get("lane_sweeps_active", 0) for r in rows
+        ),
+        "lane_sweeps_total": sum(
+            r.get("lane_sweeps_total", 0) for r in rows
+        ),
         # degradation ladder telemetry (resilience/): a faulted or
         # flaky-device round is attributable from the artifact alone
         "watchdog_trips": sum(r.get("watchdog_trips", 0) for r in rows),
@@ -881,6 +910,18 @@ def main() -> None:
             summary["t3_error"] = f"t3 missed findings: {t3_missed}"
     summary["solver_batch_microbench"] = microbench
     summary["scale_mesh_virtual"] = mesh_scale
+    # headline sweep utilization: over the corpus pass AND the scale
+    # scenarios (the corpus's narrow frontiers rarely dispatch, so the
+    # scale rows are where the ratio carries signal)
+    util_active = summary["lane_sweeps_active"] + sum(
+        r.get("lane_sweeps_active", 0) for r in scale_rows.values()
+    )
+    util_total = summary["lane_sweeps_total"] + sum(
+        r.get("lane_sweeps_total", 0) for r in scale_rows.values()
+    )
+    summary["sweep_util"] = (
+        round(util_active / util_total, 3) if util_total else None
+    )
     for (label, run_mode), row in scale_rows.items():
         key = label if run_mode == mode else f"{label}_{run_mode}"
         summary[key] = _scale_summary(row)

@@ -39,6 +39,13 @@ log = logging.getLogger(__name__)
 MAX_CLAUSE_WIDTH = 8  # wider clauses stay CPU-only (soundness preserved)
 GATHER_STEPS = 768     # DPLL sweep budget (one clause scan per step)
 GATHER_DECISIONS = 256  # decision-stack depth before bailing to CDCL
+# Round-ladder budgets for the gather/cone tiers (see pallas_prop's
+# ROUND_BUDGETS for the rationale): a FIXED geometric set so per-round
+# shapes reuse the existing bucket grid; the last entry repeats until
+# GATHER_STEPS is covered.  Watchdog EWMA keys carry the round budget
+# ("gather:64" vs "gather:512") — a re-packed 64-step round must not
+# inherit the deadline model of a 512-step one and trip false alarms.
+GATHER_ROUND_BUDGETS = (64, 256, 512)
 MAX_GATHER_CLAUSES = 8192  # beyond this the full-pool gather probe loses
 MAX_GATHER_VARS = 8192     # to the CDCL tail outright (see check_assumption_sets)
 # Union-cone gather tier (VERDICT r4 #4/#7): when the POOL outgrows the
@@ -114,6 +121,28 @@ class DispatchStats:
         # total DPLL sweeps the dense kernel ran (wall-clock breakdown:
         # device solve time ≈ sweeps x per-sweep cost for the shape)
         self.device_sweeps = 0
+        # straggler-aware sweep scheduling (round ladder; this PR):
+        # lane_sweeps_total = sweeps x lane-bucket width (the MXU work
+        # actually burned); lane_sweeps_active = per-lane live sweeps
+        # (work that could still decide something).  Their ratio is the
+        # headline sweep-utilization number — 1.0 means no lane ever
+        # idled through a sibling's search.
+        self.lane_sweeps_active = 0
+        self.lane_sweeps_total = 0
+        self.rounds = 0            # budgeted solve rounds executed
+        self.repacks = 0           # survivor re-packs into smaller buckets
+        # cross-dispatch lane coalescing (ops/coalesce.py): dispatches
+        # that carried merged lanes from the admission queue, and lanes
+        # deferred into the queue (their round fell back to the CDCL
+        # tail; the merged dispatch pays them back via memos/nogoods)
+        self.coalesced_dispatches = 0
+        self.coalesced_lanes = 0
+        self.coalesce_deferred = 0
+        # lane-bucket utilization (satellite: bucket stats): real lanes
+        # vs bucket slots across dispatches — shows the coalescer's
+        # fill effect in bench rows independent of sweep counts
+        self.lane_slots_filled = 0
+        self.lane_slots_total = 0
         # wall-clock spent inside device dispatches (cone + build +
         # solve + fetch), for the bench breakdown
         self.device_s = 0.0
@@ -137,6 +166,12 @@ class DispatchStats:
         from mythril_tpu.resilience.telemetry import resilience_stats
 
         resilience_stats.reset()
+        # the admission queue is generation-scoped; clearing it with the
+        # stats keeps per-contract bench rows from inheriting a stale
+        # window (lazy import — coalesce reads these stats back)
+        from mythril_tpu.ops.coalesce import reset_coalescer
+
+        reset_coalescer()
 
     def as_dict(self):
         from mythril_tpu.resilience.telemetry import resilience_stats
@@ -234,38 +269,26 @@ class DevicePool:
         return True
 
 
-def build_solve_lane(
+def build_round_lane(
     num_vars: int,
-    reduce_hook=None,
-    max_steps: int = GATHER_STEPS,
+    budget: int,
     max_decisions: int = GATHER_DECISIONS,
+    reduce_hook=None,
 ):
-    """Build the per-lane gather-style DPLL solve function (traceable).
+    """Resumable per-lane DPLL round (traceable; the round-ladder core
+    of the gather tier).
 
-    ``solve_lane(lits[C,K], assign[V+1]) -> (assign', status)``
-    with status 0 = undecided (budget exhausted), 1 = complete
-    satisfying assignment for the device clause subset (the host must
-    verify it against the original terms — wide clauses are dropped
-    from the gather pool), 2 = sound UNSAT (BCP conflict at zero
-    decisions, or a DPLL search that exhausted both phases of every
-    decision — sound under clause subsets, since a subset being
-    unsatisfiable under the lane's assumptions makes the full pool
-    unsatisfiable under them).
-
-    The search is chronological DPLL: trail levels per variable, an
-    explicit decision stack, dynamic DLIS decisions (the free variable
-    with the most open-clause occurrences, majority polarity), and
-    backtracking to the deepest unflipped decision on conflict.  One
-    step = one clause scan; everything lives in a single
-    ``lax.while_loop`` so the whole search is one XLA program.
-
-    This single definition backs both the single-chip jit path
-    (``make_solve_step``) and the mesh-sharded path (parallel/mesh.py),
-    which passes a ``reduce_hook(pos, neg, conflict, spos, sneg)``
-    merging forced-literal votes, conflict flags and decision scores
-    across clause shards (psum over the ``cp`` mesh axis); the merged
-    quantities are identical on every clause shard, so all replicas of
-    a lane take the same decisions and stay in lockstep.
+    ``round_lane(lits[C,K], assign, lvl, dvar, dphase, dflip, depth,
+    status, step) -> same tuple`` advances the lane's search by at most
+    ``budget`` sweeps from the given state.  Status is RAW: 0 live,
+    1 complete assignment for the device clause subset (host verifies),
+    2 sound UNSAT, 3 decision-stack bail (the ladder retires such lanes
+    as undecided and never re-enters them).  ``step`` must be zeroed by
+    the caller per round; on return it holds the lane's OWN active
+    sweep count for the round (under vmap the loop runs to the slowest
+    live lane, but each lane's carry freezes once its cond fails), so
+    the driver reads total iterations as max(step) and per-lane active
+    work as sum(step) — the sweep-utilization split.
     """
     jax, jnp = _require_jax()
 
@@ -308,7 +331,8 @@ def build_solve_lane(
         )
         return forced_pos, forced_neg, conflict, spos, sneg
 
-    def solve_lane(lits, assign_lane):
+    def round_lane(lits, assign, lvl0, dvar0, dphase0, dflip0, depth0,
+                   status0, step0):
         idx = jnp.arange(V1)
         didx = jnp.arange(D)  # slot l holds decision level l+1
 
@@ -396,10 +420,59 @@ def build_solve_lane(
                     step + 1)
 
         def cond(carry):
-            return (carry[6] == 0) & (carry[7] < max_steps)
+            return (carry[6] == 0) & (carry[7] < budget)
 
-        init = (
-            assign_lane,
+        init = (assign, lvl0, dvar0, dphase0, dflip0, depth0, status0,
+                step0)
+        return jax.lax.while_loop(cond, body, init)
+
+    return round_lane
+
+
+def build_solve_lane(
+    num_vars: int,
+    reduce_hook=None,
+    max_steps: int = GATHER_STEPS,
+    max_decisions: int = GATHER_DECISIONS,
+):
+    """Build the per-lane gather-style DPLL solve function (traceable).
+
+    ``solve_lane(lits[C,K], assign[V+1]) -> (assign', status)``
+    with status 0 = undecided (budget exhausted), 1 = complete
+    satisfying assignment for the device clause subset (the host must
+    verify it against the original terms — wide clauses are dropped
+    from the gather pool), 2 = sound UNSAT (BCP conflict at zero
+    decisions, or a DPLL search that exhausted both phases of every
+    decision — sound under clause subsets, since a subset being
+    unsatisfiable under the lane's assumptions makes the full pool
+    unsatisfiable under them).
+
+    The search is chronological DPLL: trail levels per variable, an
+    explicit decision stack, dynamic DLIS decisions (the free variable
+    with the most open-clause occurrences, majority polarity), and
+    backtracking to the deepest unflipped decision on conflict.  One
+    step = one clause scan; the search core is the resumable
+    :func:`build_round_lane` run as a single full-budget round.
+
+    This single definition backs the one-shot jit path
+    (``make_solve_step``, used by the async prefetch runner) and the
+    mesh-sharded path (parallel/mesh.py), which passes a
+    ``reduce_hook(pos, neg, conflict, spos, sneg)`` merging
+    forced-literal votes, conflict flags and decision scores across
+    clause shards (psum over the ``cp`` mesh axis); the merged
+    quantities are identical on every clause shard, so all replicas of
+    a lane take the same decisions and stay in lockstep.
+    """
+    _, jnp = _require_jax()
+
+    V1 = num_vars + 1
+    D = max(1, min(max_decisions, V1))
+    rnd = build_round_lane(num_vars, max_steps, max_decisions,
+                           reduce_hook)
+
+    def solve_lane(lits, assign_lane):
+        out = rnd(
+            lits, assign_lane,
             jnp.zeros(V1, dtype=jnp.int32),
             jnp.zeros(D, dtype=jnp.int32),
             jnp.zeros(D, dtype=jnp.int8),
@@ -408,7 +481,6 @@ def build_solve_lane(
             jnp.int32(0),
             jnp.int32(0),
         )
-        out = jax.lax.while_loop(cond, body, init)
         assign, status = out[0], out[6]
         status = jnp.where(status == 3, 0, status)  # bailed = undecided
         return assign, status
@@ -423,6 +495,27 @@ def make_solve_step(num_vars: int):
 
     batched = jax.vmap(build_solve_lane(num_vars), in_axes=(None, 0))
     return jax.jit(batched)
+
+
+def make_round_step(num_vars: int, budget: int):
+    """Jitted batched round for the gather ladder:
+    fn(lits[C,K], *state[B, ...]) -> state' (see build_round_lane)."""
+    jax, _ = _require_jax()
+
+    batched = jax.vmap(
+        build_round_lane(num_vars, budget),
+        in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0),
+    )
+    return jax.jit(batched)
+
+
+def lane_bucket(n: int, floor: int = 4) -> int:
+    """Power-of-two lane-bucket width (shared with the coalescer, whose
+    fill targets must match the shapes the ladder actually runs)."""
+    size = floor
+    while size < n:
+        size *= 2
+    return size
 
 
 class BatchedSatBackend:
@@ -558,18 +651,12 @@ class BatchedSatBackend:
                 ctx, "absorbed_learnt_count", 0
             )
         else:
-            pool_lits = self.pool.lits
-            bucket = self.pool.num_vars
-
-            def _solve_gather():
-                faults.maybe_fault_dispatch()
-                step = self._cached_step(bucket)
-                fa, st = step(pool_lits, jnp.asarray(assign))
-                return np.asarray(st), np.asarray(fa)
-
+            # round-laddered lockstep solve: budgeted rounds, lane
+            # retirement, bucket re-packing (supervision + fault
+            # injection happen per round inside the ladder)
             try:
-                status, final_assign = get_watchdog().supervised(
-                    "gather", _solve_gather
+                status, final_assign = self._solve_gather_ladder(
+                    "gather", self.pool.lits, assign
                 )
             except DispatchAbandoned as exc:
                 return self._abandon(ctx, exc, batch)
@@ -610,21 +697,140 @@ class BatchedSatBackend:
         return [None] * batch
 
     def _cached_step(self, bucket: int):
-        """Jitted solve for a pool bucket, compiled at most once per
-        bucket (thread-safe: shared by the sync path and the async
-        prefetch worker).  Bounded to a few live shapes."""
+        """Jitted one-shot solve for a pool bucket, compiled at most
+        once per bucket (thread-safe: shared by the sync path and the
+        async prefetch worker).  Bounded to a few live shapes."""
+        return self._cached(("solve", bucket),
+                            lambda: make_solve_step(bucket))
+
+    def _cached_round(self, bucket: int, budget: int):
+        """Jitted ladder round for (pool bucket, step budget) — budgets
+        come from the fixed GATHER_ROUND_BUDGETS set, so the key space
+        stays a small grid and nothing recompiles after warmup."""
+        return self._cached(("round", bucket, budget),
+                            lambda: make_round_step(bucket, budget))
+
+    def _cached(self, key, build):
         with self._step_lock:
-            step = self._step_cache.get(bucket)
+            step = self._step_cache.get(key)
             if step is not None:
                 return step
-        built = make_solve_step(bucket)
+        built = build()
         with self._step_lock:
-            step = self._step_cache.setdefault(bucket, built)
-            if len(self._step_cache) > 4:
-                for key in list(self._step_cache):
-                    if key != bucket and len(self._step_cache) > 4:
-                        del self._step_cache[key]
+            step = self._step_cache.setdefault(key, built)
+            if len(self._step_cache) > 12:
+                for stale in list(self._step_cache):
+                    if stale != key and len(self._step_cache) > 12:
+                        del self._step_cache[stale]
         return step
+
+    def _solve_gather_ladder(self, key_base: str, lits, assign):
+        """Round-laddered lockstep solve over assumption-seeded
+        assignment vectors ``assign [batch, V1]`` (int8).
+
+        Replaces the monolithic while_loop dispatch: budgeted rounds
+        (GATHER_ROUND_BUDGETS), decided lanes retired between rounds,
+        survivors re-packed into the smallest power-of-two lane bucket
+        that fits.  Each round runs supervised under its own watchdog
+        key ``{key_base}:{budget}`` so the latency-EWMA deadline model
+        tracks the round's actual step budget, and each round fires the
+        dispatch fault point (chaos tests exercise every rung through
+        this path).  Raises DispatchAbandoned when the ladder gives up
+        — callers demote the context exactly as before.
+
+        Returns (status[batch] int32 with bails mapped to undecided,
+        final assign[batch, V1] int8).
+        """
+        from mythril_tpu.resilience import faults
+        from mythril_tpu.resilience.watchdog import get_watchdog
+
+        _, jnp = _require_jax()
+        assign = np.asarray(assign, dtype=np.int8)
+        batch, V1 = assign.shape
+        D = max(1, min(GATHER_DECISIONS, V1))
+        B = lane_bucket(batch)
+        dispatch_stats.lane_slots_filled += batch
+        dispatch_stats.lane_slots_total += B
+
+        state = {
+            "assign": np.ones((B, V1), np.int8),
+            "lvl": np.zeros((B, V1), np.int32),
+            "dvar": np.zeros((B, D), np.int32),
+            "dphase": np.zeros((B, D), np.int8),
+            "dflip": np.zeros((B, D), bool),
+            "depth": np.zeros(B, np.int32),
+            "status": np.zeros(B, np.int32),
+            "step": np.zeros(B, np.int32),
+        }
+        order = ("assign", "lvl", "dvar", "dphase", "dflip", "depth",
+                 "status", "step")
+        state["assign"][:batch] = assign
+        state["status"][batch:] = 3  # bucket pads: retired from step 0
+
+        statuses_out = np.zeros(batch, np.int32)
+        assign_out = np.array(assign, copy=True)
+        live = np.arange(batch)
+
+        budgets, spent, i = [], 0, 0
+        while spent < GATHER_STEPS:
+            budgets.append(
+                GATHER_ROUND_BUDGETS[min(i, len(GATHER_ROUND_BUDGETS) - 1)]
+            )
+            spent += budgets[-1]
+            i += 1
+
+        for budget in budgets:
+            if live.size == 0:
+                break
+            state["step"][:] = 0  # per-round active-sweep counters
+            step_fn = self._cached_round(V1 - 1, budget)
+            vals = [jnp.asarray(state[k]) for k in order]
+
+            def _thunk():
+                faults.maybe_fault_dispatch()
+                out = step_fn(lits, *vals)
+                # the host copy blocks until the round finished — the
+                # wedge point, so it belongs inside the supervision
+                # (np.array, not asarray: the ladder mutates the state
+                # between rounds and jax exports read-only views)
+                return [np.array(o) for o in out]
+
+            out = get_watchdog().supervised(f"{key_base}:{budget}",
+                                            _thunk)
+            state = dict(zip(order, out))
+            dispatch_stats.rounds += 1
+            steps_live = state["step"][: live.size]
+            steps_used = int(steps_live.max()) if live.size else 0
+            dispatch_stats.device_sweeps += steps_used
+            dispatch_stats.lane_sweeps_total += steps_used * B
+            dispatch_stats.lane_sweeps_active += int(steps_live.sum())
+            st = state["status"][: live.size]
+            done = st != 0
+            if not done.any():
+                continue
+            for local in np.nonzero(done)[0]:
+                statuses_out[live[local]] = st[local]
+                assign_out[live[local]] = state["assign"][local]
+            keep = np.nonzero(~done)[0]
+            live = live[keep]
+            if live.size == 0:
+                break
+            B_new = lane_bucket(int(keep.size))
+            idx = np.concatenate(
+                [keep, np.repeat(keep[:1], B_new - keep.size)]
+            )
+            for k in order:
+                state[k] = np.ascontiguousarray(state[k][idx])
+            state["status"][keep.size:] = 3
+            if B_new < B:
+                dispatch_stats.repacks += 1
+            B = B_new
+        # budget exhausted: survivors stay undecided with their final
+        # (partial) assignment, exactly like the monolithic bail
+        for local in range(live.size):
+            statuses_out[live[local]] = state["status"][local]
+            assign_out[live[local]] = state["assign"][local]
+        return np.where(statuses_out == 3, 0, statuses_out), assign_out
 
     def _build_cone_batch(self, ctx, assumption_sets):
         """Device inputs for the union-cone tier: (rows [N,K] int32
@@ -750,15 +956,9 @@ class BatchedSatBackend:
                     axis=1,
                 )
 
-            def _solve_cone():
-                faults.maybe_fault_dispatch()
-                step = self._cached_step(bucket)
-                fa, st = step(jnp.asarray(rows), jnp.asarray(assign))
-                return np.asarray(st), np.asarray(fa)
-
             try:
-                status, final_assign = get_watchdog().supervised(
-                    "cone", _solve_cone
+                status, final_assign = self._solve_gather_ladder(
+                    "cone", jnp.asarray(rows), assign
                 )
             except DispatchAbandoned as exc:
                 return self._abandon(ctx, exc, len(assumption_sets))
@@ -991,7 +1191,7 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     for i, nodes in enumerate(node_sets):
         if nodes is None:
             continue
-        if tuple(sorted(n.id for n in nodes)) in ctx.unsat_memo:
+        if ctx.unsat_memo_hit(tuple(sorted(n.id for n in nodes))):
             decided[i] = False  # permanent verdict (see BlastContext)
             continue
         # the per-query funnel may have solved this exact set already
@@ -1073,13 +1273,26 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
                 # not worth BLOCKING for — but the device is idle, so
                 # prefetch the batch asynchronously: refutations and
                 # models harvested on a later call only have to beat
-                # idle time, not CPU time
-                get_async_dispatcher().launch(
+                # idle time, not CPU time.  Queued (coalesce-deferred)
+                # lanes ride along to fill the prefetch bucket.
+                from mythril_tpu.ops.coalesce import get_coalescer
+
+                extras = get_coalescer().drain(ctx)
+                launched = get_async_dispatcher().launch(
                     get_backend(), ctx,
-                    [assumption_sets[i] for i in rep_indices],
-                    [node_sets[i] for i in rep_indices],
-                    [constraint_sets[i] for i in rep_indices],
+                    [assumption_sets[i] for i in rep_indices]
+                    + [q.lits for q in extras],
+                    [node_sets[i] for i in rep_indices]
+                    + [q.nodes for q in extras],
+                    [constraint_sets[i] for i in rep_indices]
+                    + [q.constraints for q in extras],
                 )
+                if extras:
+                    if launched:
+                        dispatch_stats.coalesced_dispatches += 1
+                        dispatch_stats.coalesced_lanes += len(extras)
+                    else:
+                        get_coalescer().requeue(ctx, extras)
             return decided
 
     backend = get_backend()
@@ -1113,11 +1326,27 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     # search explores assignments the probe never saw, so it stays on
     # even for probe-filtered residues — that residue is exactly where
     # the device must pay.
+    from mythril_tpu.ops.coalesce import get_coalescer
+
+    rep_sets = [assumption_sets[i] for i in rep_indices]
+    admitted = get_coalescer().admit(
+        ctx, rep_sets,
+        [node_sets[i] for i in rep_indices],
+        [constraint_sets[i] for i in rep_indices],
+        force_now=fuse_retry_attempt,
+    )
+    if admitted is None:
+        # coalescing window: this underfilled batch waits in the
+        # admission queue; its lanes fall through to the CDCL tail
+        # this round (verdicts unchanged — exactly what an undecided
+        # device lane does) and a later merged dispatch pays them
+        # back through the memo/nogood channel
+        return decided
+    extras = admitted
     prefetch_inflight = get_async_dispatcher().pending is not None
     dispatch_began = time.monotonic()
     verdicts = backend.check_assumption_sets(
-        ctx,
-        [assumption_sets[i] for i in rep_indices],
+        ctx, rep_sets + [q.lits for q in extras],
     )
     dispatch_elapsed = time.monotonic() - dispatch_began
     dispatch_stats.device_s += dispatch_elapsed
@@ -1130,7 +1359,10 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         backend.fuse_retries -= 1
     if engaged:
         dispatch_stats.dispatches += 1
-        dispatch_stats.lanes += len(rep_indices)
+        dispatch_stats.lanes += len(rep_indices) + len(extras)
+        if extras:
+            dispatch_stats.coalesced_dispatches += 1
+            dispatch_stats.coalesced_lanes += len(extras)
 
     counted_lanes = set()  # per-verdict counters tally device lanes,
     # not original states (several states can share one deduped lane)
@@ -1190,6 +1422,37 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
                 device_decided += 1
             else:
                 dispatch_stats.undecided += 1
+    # coalesced extras: lanes merged from the admission queue were
+    # already answered by the CDCL tail in their own (deferred) round,
+    # so their device verdicts land in the memo/model channels only —
+    # the same contract the async harvest uses
+    n_rep = len(rep_indices)
+    for pos, q in enumerate(extras):
+        verdict = verdicts[n_rep + pos]
+        if verdict is False:
+            if proof_log and not ctx.confirm_unsat(q.lits):
+                continue
+            ctx.note_unsat(q.nodes)
+            if engaged:
+                ctx.learn_nogood(q.lits, certified=proof_log)
+                dispatch_stats.unsat += 1
+                device_decided += 1
+        elif engaged:
+            env = _env_from_assignment(
+                ctx, backend.last_assignments[n_rep + pos]
+            )
+            ok = True
+            for c in q.constraints:
+                node = c.raw if hasattr(c, "raw") else c
+                if isinstance(node, bool):
+                    continue
+                if T.evaluate(node, env) is not True:
+                    ok = False
+                    break
+            if ok:
+                ctx._remember_model(env)
+                dispatch_stats.sat_verified += 1
+                device_decided += 1
     if engaged:
         # adaptive fuse accounting: a dispatch "paid off" iff it decided
         # at least one lane (device UNSAT, or a device model that

@@ -117,6 +117,27 @@ DPLL_MAX_VARS_INTERPRET = 2048
 # undecided instead of UNSAT.  Completion sweeps drop ~K-fold.
 DPLL_SINGLE_WINDOW = 8
 DPLL_BULK_K = 16
+# Round-ladder budgets: the monolithic while_loop ran every lane for as
+# long as the SLOWEST lane in the batch needed (BENCH_r05: 9,698 sweeps
+# for 158 lanes — one hard lane drags a full-width batch).  Budgeted
+# rounds let the host retire decided lanes between rounds and re-pack
+# the survivors into the smallest lane bucket that fits, so late sweeps
+# run at straggler width, not batch width.  Budgets come from a FIXED
+# geometric set (the last entry repeats until the tier's step budget is
+# covered), so per-round shapes reuse the existing bucket grid and no
+# new kernels compile after warmup.
+ROUND_BUDGETS = (64, 256, 1024)
+ROUND_BUDGETS_INTERPRET = (48, 144)
+# Tiered cone sweeping: the hot tier (narrow clauses + rows touched by
+# the assignment frontier / the last round's trail) is swept every
+# step; the cold remainder joins every TIER_PERIOD-th sweep as the
+# conflict/completeness check.  Soundness is preserved by gating the
+# verdict-bearing transitions on full sweeps (see _dpll_round_loop):
+# SAT completion, bulk decisions and the don't-care cascade only happen
+# on a full-cone view, while hot-subset conflicts/forcings are sound
+# unconditionally (every hot clause is a real cone clause).
+TIER_PERIOD = 8
+HOT_WIDTH = 3  # clauses at most this wide are always hot (unit fuel)
 
 
 def pallas_enabled() -> Optional[bool]:
@@ -151,6 +172,57 @@ def _bucket(n: int, floor: int = 128) -> int:
     while size < n:
         size *= 2
     return size
+
+
+def _tier_period() -> int:
+    """Cold-sweep period (env-tunable; <= 1 disables the tier split)."""
+    try:
+        return max(1, int(os.environ.get("MYTHRIL_TPU_TIER_PERIOD",
+                                         TIER_PERIOD)))
+    except ValueError:
+        return TIER_PERIOD
+
+
+def _ladder_budgets(total_steps: int, interpret: bool) -> list:
+    """Per-round step budgets covering ``total_steps`` from the fixed
+    geometric set (last entry repeats; slight overshoot is fine — the
+    loop exits early on decided batches).  `MYTHRIL_TPU_ROUND_LADDER=0`
+    collapses the ladder back to one monolithic round."""
+    if os.environ.get("MYTHRIL_TPU_ROUND_LADDER", "1").lower() in (
+        "0", "off", "false",
+    ):
+        return [total_steps]
+    seq = ROUND_BUDGETS_INTERPRET if interpret else ROUND_BUDGETS
+    budgets, spent, i = [], 0, 0
+    while spent < total_steps:
+        budgets.append(seq[min(i, len(seq) - 1)])
+        spent += budgets[-1]
+        i += 1
+    return budgets
+
+
+def _hot_row_mask(urow, ulit, width_arr, seed_cols) -> np.ndarray:
+    """Hot-tier membership over clause rows: narrow clauses (unit fuel
+    for BCP) plus every row touching a seed column (the assignment
+    frontier at dispatch time; the round trail later)."""
+    n_rows = len(width_arr)
+    mask = (width_arr > 0) & (width_arr <= HOT_WIDTH)
+    if len(urow) and len(seed_cols):
+        hit = np.isin(np.abs(ulit.astype(np.int64)), seed_cols)
+        touched = np.zeros(n_rows, dtype=bool)
+        touched[np.unique(urow[hit])] = True
+        mask = mask | touched
+    return mask
+
+
+def _hot_first_perm(hot_mask: np.ndarray):
+    """Stable permutation packing hot rows to the row-axis prefix.
+    Returns (order, new_pos): ``order[new] = old`` for width vectors,
+    ``new_pos[old] = new`` for remapping ``urow`` coordinates."""
+    order = np.argsort(~hot_mask, kind="stable")
+    new_pos = np.empty(len(hot_mask), np.int64)
+    new_pos[order] = np.arange(len(hot_mask))
+    return order, new_pos
 
 
 class DenseClausePool:
@@ -463,33 +535,93 @@ def _make_dpll_sweep(
     return call
 
 
-def _dpll_solve_loop(sweep, B, V, steps, max_decisions):
-    """Shared DPLL control loop around a sweep callable.
+#: field order of the resumable solver state (see _dpll_round_loop);
+#: drivers index status/active out of round outputs by these positions
+DPLL_STATE_FIELDS = (
+    "A", "lvl", "dvar", "dphase", "dflip", "dbulk", "depth", "status",
+    "taint", "active",
+)
+_STATUS_IDX = DPLL_STATE_FIELDS.index("status")
+_ACTIVE_IDX = DPLL_STATE_FIELDS.index("active")
+
+
+def _dpll_state0(A0: np.ndarray, D: int, n_real: int) -> list:
+    """Host-side zero state for a round ladder over ``A0 [B, V]``;
+    rows past ``n_real`` are bucket padding, retired from step 0."""
+    B, V = A0.shape
+    state = [
+        A0.astype(np.float32, copy=True),
+        np.zeros((B, V), np.int32),
+        np.zeros((B, D), np.int32),
+        np.zeros((B, D), np.float32),
+        np.zeros((B, D), np.float32),
+        np.zeros((B, D), np.float32),
+        np.zeros((B, 1), np.int32),
+        np.zeros((B, 1), np.int32),
+        np.zeros((B, 1), np.float32),
+        np.zeros((B, 1), np.int32),
+    ]
+    state[_STATUS_IDX][n_real:] = 3
+    return state
+
+
+def _dpll_round_loop(sweep, B, V, budget, max_decisions, sweep_hot=None,
+                     tier_period=1):
+    """Resumable DPLL control loop around a sweep callable.
 
     ``sweep(P, N, width, A)`` returns (fpos, fneg, conf[, spos, sneg])
     as [B, V] / [B, 1] planes; the loop is agnostic to how the clause
     scan is realized (tiled Pallas kernel over a shared [C, V] pool, or
     batched XLA dots over per-lane [B, C, V] planes).
+
+    Returns the raw (unjitted) round function
+    ``rounds(P, N, width, *state) -> (*state', steps_used)`` over the
+    DPLL_STATE_FIELDS tuple, so the host can run budgeted rounds,
+    retire decided lanes between them and re-pack survivors into a
+    smaller lane bucket (the round ladder).  Status is RAW here:
+    0 live, 1 SAT candidate, 2 sound UNSAT, 3 retired-undecided
+    (budget/taint bail — the ladder must not re-enter such lanes);
+    ``active`` counts per-lane live sweeps for the utilization split.
+
+    ``sweep_hot`` (with ``tier_period > 1``) enables tiered sweeping:
+    steps where ``step % tier_period != 0`` scan only the hot clause
+    prefix.  Hot-subset conflicts, forcings and exhaustion verdicts
+    are sound unconditionally (hot clauses are real cone clauses, and a
+    subset conflict refutes the superset), but SAT completion, bulk
+    decisions and the don't-care cascade need the full-cone view, so
+    those transitions are gated on full sweeps.
     """
-    import jax
     import jax.numpy as jnp
     from jax import lax
 
     decisions_on = max_decisions > 0
     D = max(1, min(max_decisions, V))  # stack planes ([B, D])
+    tiered = sweep_hot is not None and tier_period > 1
 
-    def solve(P, N, width, A0):
+    def rounds(P, N, width, A0, lvl0, dvar0, dphase0, dflip0, dbulk0,
+               depth0, status0, taint0, active0):
         col = lax.broadcasted_iota(jnp.int32, (B, V), 1)
         dcol = lax.broadcasted_iota(jnp.int32, (B, D), 1)  # slot l ↔ level l+1
         krow = jnp.arange(DPLL_BULK_K)[None, :]            # [1, K]
 
         def body(carry):
             (A, lvl, dvar, dphase, dflip, dbulk, depth, status, taint,
-             step) = carry
-            if decisions_on:
-                fpos, fneg, conf, spos, sneg = sweep(P, N, width, A)
+             sweeps, step) = carry
+            if tiered:
+                full_view = (step % tier_period) == 0
+                outs = lax.cond(
+                    full_view,
+                    lambda a: sweep(P, N, width, a),
+                    lambda a: sweep_hot(P, N, width, a),
+                    A,
+                )
             else:
-                fpos, fneg, conf = sweep(P, N, width, A)
+                full_view = jnp.bool_(True)
+                outs = sweep(P, N, width, A)
+            if decisions_on:
+                fpos, fneg, conf, spos, sneg = outs
+            else:
+                fpos, fneg, conf = outs
             free = (A == 0.0) & (col > 1)  # col 1 = constant-TRUE anchor
             force_pos = (fpos > 0.5) & free
             force_neg = (fneg > 0.5) & free
@@ -548,9 +680,21 @@ def _dpll_solve_loop(sweep, B, V, steps, max_decisions):
             # ~2.5x through conflict/redo churn — classic alternation
             # wins even though carry chains ripple one level per sweep.
             want = active & ~conflict & open_any & ~has_force
+            if decisions_on and tiered:
+                # hot-quiescence gate: when the HOT view offers no open
+                # clause to score, deciding would burn blind levels on
+                # cold-only vars (measured: conflict/redo churn that
+                # starves completion) — wait for the full-cone sweep
+                hot_open = jnp.any(
+                    spos + sneg > 0.5, axis=1, keepdims=True
+                )
+                want = want & (full_view | hot_open)
             if decisions_on:
                 can = depth < D
-                in_bulk = depth >= DPLL_SINGLE_WINDOW       # [B,1]
+                # bulk levels speculate on the FULL score view; a hot
+                # sweep's partial scores keep levels single-var so
+                # exhaustion stays a refutation without taint
+                in_bulk = (depth >= DPLL_SINGLE_WINDOW) & full_view
                 do_dec = want & can
                 bail = want & ~can
                 score = jnp.where(
@@ -588,7 +732,10 @@ def _dpll_solve_loop(sweep, B, V, steps, max_decisions):
                 # pop with it on backtrack).  EVM cones are mostly
                 # don't-cares once the constrained core is satisfied;
                 # without this, completion costs one decision per var.
-                dontcare = free & ~forced & (spos + sneg < 0.5)
+                # Full-view sweeps only: a var with zero HOT-view score
+                # may still sit in an open cold clause, so the "provably
+                # safe" argument needs the whole cone.
+                dontcare = free & ~forced & (spos + sneg < 0.5) & full_view
                 newly = do_dec & (dontcare | chosen)
                 A3 = jnp.where(
                     newly, jnp.where(chosen, ph_full, 1.0), A2
@@ -606,8 +753,11 @@ def _dpll_solve_loop(sweep, B, V, steps, max_decisions):
                 dvar2, dphase2, dflip2, depth2 = dvar1, dphase1, dflip1, depth1
                 dbulk2 = dbulk1
 
-            # --- quiet and complete: SAT candidate
-            done_sat = active & ~conflict & ~has_force & ~open_any
+            # --- quiet and complete: SAT candidate.  A hot sweep's
+            # conflict flag covers only the hot subset, so completion
+            # is only claimed on a full-cone view.
+            done_sat = active & ~conflict & ~has_force & ~open_any \
+                & full_view
 
             # tainted exhaustion is NOT a refutation — report undecided
             status1 = jnp.where(
@@ -615,27 +765,48 @@ def _dpll_solve_loop(sweep, B, V, steps, max_decisions):
             )
             status1 = jnp.where(done_sat, 1, status1)
             status1 = jnp.where(bail, 3, status1)  # 3 = budget-bailed
+            sweeps1 = sweeps + active.astype(jnp.int32)
             return (A3, lvl3, dvar2, dphase2, dflip2, dbulk2, depth2,
-                    status1, taint1, step + 1)
+                    status1, taint1, sweeps1, step + 1)
 
         def cond(carry):
-            status, step = carry[7], carry[9]
-            return jnp.any(status == 0) & (step < steps)
+            status, step = carry[_STATUS_IDX], carry[-1]
+            return jnp.any(status == 0) & (step < budget)
 
         init = (
-            A0,
-            jnp.zeros((B, V), dtype=jnp.int32),
-            jnp.zeros((B, D), dtype=jnp.int32),
-            jnp.zeros((B, D), dtype=jnp.float32),
-            jnp.zeros((B, D), dtype=jnp.float32),
-            jnp.zeros((B, D), dtype=jnp.float32),
-            jnp.zeros((B, 1), dtype=jnp.int32),
-            jnp.zeros((B, 1), dtype=jnp.int32),
-            jnp.zeros((B, 1), dtype=jnp.float32),
-            jnp.int32(0),
+            A0, lvl0, dvar0, dphase0, dflip0, dbulk0, depth0, status0,
+            taint0, active0, jnp.int32(0),
         )
         out = lax.while_loop(cond, body, init)
-        A, status, steps_used = out[0], out[7], out[9]
+        return out[:-1] + (out[-1],)
+
+    return rounds
+
+
+def _dpll_solve_loop(sweep, B, V, steps, max_decisions):
+    """Legacy one-shot wrapper over :func:`_dpll_round_loop`: zero
+    state in, mapped status out (3 = bailed becomes 0 = undecided)."""
+    import jax
+    import jax.numpy as jnp
+
+    rounds = _dpll_round_loop(sweep, B, V, steps, max_decisions)
+    D = max(1, min(max_decisions, V))
+
+    def solve(P, N, width, A0):
+        z = jnp.zeros
+        out = rounds(
+            P, N, width, A0,
+            z((B, V), dtype=jnp.int32),
+            z((B, D), dtype=jnp.int32),
+            z((B, D), dtype=jnp.float32),
+            z((B, D), dtype=jnp.float32),
+            z((B, D), dtype=jnp.float32),
+            z((B, 1), dtype=jnp.int32),
+            z((B, 1), dtype=jnp.int32),
+            z((B, 1), dtype=jnp.float32),
+            z((B, 1), dtype=jnp.int32),
+        )
+        A, status, steps_used = out[0], out[_STATUS_IDX], out[-1]
         status = jnp.where(status == 3, 0, status)  # bailed = undecided
         return A, status, steps_used
 
@@ -668,6 +839,34 @@ def make_dense_solve(
     return _dpll_solve_loop(sweep, B, V, steps, max_decisions)
 
 
+@functools.lru_cache(maxsize=64)
+def make_dense_rounds(
+    C: int, V: int, B: int, budget: int, interpret: bool,
+    max_decisions: int = MAX_DECISIONS, hot_c: int = 0,
+    tier_period: int = 1,
+):
+    """Resumable round variant of :func:`make_dense_solve` for the
+    round-ladder driver: fn(P, N, width, *state) -> (*state',
+    steps_used) with RAW status (see _dpll_round_loop).
+
+    ``hot_c > 0`` builds a second Pallas sweep over only the first
+    ``hot_c`` clause rows (the hot tier packed to the row prefix by the
+    caller; must be a multiple of the clause tile) and sweeps the full
+    pool every ``tier_period``-th step only.
+    """
+    import jax
+
+    TC = _tile_c(C, V)
+    scores = max_decisions > 0
+    sweep = _make_dpll_sweep(C, V, B, TC, interpret, scores)
+    sweep_hot = None
+    if hot_c and tier_period > 1 and TC <= hot_c < C:
+        sweep_hot = _make_dpll_sweep(hot_c, V, B, TC, interpret, scores)
+    return jax.jit(_dpll_round_loop(
+        sweep, B, V, budget, max_decisions, sweep_hot, tier_period
+    ))
+
+
 @functools.lru_cache(maxsize=16)
 def make_batched_solve(
     C: int, V: int, B: int, steps: int,
@@ -689,12 +888,41 @@ def make_batched_solve(
     Returns fn(P[B,C,V]bf16, N[B,C,V]bf16, width[B,C]f32, A0[B,V]f32)
     -> (A[B,V]f32, status[B,1]i32, steps_used i32).
     """
+    sweep = _make_batched_sweep(max_decisions > 0)
+    return _dpll_solve_loop(sweep, B, V, steps, max_decisions)
+
+
+@functools.lru_cache(maxsize=32)
+def make_batched_rounds(
+    C: int, V: int, B: int, budget: int,
+    max_decisions: int = MAX_DECISIONS, hot_c: int = 0,
+    tier_period: int = 1,
+):
+    """Resumable round variant of :func:`make_batched_solve` (same
+    state contract as make_dense_rounds).  ``hot_c`` slices the leading
+    ``hot_c`` rows of each lane's plane for the hot-tier sweeps — the
+    caller packs each lane's hot rows to its row prefix."""
     import jax
+
+    sweep = _make_batched_sweep(max_decisions > 0)
+    sweep_hot = None
+    if hot_c and tier_period > 1 and hot_c < C:
+        base = sweep
+
+        def sweep_hot(P, N, width, A):  # noqa: F811 — tier closure
+            return base(P[:, :hot_c], N[:, :hot_c], width[:, :hot_c], A)
+
+    return jax.jit(_dpll_round_loop(
+        sweep, B, V, budget, max_decisions, sweep_hot, tier_period
+    ))
+
+
+def _make_batched_sweep(decisions_on: bool):
+    """One batched clause scan over per-lane incidence planes
+    ([B, C, V] dots; XLA streams and MXU-lowers them)."""
     import jax.numpy as jnp
     from jax import lax
 
-    D = max(1, min(max_decisions, V))
-    decisions_on = max_decisions > 0
     # lhs [B,V] x rhs [B,C,V], contract V, batch B -> [B,C]
     by_v = (((1,), (2,)), ((0,), (0,)))
     # lhs [B,C] x rhs [B,C,V], contract C, batch B -> [B,V]
@@ -740,7 +968,7 @@ def make_batched_solve(
             return fpos, fneg, conf, spos, sneg
         return fpos, fneg, conf
 
-    return _dpll_solve_loop(sweep, B, V, steps, max_decisions)
+    return sweep
 
 
 @functools.lru_cache(maxsize=32)
@@ -764,6 +992,121 @@ def _make_lane_incidence_builder(B: int, C: int, V: int, n_pos: int,
     fn.n_pos = n_pos
     fn.n_neg = n_neg
     return fn
+
+
+def _run_dense_ladder(
+    round_fn,
+    planes,
+    A0: np.ndarray,
+    n_real: int,
+    max_decisions: int,
+    steps_total: int,
+    interpret: bool,
+    hot_c: int = 0,
+    lane_floor: int = 8,
+    compact_planes=None,
+    grow_hot=None,
+):
+    """Host driver for the round ladder over a dense solve.
+
+    Runs ``round_fn(B, budget, hot_c)`` for the geometric budget
+    sequence; between rounds decided lanes are retired (their final
+    assignment captured), survivors are compacted to the bucket prefix
+    and re-packed into the smallest lane bucket that fits, so one
+    straggler lane stops dragging a full-width batch through the MXU.
+
+    - ``planes`` are passed to the round function verbatim;
+      ``compact_planes(planes, idx)`` re-gathers per-lane planes on
+      lane compaction (None for lane-shared planes).
+    - ``grow_hot(live_A, hot_c) -> (planes, hot_c) | None`` lets the
+      caller fold the round's trail into the hot tier (union layout).
+
+    Telemetry lands on DispatchStats: ``rounds``, ``repacks``,
+    ``device_sweeps`` (loop iterations), ``lane_sweeps_total``
+    (iterations x bucket width — the MXU work actually burned) and
+    ``lane_sweeps_active`` (per-lane live sweeps — the work that could
+    have decided something).
+
+    Returns (status[n_real] int32 with bails mapped to 0, final
+    A[n_real, V] float32).
+    """
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+    from mythril_tpu.resilience import faults
+    from mythril_tpu.resilience.watchdog import raise_if_cancelled
+
+    B, V = A0.shape
+    D = max(1, min(max_decisions, V))
+    state = _dpll_state0(A0, D, n_real)
+    statuses_out = np.zeros(n_real, np.int32)
+    A_out = np.zeros((n_real, V), np.float32)
+    live = np.arange(n_real)
+
+    def commit(local_rows, st, act, A_host):
+        nonlocal_sum = 0
+        for local in local_rows:
+            statuses_out[live[local]] = st[local]
+            A_out[live[local]] = A_host[local]
+            nonlocal_sum += int(act[local])
+        return nonlocal_sum
+
+    for budget in _ladder_budgets(steps_total, interpret):
+        if live.size == 0:
+            break
+        # cooperative checkpoints: the whole ladder runs inside one
+        # supervised "pallas" dispatch, so an abandoned worker bails
+        # between rounds instead of racing the host on shared state
+        raise_if_cancelled()
+        faults.maybe_fault_dispatch()
+        fn = round_fn(B, budget, hot_c)
+        out = fn(*planes, *state)
+        state, steps_used = list(out[:-1]), int(out[-1])
+        dispatch_stats.rounds += 1
+        dispatch_stats.device_sweeps += steps_used
+        dispatch_stats.lane_sweeps_total += steps_used * B
+        st = np.asarray(state[_STATUS_IDX])[:, 0]
+        done = st[: live.size] != 0
+        if not done.any() and grow_hot is None:
+            continue
+        A_host = np.asarray(state[0])
+        if done.any():
+            act = np.asarray(state[_ACTIVE_IDX])[:, 0]
+            dispatch_stats.lane_sweeps_active += commit(
+                np.nonzero(done)[0], st, act, A_host
+            )
+            keep = np.nonzero(~done)[0]
+            if keep.size == 0:
+                live = keep
+                break
+            live = live[keep]
+            B_new = max(
+                lane_floor, _bucket(int(keep.size), floor=lane_floor)
+            )
+            idx = np.concatenate(
+                [keep, np.repeat(keep[:1], B_new - keep.size)]
+            )
+            new_state = [np.ascontiguousarray(np.asarray(a)[idx])
+                         for a in state]
+            new_state[_STATUS_IDX][keep.size:] = 3  # pads stay inert
+            if B_new < B:
+                dispatch_stats.repacks += 1
+            B = B_new
+            state = new_state
+            if compact_planes is not None:
+                planes = compact_planes(planes, idx)
+        else:
+            keep = np.arange(live.size)
+        if grow_hot is not None:
+            grown = grow_hot(A_host[keep], hot_c)
+            if grown is not None:
+                planes, hot_c = grown
+    if live.size:
+        st = np.asarray(state[_STATUS_IDX])[:, 0]
+        act = np.asarray(state[_ACTIVE_IDX])[:, 0]
+        A_host = np.asarray(state[0])
+        dispatch_stats.lane_sweeps_active += commit(
+            range(live.size), st, act, A_host
+        )
+    return np.where(statuses_out == 3, 0, statuses_out), A_out
 
 
 class PallasSatBackend:
@@ -892,9 +1235,13 @@ class PallasSatBackend:
         self, ctx, assumption_sets, clause_idx, cone_vars, interpret,
         search,
     ):
-        """Union-cone layout: one shared [C, V] incidence pool."""
-        import jax.numpy as jnp
-
+        """Union-cone layout: one shared [C, V] incidence pool, solved
+        through the round ladder (budgeted rounds, straggler-aware lane
+        retirement and bucket re-packing) with tiered hot/cold sweeps:
+        hot rows — narrow clauses plus rows touched by the assumption
+        frontier, grown with each round's trail — are packed to the row
+        prefix and swept every step; the cold remainder joins every
+        TIER_PERIOD-th sweep as the conflict/completeness check."""
         from mythril_tpu.ops.batched_sat import dispatch_stats
 
         # every assumption var is a cone root, so the remap is exactly
@@ -906,10 +1253,34 @@ class PallasSatBackend:
         assignments[:, 1] = 1
 
         urow, ulit, width_arr = remap_cone_csr(ctx, clause_idx, cone_vars)
-        pool = DenseClausePool()
-        pool.refresh_coords(
-            urow, ulit, width_arr, len(clause_idx), num_cone_vars
+        n_rows = len(clause_idx)
+        seed_lists = [
+            np.abs(assumption_columns(cone_vars, lits))
+            for lits in assumption_sets if lits
+        ]
+        seed_cols = (
+            np.unique(np.concatenate(seed_lists))
+            if seed_lists else np.empty(0, np.int64)
         )
+        C = _bucket(max(1, n_rows))
+        V = _bucket(num_cone_vars + 1)
+        TC = _tile_c(C, V)
+        tier_period = _tier_period()
+        tier_on = tier_period > 1
+        # the initial hot candidates (narrow clauses + rows touched by
+        # the assumption frontier) are recorded but the FIRST round
+        # always sweeps the full cone: the first trail is what tells us
+        # which part of the circuit the search actually exercises, and
+        # a hot tier seeded from assumptions alone starves completion
+        # (measured on the 16-bit MUL circuits: blind decisions on
+        # cold-only vars churn conflicts for the whole budget)
+        hot_mask = (
+            _hot_row_mask(urow, ulit, width_arr, seed_cols)
+            if tier_on else np.zeros(len(width_arr), dtype=bool)
+        )
+        hot_c = 0  # engaged by grow_hot once a trail exists
+        pool = DenseClausePool()
+        pool.refresh_coords(urow, ulit, width_arr, n_rows, num_cone_vars)
         inverse = np.zeros(pool.V, dtype=np.int64)
         inverse[1] = 1
         inverse[2 : 2 + len(cone_vars)] = cone_vars
@@ -922,40 +1293,80 @@ class PallasSatBackend:
             DPLL_MAX_VARS_INTERPRET if interpret else DPLL_MAX_VARS
         )
         decisions = MAX_DECISIONS if (search and V <= search_ceiling) else 0
-        from mythril_tpu.resilience import faults
-        from mythril_tpu.resilience.watchdog import raise_if_cancelled
 
+        def round_fn(Bc, round_budget, hot_rows):
+            return make_dense_rounds(
+                pool.C, V, Bc, round_budget, interpret, decisions,
+                hot_rows, tier_period,
+            )
+
+        # initially-assigned columns across the chunk (anchor, bucket
+        # padding, any lane's assumptions): everything a survivor
+        # assigns beyond these is the round's trail
         for start in range(0, batch, chunk_lanes):
-            # supervised-dispatch checkpoints: an abandoned worker must
-            # bail here rather than touch shared context/device state
-            # while the host has already moved on to the CDCL tail
-            raise_if_cancelled()
-            faults.maybe_fault_dispatch()
             chunk = assumption_sets[start : start + chunk_lanes]
-            B = max(8, _bucket(len(chunk), floor=8))
+            n = len(chunk)
+            B = max(8, _bucket(n, floor=8))
             A0 = np.zeros((B, V), dtype=np.float32)
             A0[:, 1] = 1.0  # constant-TRUE anchor
             # bucket-padding columns occur in no clause; preassign them
             # so the DPLL never spends decisions completing them
             A0[:, num_cone_vars + 1:] = 1.0
-            # pad lanes likewise fully assigned, or they would keep the
-            # while_loop searching after every real lane decided
-            A0[len(chunk):, :] = 1.0
+            # pad lanes likewise fully assigned (and retired from step
+            # 0 via the ladder's pad status)
+            A0[n:, :] = 1.0
             for lane, lits in enumerate(chunk):
                 cols = assumption_columns(cone_vars, lits)
                 A0[lane, np.abs(cols)] = np.where(cols > 0, 1.0, -1.0)
-            from mythril_tpu.ops.device_placement import place
+            seeded = np.any(A0[:n] != 0.0, axis=0)
+            # layout state the trail growth mutates (carried across
+            # chunks so a grown tier serves the rest of the batch)
+            layout = {"urow": urow, "width": width_arr, "hot": hot_mask}
 
-            step = make_dense_solve(
-                pool.C, V, B, steps, interpret, decisions
+            def grow_hot(live_A, hot_cur):
+                """Fold the round trail (columns newly assigned by any
+                survivor) into the hot tier — the tier ENGAGES here
+                after the first round's full-cone sweeps showed which
+                rows the search exercises — rebuilding the hot-first
+                layout only when the hot bucket actually grows."""
+                if not len(ulit):
+                    return None
+                mask = layout["hot"]
+                trail = np.nonzero(
+                    np.any(np.abs(live_A) > 0.5, axis=0) & ~seeded
+                )[0]
+                if trail.size:
+                    hit = np.isin(np.abs(ulit.astype(np.int64)), trail)
+                    mask = mask.copy()
+                    mask[np.unique(layout["urow"][hit])] = True
+                    layout["hot"] = mask
+                new_hot_c = _bucket(max(1, int(mask.sum())), floor=TC)
+                if new_hot_c <= hot_cur or new_hot_c * 2 > C:
+                    return None
+                order2, new_pos2 = _hot_first_perm(mask)
+                layout["urow"] = new_pos2[layout["urow"]]
+                layout["width"] = layout["width"][order2]
+                layout["hot"] = mask[order2]
+                pool.refresh_coords(
+                    layout["urow"], ulit, layout["width"], n_rows,
+                    num_cone_vars,
+                )
+                return (pool.P, pool.N, pool.width), new_hot_c
+
+            st_out, A_host = _run_dense_ladder(
+                round_fn, (pool.P, pool.N, pool.width), A0,
+                n, decisions, steps, interpret,
+                hot_c=hot_c, lane_floor=8,
+                grow_hot=grow_hot if tier_on else None,
             )
-            A, st, steps_used = step(
-                pool.P, pool.N, pool.width, place(jnp.asarray(A0)),
+            # trail growth may have reordered rows for the next chunk;
+            # refresh the chunk-level views
+            urow, width_arr, hot_mask = (
+                layout["urow"], layout["width"], layout["hot"]
             )
-            dispatch_stats.device_sweeps += int(steps_used)
-            n = len(chunk)
-            A_host = np.asarray(A, dtype=np.float32)[:n]
-            statuses[start : start + n] = np.asarray(st)[:n, 0]
+            dispatch_stats.lane_slots_filled += n
+            dispatch_stats.lane_slots_total += B
+            statuses[start : start + n] = st_out
             # map cone columns back to original variable ids
             signs = np.sign(A_host).astype(np.int8)  # [n, V]
             for lane in range(n):
@@ -968,9 +1379,12 @@ class PallasSatBackend:
         self, ctx, assumption_sets, lane_cones, max_C, max_V, interpret,
         search,
     ):
-        """Per-lane-cone layout: [B, C, V] planes, batched matmuls."""
-        import jax.numpy as jnp
-
+        """Per-lane-cone layout: [B, C, V] planes, batched matmuls,
+        driven through the round ladder (lane retirement compacts the
+        per-lane planes too, so a straggler stops streaming its retired
+        siblings' incidence data).  No tier split here: hot tiers need
+        the trail-growth feedback loop (union layout), and a static
+        assumption-seeded tier measurably starves completion."""
         from mythril_tpu.ops.batched_sat import dispatch_stats
 
         batch = len(assumption_sets)
@@ -996,15 +1410,12 @@ class PallasSatBackend:
         decisions = (
             MAX_DECISIONS if (search and max_V <= search_ceiling) else 0
         )
-        from mythril_tpu.resilience import faults
-        from mythril_tpu.resilience.watchdog import raise_if_cancelled
 
         for start in range(0, batch, chunk_lanes):
-            raise_if_cancelled()
-            faults.maybe_fault_dispatch()
             chunk = assumption_sets[start : start + chunk_lanes]
             chunk_cones = lane_cones[start : start + chunk_lanes]
             B = _bucket(len(chunk), floor=min(8, chunk_lanes))
+            lane_floor = min(8, chunk_lanes)
             A0 = np.zeros((B, max_V), dtype=np.float32)
             A0[:, 1] = 1.0
             A0[len(chunk):, :] = 1.0  # pad lanes fully assigned
@@ -1051,12 +1462,25 @@ class PallasSatBackend:
                 place(_pad_coords(neg_c, build.n_neg)),
                 place(width),
             )
-            step = make_batched_solve(max_C, max_V, B, steps, decisions)
-            A, st, steps_used = step(P, N, W, jnp.asarray(A0))
-            dispatch_stats.device_sweeps += int(steps_used)
+            def round_fn(Bc, round_budget, hot_rows):
+                return make_batched_rounds(
+                    max_C, max_V, Bc, round_budget, decisions,
+                )
+
+            def compact_planes(planes, idx):
+                import jax.numpy as jnp
+
+                j = jnp.asarray(idx)
+                return tuple(jnp.take(p, j, axis=0) for p in planes)
+
             n = len(chunk)
-            A_host = np.asarray(A, dtype=np.float32)[:n]
-            statuses[start : start + n] = np.asarray(st)[:n, 0]
+            st_out, A_host = _run_dense_ladder(
+                round_fn, (P, N, W), A0, n, decisions, steps, interpret,
+                lane_floor=lane_floor, compact_planes=compact_planes,
+            )
+            dispatch_stats.lane_slots_filled += n
+            dispatch_stats.lane_slots_total += B
+            statuses[start : start + n] = st_out
             signs = np.sign(A_host).astype(np.int8)
             for lane in range(n):
                 inverse = inverses[lane]

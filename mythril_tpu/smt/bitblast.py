@@ -33,8 +33,25 @@ TRUE_LIT = 1
 FALSE_LIT = -1
 
 # probe-memo entry cap (SAT entries pin whole EvalEnvs; see
-# probe_with_memo) — oldest quarter is evicted when full
+# probe_with_memo) — the least-recently-USED quarter is evicted when
+# full (hits refresh recency, so live frontier entries survive long
+# corpus runs).  Env-tunable: MYTHRIL_TPU_PROBE_MEMO_CAP.
 PROBE_MEMO_CAP = 16384
+
+
+def probe_memo_cap() -> int:
+    """Effective memo cap: ``MYTHRIL_TPU_PROBE_MEMO_CAP`` when set (a
+    soak driver analyzing thousands of contracts wants a bigger live
+    set; a memory-tight CI wants a smaller one), else the default.
+    Floored so the eviction quarter never rounds to zero."""
+    import os
+
+    try:
+        return max(64, int(os.environ.get(
+            "MYTHRIL_TPU_PROBE_MEMO_CAP", PROBE_MEMO_CAP
+        )))
+    except ValueError:
+        return PROBE_MEMO_CAP
 
 # powers of two for vectorized bit packing (64-bit limbs)
 _POW2_64 = np.uint64(1) << np.arange(64, dtype=np.uint64)
@@ -231,10 +248,26 @@ class BlastContext:
         permanent, because the pool only ever gains implied/definitional
         clauses, so an assumption set can never turn SAT later."""
         key = tuple(sorted(n.id for n in nodes))
-        if len(self.unsat_memo) >= PROBE_MEMO_CAP:
-            for stale in list(self.unsat_memo)[: PROBE_MEMO_CAP // 4]:
+        cap = probe_memo_cap()
+        if len(self.unsat_memo) >= cap:
+            # recency order, not insertion order: hits re-insert at the
+            # end (see unsat_memo_hit), so this drops the quarter the
+            # frontier stopped asking about — long corpus runs keep
+            # their live entries
+            for stale in list(self.unsat_memo)[: cap // 4]:
                 del self.unsat_memo[stale]
         self.unsat_memo[key] = True
+
+    def unsat_memo_hit(self, key) -> bool:
+        """Memo lookup that REFRESHES recency on a hit (dict preserves
+        insertion order, so re-inserting moves the key to the evict-last
+        end).  All memo readers go through here — a key that keeps
+        deciding lanes must never be the one evicted."""
+        if key in self.unsat_memo:
+            del self.unsat_memo[key]
+            self.unsat_memo[key] = True
+            return True
+        return False
 
     def learn_nogood(
         self, assumption_lits: Sequence[int], certified: bool = False
@@ -622,7 +655,7 @@ class BlastContext:
                 continue
             nodes.append(c)
         key = tuple(sorted(n.id for n in nodes))
-        if key in self.unsat_memo:
+        if self.unsat_memo_hit(key):
             return SatSolver.UNSAT, None
         from mythril_tpu.support.support_args import args as _args
 
@@ -898,16 +931,22 @@ class BlastContext:
             self.probe_memo[key] = memo
             return memo
         if memo is not None and memo[1] == self.model_version:
-            return None  # known-failed against the current model set
+            # known-failed against the current model set: refresh the
+            # entry's recency — a set the frontier keeps re-asking is
+            # exactly the one whose negative verdict must stay cached
+            del self.probe_memo[key]
+            self.probe_memo[key] = memo
+            return None
         env = self._probe_candidates(nodes)
         if key in self.probe_memo:
             del self.probe_memo[key]  # re-write moves the key to the end
-        elif len(self.probe_memo) >= PROBE_MEMO_CAP:
+        elif len(self.probe_memo) >= probe_memo_cap():
             # bounded: deep analyses generate an unbounded stream of
             # unique constraint-set keys, and SAT entries pin whole
             # EvalEnvs — evict least-recently-used (dict preserves
             # insertion order; hits/re-writes reinsert at the end)
-            for stale_key in list(self.probe_memo)[: PROBE_MEMO_CAP // 4]:
+            cap = probe_memo_cap()
+            for stale_key in list(self.probe_memo)[: cap // 4]:
                 del self.probe_memo[stale_key]
         self.probe_memo[key] = (
             env if env is not None else (False, self.model_version)

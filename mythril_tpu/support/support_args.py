@@ -47,6 +47,11 @@ class Args(object, metaclass=Singleton):
         # capability/benchmark override: dispatch whenever the size
         # gates allow, ignoring the profit projection
         self.device_force_dispatch = False
+        # cross-dispatch lane coalescing (ops/coalesce.py): defer
+        # badly-underfilled dispatches into a short admission window
+        # and merge them with the next compatible batch so lane
+        # buckets ship full; off routes every batch straight through
+        self.device_coalesce = True
         # concrete-prefix dispatcher pre-split (SoA-validated): replace
         # each transaction seed with per-selector states at the
         # function entries (laser/ethereum/lockstep_dispatch.py).

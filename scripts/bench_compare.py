@@ -1,0 +1,129 @@
+"""Perf regression gate over the bench artifacts.
+
+Diffs the two most recent ``BENCH_r*.json`` headlines and exits
+non-zero when ``t3_wall_s`` or ``device_s`` regressed by more than the
+threshold (default 20%) — the tripwire the straggler-aware sweep
+scheduling work is held to round over round.  Everything else on the
+headline (sweep_util, dispatch counts, degradation counters) is printed
+as an informational delta.
+
+Usage:
+    python scripts/bench_compare.py [--dir REPO] [--threshold 0.20]
+
+Exit status: 0 = no regression (or fewer than two artifacts — nothing
+to diff is not a failure on a fresh checkout), 1 = regression, 2 = the
+artifacts exist but carry no comparable headline.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+#: headline metrics gated on regression (larger = worse)
+GATED = ("t3_wall_s", "device_s")
+#: floor below which a baseline is noise and ratios are meaningless
+MIN_BASE = 0.05
+
+
+def load_headline(path):
+    """Headline dict of one artifact: the ``parsed`` block when the
+    capture parsed it, else the last headline-shaped JSON line of the
+    raw tail (the 500-char-capped line bench.py prints last)."""
+    with open(path) as fh:
+        art = json.load(fh)
+    parsed = art.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        return parsed
+    for line in reversed(art.get("tail", "").splitlines()):
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def round_number(path):
+    m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--dir",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding the BENCH_r*.json artifacts",
+    )
+    ap.add_argument(
+        "--threshold", type=float, default=0.20,
+        help="fractional regression that fails the gate (default 0.20)",
+    )
+    opts = ap.parse_args()
+
+    paths = sorted(
+        glob.glob(os.path.join(opts.dir, "BENCH_r*.json")),
+        key=round_number,
+    )
+    if len(paths) < 2:
+        print(f"bench_compare: {len(paths)} artifact(s) under "
+              f"{opts.dir} — nothing to diff")
+        return 0
+    # the two most recent HEADLINES, not artifacts: rounds predating
+    # the headline contract (or killed mid-run) carry none and would
+    # otherwise wedge the gate forever
+    with_headlines = [
+        (p, h) for p in paths for h in (load_headline(p),)
+        if h is not None
+    ]
+    if not with_headlines:
+        print("bench_compare: no parseable headline in any artifact")
+        return 2
+    if len(with_headlines) < 2:
+        print("bench_compare: only one artifact carries a headline "
+              f"({os.path.basename(with_headlines[0][0])}) — "
+              "nothing to diff")
+        return 0
+    (old_path, old), (new_path, new) = with_headlines[-2:]
+
+    print(f"bench_compare: {os.path.basename(old_path)} -> "
+          f"{os.path.basename(new_path)}")
+    failed = False
+    for key in GATED:
+        base, cur = old.get(key), new.get(key)
+        if not isinstance(base, (int, float)) or not isinstance(
+            cur, (int, float)
+        ):
+            print(f"  {key}: incomparable ({base!r} -> {cur!r})")
+            continue
+        if base <= MIN_BASE:
+            print(f"  {key}: {base} -> {cur} (baseline below noise "
+                  "floor; not gated)")
+            continue
+        delta = (cur - base) / base
+        verdict = "REGRESSION" if delta > opts.threshold else "ok"
+        print(f"  {key}: {base} -> {cur} ({delta:+.1%}) {verdict}")
+        failed = failed or delta > opts.threshold
+
+    # informational: everything both headlines carry beyond the gate
+    for key in sorted(set(old) | set(new)):
+        if key in GATED or key in ("metric", "unit", "cmd"):
+            continue
+        a, b = old.get(key), new.get(key)
+        if a != b:
+            print(f"  {key}: {a!r} -> {b!r}")
+
+    if failed:
+        print(f"bench_compare: FAILED (>{opts.threshold:.0%} "
+              "regression on a gated metric)")
+        return 1
+    print("bench_compare: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

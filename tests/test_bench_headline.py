@@ -64,6 +64,44 @@ def test_headline_mesh_row_not_ok_without_dispatches():
     assert payload["mesh_row_ok"] is False
 
 
+def test_headline_carries_sweep_utilization():
+    """The straggler-aware scheduling round is judged on the headline's
+    sweep-utilization ratio (lane_sweeps_active / lane_sweeps_total);
+    it must ride the line (null when nothing dispatched) and still be
+    droppable under the 500-char cap."""
+    payload = json.loads(
+        bench.build_headline_line(dict(BASE_SUMMARY), None, None)
+    )
+    assert "sweep_util" in payload
+    assert payload["sweep_util"] is None  # nothing dispatched
+    summary = dict(BASE_SUMMARY, sweep_util=0.813)
+    payload = json.loads(bench.build_headline_line(summary, None, None))
+    assert payload["sweep_util"] == 0.813
+    # adversarial cap pressure: sweep_util is allowed to drop
+    summary = dict(BASE_SUMMARY, sweep_util=0.813,
+                   error="missed findings: " + "x" * 1000)
+    line = bench.build_headline_line(summary, None, None)
+    assert len(line) <= 500
+
+
+def test_scale_summary_reports_ladder_telemetry():
+    """The per-scenario summary must expose the round-ladder and
+    coalescer counters plus the derived per-row sweep_util."""
+    row = {
+        "wall_s": 1.0, "dispatches": 3, "lanes": 24, "unsat": 2,
+        "sat_verified": 20, "undecided": 2, "found": ["106"],
+        "device_sweeps": 500, "rounds": 9, "repacks": 4,
+        "coalesced_dispatches": 2, "coalesce_deferred": 11,
+        "lane_sweeps_active": 600, "lane_sweeps_total": 800,
+        "lane_slots_filled": 24, "lane_slots_total": 32,
+    }
+    out = bench._scale_summary(row)
+    assert out["rounds"] == 9
+    assert out["repacks"] == 4
+    assert out["coalesced_dispatches"] == 2
+    assert out["sweep_util"] == 0.75
+
+
 def test_headline_carries_degradation_counters():
     """Chaos/flaky-hardware rounds are judged on the headline alone, so
     the ladder counters must ride it (and default to 0 when a summary

@@ -1,0 +1,178 @@
+"""Cone-layout primitives behind the device incidence builds:
+``dedupe_clause_rows`` (row normalization), ``remap_cone_csr`` (pool
+CSR -> dense cone columns) and ``assumption_columns`` (assumption
+literals under the same remap).
+
+These functions feed every dense dispatch, and the round ladder's
+hot-tier bookkeeping (``_hot_row_mask`` indexes ``urow``/``ulit``
+coordinates) assumes their invariants: unique (row, literal) pairs,
+tautologies dropped with width 0, and widths counting UNIQUE literals.
+"""
+
+import numpy as np
+import pytest
+
+from mythril_tpu.ops.batched_sat import MAX_CLAUSE_WIDTH
+from mythril_tpu.ops.pallas_prop import (
+    assumption_columns,
+    dedupe_clause_rows,
+    remap_cone_csr,
+)
+
+
+class _FakePool:
+    """Stands in for the native clause pool: canned subset_csr."""
+
+    def __init__(self, rows):
+        self.rows = rows  # clause id -> list of signed literals
+
+    def subset_csr(self, clause_ids):
+        lits, indptr = [], [0]
+        for cid in clause_ids:
+            lits.extend(self.rows[cid])
+            indptr.append(len(lits))
+        return (np.asarray(lits, dtype=np.int32),
+                np.asarray(indptr, dtype=np.int64))
+
+
+class _FakeCtx:
+    def __init__(self, rows):
+        self.pool = _FakePool(rows)
+
+
+# ---------------------------------------------------------------- dedupe
+
+
+def test_dedupe_empty_cone():
+    """Zero rows, zero literals: the empty cone must round-trip without
+    index errors and with a zero-length width vector."""
+    urow, ulit, width = dedupe_clause_rows(
+        np.empty(0, dtype=np.int32), np.zeros(1, dtype=np.int64)
+    )
+    assert urow.size == 0
+    assert ulit.size == 0
+    assert width.shape == (0,)
+
+
+def test_dedupe_all_pad_rows():
+    """Rows with no literals at all (every indptr step is empty) are
+    inert: no coordinates, width 0 per row — an all-zero incidence row
+    can never conflict or force."""
+    urow, ulit, width = dedupe_clause_rows(
+        np.empty(0, dtype=np.int32),
+        np.zeros(4, dtype=np.int64),  # 3 rows, all empty
+    )
+    assert urow.size == 0
+    assert np.array_equal(width, np.zeros(3, dtype=np.float32))
+
+
+def test_dedupe_collapses_duplicate_literals():
+    """[2, 2, 3] must count width 2, not 3 — the incidence cell
+    collapses duplicates, and an inflated width would miss the unit
+    state of the clause."""
+    lits = np.asarray([2, 2, 3], dtype=np.int32)
+    indptr = np.asarray([0, 3], dtype=np.int64)
+    urow, ulit, width = dedupe_clause_rows(lits, indptr)
+    assert width.tolist() == [2.0]
+    assert sorted(ulit.tolist()) == [2, 3]
+    assert np.array_equal(urow, np.zeros(2, dtype=np.int64))
+
+
+def test_dedupe_drops_tautologies_entirely():
+    """A row holding both polarities of a variable is always satisfied;
+    it must vanish (width 0, no coordinates) rather than feed the
+    kernel a clause that can never go unit."""
+    lits = np.asarray([2, -2, 5, 3, 4], dtype=np.int32)
+    indptr = np.asarray([0, 3, 5], dtype=np.int64)
+    urow, ulit, width = dedupe_clause_rows(lits, indptr)
+    # row 0 ([2, -2, 5]) is tautologous; row 1 survives untouched
+    assert width.tolist() == [0.0, 2.0]
+    assert np.all(urow == 1)
+    assert sorted(ulit.tolist()) == [3, 4]
+
+
+def test_dedupe_max_width_clause():
+    """A clause at MAX_CLAUSE_WIDTH distinct literals keeps every
+    coordinate and counts them all — the widest rows the gather tier
+    ever admits must survive normalization losslessly."""
+    body = [v if v % 2 else -v for v in range(2, 2 + MAX_CLAUSE_WIDTH)]
+    lits = np.asarray(body, dtype=np.int32)
+    indptr = np.asarray([0, len(body)], dtype=np.int64)
+    urow, ulit, width = dedupe_clause_rows(lits, indptr)
+    assert width.tolist() == [float(MAX_CLAUSE_WIDTH)]
+    assert sorted(ulit.tolist()) == sorted(body)
+
+
+def test_dedupe_mixed_rows_keep_alignment():
+    """Width indices stay aligned to input row positions even when a
+    middle row is dropped as tautologous."""
+    lits = np.asarray([2, 3, 4, -4, 5, 6], dtype=np.int32)
+    indptr = np.asarray([0, 2, 4, 6], dtype=np.int64)
+    urow, ulit, width = dedupe_clause_rows(lits, indptr)
+    assert width.tolist() == [2.0, 0.0, 2.0]
+    assert set(urow.tolist()) == {0, 2}
+
+
+# ------------------------------------------------------------ remap CSR
+
+
+def test_remap_cone_csr_dense_columns():
+    """Pool variable ids land on dense columns: anchor 1 -> 1,
+    cone_vars[i] -> i + 2, polarity preserved."""
+    ctx = _FakeCtx({7: [5, -9, 1], 8: [-5, 12]})
+    cone_vars = np.asarray([5, 9, 12], dtype=np.int64)
+    urow, ulit, width = remap_cone_csr(ctx, [7, 8], cone_vars)
+    by_row = {
+        r: sorted(ulit[urow == r].tolist()) for r in np.unique(urow)
+    }
+    assert by_row[0] == [-3, 1, 2]   # 5->2, -9->-3, 1->1
+    assert by_row[1] == [-2, 4]      # -5->-2, 12->4
+    assert width.tolist() == [3.0, 2.0]
+
+
+def test_remap_cone_csr_empty_cone():
+    """No clause ids: empty coordinates, empty width."""
+    ctx = _FakeCtx({})
+    urow, ulit, width = remap_cone_csr(
+        ctx, [], np.empty(0, dtype=np.int64)
+    )
+    assert urow.size == 0 and ulit.size == 0 and width.size == 0
+
+
+def test_remap_cone_csr_dedupes_through():
+    """The remap feeds dedupe: a tautologous pool clause disappears."""
+    ctx = _FakeCtx({3: [9, -9], 4: [9, 9]})
+    cone_vars = np.asarray([9], dtype=np.int64)
+    urow, ulit, width = remap_cone_csr(ctx, [3, 4], cone_vars)
+    assert width.tolist() == [0.0, 1.0]
+    assert ulit.tolist() == [2]
+
+
+# ---------------------------------------------------- assumption columns
+
+
+def test_assumption_columns_signs_and_anchor():
+    cone_vars = np.asarray([4, 6], dtype=np.int64)
+    cols = assumption_columns(cone_vars, [4, -6, 1, -1])
+    assert cols.tolist() == [2, -3, 1, -1]
+
+
+def test_assumption_columns_empty():
+    cols = assumption_columns(np.empty(0, dtype=np.int64), [])
+    assert cols.size == 0
+
+
+def test_assumption_columns_matches_remap():
+    """The two remaps must agree — an assumption literal must seed the
+    same column its clause occurrences land on."""
+    ctx = _FakeCtx({0: [10, -20]})
+    cone_vars = np.asarray([10, 20], dtype=np.int64)
+    _, ulit, _ = remap_cone_csr(ctx, [0], cone_vars)
+    cols = assumption_columns(cone_vars, [10, -20])
+    assert sorted(cols.tolist()) == sorted(ulit.tolist())
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

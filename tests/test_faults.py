@@ -62,6 +62,10 @@ def chaos_env(monkeypatch):
     monkeypatch.setattr(args, "async_dispatch", False)
     monkeypatch.setattr(args, "word_probing", False)
     monkeypatch.setattr(args, "batch_width", 32)
+    # chaos tests pin the ladder/fuse semantics per dispatch: the
+    # coalescer's admission window (its own tests live in
+    # test_sweep_scheduler.py) must not swallow calls here
+    monkeypatch.setattr(args, "device_coalesce", False)
     faults.reset_for_tests()
     watchdog.reset_for_tests()
     from mythril_tpu.ops.async_dispatch import get_async_dispatcher

@@ -274,6 +274,9 @@ def test_futile_dispatch_fuse(monkeypatch):
     monkeypatch.setattr(args, "device_min_lanes", 2)
     monkeypatch.setattr(args, "word_probing", False)
     monkeypatch.setattr(args, "device_force_dispatch", True)
+    # this test pins the FUSE semantics: every call must reach the
+    # backend, so the coalescer's admission window stays out of the way
+    monkeypatch.setattr(args, "device_coalesce", False)
     backend = BS.get_backend()
 
     # force "engaged but nothing decided" outcomes without a device:
@@ -325,6 +328,9 @@ def test_fuse_retry_rearms_on_decision(monkeypatch):
     monkeypatch.setattr(args, "device_min_lanes", 2)
     monkeypatch.setattr(args, "word_probing", False)
     monkeypatch.setattr(args, "device_force_dispatch", True)
+    # fuse-retry cadence test: the admission window must not swallow
+    # the non-retry calls it counts
+    monkeypatch.setattr(args, "device_coalesce", False)
     backend = BS.get_backend()
     mode = {"deciding": False}
 
