@@ -682,6 +682,9 @@ def _scale_summary(row):
         "device_s", "found", "unhealthy_skips", "cpu_auto_skips",
         "profit_skips", "mesh_dispatches", "device_status",
         "watchdog_trips", "dispatch_retries", "demotions",
+        # preemption safety (checkpoint plane + poisoned-lane bisection)
+        "quarantined_lanes", "bisect_dispatches",
+        "checkpoints_written", "resumes",
         # straggler-aware sweep scheduling (round ladder + coalescer)
         "rounds", "repacks", "coalesced_dispatches", "coalesce_deferred",
         "lane_sweeps_active", "lane_sweeps_total",
@@ -716,6 +719,10 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
         # flaky hardware (the acceptance signal for chaos runs)
         "watchdog_trips": summary.get("watchdog_trips", 0),
         "demotions": summary.get("demotions", 0),
+        # checkpoint cadence cost: wall-clock spent writing journal
+        # generations (0.0 with checkpointing off) — bench_compare gates
+        # regressions on it, so a costlier snapshot format shows up here
+        "checkpoint_overhead_s": summary.get("checkpoint_overhead_s", 0.0),
         # sweep utilization: lane_sweeps_active / lane_sweeps_total
         # over every dispatching pass of the round (straggler-aware
         # scheduling headline; 1.0 = no lane ever idled through a
@@ -738,8 +745,8 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
     line = json.dumps(headline)
     if len(line) > 500:  # hard cap so the tail capture can never lose it
         for key in ("microbench_speedup", "microbench_device_warm_s",
-                    "mesh_row_ok", "sweep_util", "t3_wall_s", "error",
-                    "watchdog_trips", "demotions"):
+                    "mesh_row_ok", "sweep_util", "checkpoint_overhead_s",
+                    "t3_wall_s", "error", "watchdog_trips", "demotions"):
             headline.pop(key, None)
             line = json.dumps(headline)
             if len(line) <= 500:
@@ -879,6 +886,22 @@ def main() -> None:
         "demotions": sum(r.get("demotions", 0) for r in rows),
         "rpc_retries": sum(r.get("rpc_retries", 0) for r in rows),
         "faults_fired": sum(r.get("faults_fired", 0) for r in rows),
+        # preemption safety: quarantined lanes keep contexts on device
+        # under lane-dependent failures; checkpoint_overhead_s is the
+        # journal-write cost the headline gates (0.0 when off)
+        "quarantined_lanes": sum(
+            r.get("quarantined_lanes", 0) for r in rows
+        ),
+        "bisect_dispatches": sum(
+            r.get("bisect_dispatches", 0) for r in rows
+        ),
+        "checkpoints_written": sum(
+            r.get("checkpoints_written", 0) for r in rows
+        ),
+        "resumes": sum(r.get("resumes", 0) for r in rows),
+        "checkpoint_overhead_s": round(
+            sum(r.get("checkpoint_s", 0.0) for r in rows), 3
+        ),
         "solver_split": {
             k: round(sum(r[k] for r in rows), 2)
             for k in ("probe_s", "blast_s", "cone_s", "native_s",

@@ -250,11 +250,19 @@ class Report:
             # says so in-band (findings are identical either way — the
             # CDCL tail re-solves demoted lanes — but a consumer
             # correlating wall-clock needs to see the speedup was lost)
+            from mythril_tpu.resilience.checkpoint import (
+                drain_requested, get_checkpoint_plane,
+            )
             from mythril_tpu.resilience.telemetry import resilience_stats
 
             degraded = {
                 k: v for k, v in resilience_stats.as_dict().items() if v
             }
+            if drain_requested() or get_checkpoint_plane().partial:
+                # a drained run reports what it had at the last
+                # cooperative checkpoint — consumers must not read the
+                # issue list as the analysis's final word
+                degraded["partial"] = True
             if degraded:
                 meta["resilience"] = degraded
         except Exception:  # noqa: BLE001 — telemetry never breaks reports

@@ -271,6 +271,22 @@ def create_analyzer_parser(analyzer_parser: argparse.ArgumentParser) -> None:
         "blocking; see ops/async_dispatch.py)",
     )
     options.add_argument(
+        "--checkpoint-dir",
+        help="Journal the analysis (frontier, findings, solver memo "
+        "channels) into this directory so a preempted run can be "
+        "resumed; cadence via MYTHRIL_TPU_CHECKPOINT_PERIOD "
+        "(seconds, default 30)",
+        metavar="DIR",
+    )
+    options.add_argument(
+        "--resume",
+        dest="resume_dir",
+        help="Resume a preempted analysis from the journal in DIR "
+        "(implies --checkpoint-dir DIR); findings are identical to an "
+        "uninterrupted run",
+        metavar="DIR",
+    )
+    options.add_argument(
         "--proof-log",
         action="store_true",
         help="Record a DRAT-style proof stream on the native solver and "
@@ -533,6 +549,8 @@ def _build_analyzer(
         lockstep_dispatch=args.lockstep_dispatch,
         proof_log=args.proof_log,
         async_dispatch=not args.no_async_dispatch,
+        checkpoint_dir=getattr(args, "checkpoint_dir", None),
+        resume_from=getattr(args, "resume_dir", None),
         strategy=args.strategy,
         disassembler=disassembler,
         address=address,
@@ -757,6 +775,33 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
             getattr(args, "outform", "text"),
             "--enable-iprof must be used with -v LOG_LEVEL where LOG_LEVEL >= 4",
         )
+
+    if os.environ.get("MYTHRIL_TPU_FAULT") or os.environ.get(
+        "MYTHRIL_TPU_KILL_AT"
+    ):
+        # chaos specs must fail loudly HERE: a typo'd injection point
+        # that parsed lazily mid-analysis used to be swallowed by the
+        # batch path's broad except and pass the run vacuously
+        from mythril_tpu.resilience.faults import (
+            FaultSpecError, get_fault_plane,
+        )
+
+        try:
+            get_fault_plane()
+        except FaultSpecError as e:
+            # nonzero on purpose (exit_with_error exits 0): a chaos CI
+            # gate keying on $? must see the schedule was rejected
+            print(f"bad fault spec: {e}", file=sys.stderr)
+            sys.exit(2)
+
+    if args.command in ANALYZE_LIST or args.command == "truffle":
+        # graceful drain: SIGTERM/SIGINT walk the cooperative
+        # cancellation checkpoints, land a final journal generation,
+        # and ship a partial report (meta.resilience.partial) instead
+        # of dying mid-dispatch
+        from mythril_tpu.resilience.checkpoint import install_signal_handlers
+
+        install_signal_handlers()
 
     if args.command == "function-to-hash":
         print(MythrilDisassembler.hash_for_function_signature(args.func_name))

@@ -46,9 +46,15 @@ def prune_infeasible(states: List) -> List:
         undecided.append(state)
 
     from mythril_tpu.ops.batched_sat import effective_min_lanes
+    from mythril_tpu.resilience.checkpoint import drain_requested
 
     min_lanes = effective_min_lanes()
     use_batch = args.batched_solving and len(undecided) >= min_lanes
+    if drain_requested():
+        # graceful drain: don't start new device dispatches — verdicts
+        # fall to the memo-backed CDCL tail below (results unchanged)
+        # while the scheduler loop winds down to the final checkpoint
+        use_batch = False
     if use_batch:
         # gate on the number of *unique* constraint sets: sibling forks
         # often share identical constraints, and a deduped 1-2 lane

@@ -174,12 +174,26 @@ class LaserEVM:
 
     def _execute_transactions(self, address: int) -> None:
         """Run ``transaction_count`` message calls against every open
-        world state (reference svm.py:189)."""
-        from mythril_tpu.laser.ethereum.transaction import execute_message_call
+        world state (reference svm.py:189).
 
+        This loop is the durable-checkpoint spine (resilience/
+        checkpoint.py): a boundary snapshot (pruned frontier + findings
+        so far) is journaled before every transaction, a resumed
+        analysis re-enters here at the interrupted transaction's index,
+        and a drain request stops the loop at the next boundary with a
+        final checkpoint instead of dying mid-transaction."""
+        from mythril_tpu.laser.ethereum.transaction import execute_message_call
+        from mythril_tpu.resilience.checkpoint import (
+            drain_requested, get_checkpoint_plane,
+        )
+
+        plane = get_checkpoint_plane()
+        start_index = plane.restore_transactions(self, address)
         self._execute_hooks(self._start_exec_trans_hooks)
-        for i in range(self.transaction_count):
+        for i in range(start_index, self.transaction_count):
             if len(self.open_states) == 0:
+                break
+            if drain_requested():
                 break
             # Frontier pruning across transactions: the reference issues
             # one solver call per open state (svm.py:201-204); here the
@@ -192,6 +206,7 @@ class LaserEVM:
                 )
             ]
             self.iteration_states.append(len(self.open_states))
+            plane.transaction_boundary(self, address, i)
             log.info(
                 "Starting message call transaction, iteration: %d, "
                 "%d initial states",
@@ -201,6 +216,20 @@ class LaserEVM:
             self._execute_hooks(self._start_exec_hooks)
             execute_message_call(self, address)
             self._execute_hooks(self._stop_exec_hooks)
+        else:
+            if not drain_requested():
+                # completed every transaction: journal the final
+                # frontier so a kill during detection/reporting resumes
+                # to a no-op run
+                plane.transaction_boundary(self, address,
+                                           self.transaction_count)
+        if drain_requested():
+            # a drain ANYWHERE inside a transaction must leave the
+            # journal at that transaction's start boundary (never a
+            # completion boundary over partially explored states), so
+            # a later --resume re-executes it and recovers the full
+            # findings the partial report could not carry
+            plane.finalize(partial=True)
         self._execute_hooks(self._stop_exec_trans_hooks)
 
     # ------------------------------------------------------------------
@@ -217,11 +246,25 @@ class LaserEVM:
         their successors in a single ``prune_infeasible`` pass — wide
         enough for the TPU lockstep solver to engage mid-transaction.
         """
+        from mythril_tpu.resilience.checkpoint import (
+            drain_requested, get_checkpoint_plane,
+        )
+
+        plane = get_checkpoint_plane()
         final_states: List[GlobalState] = []
         if self.time is None:
             self.time = datetime.now()
         batch_width = max(1, getattr(args, "batch_width", 1))
         while True:
+            if drain_requested():
+                # graceful drain: stop drawing work — in-flight rounds
+                # have already landed, the boundary checkpoint survives,
+                # and the partial report is emitted by the caller
+                break
+            # journal refresh cadence (and demotion-triggered writes)
+            # rides the scheduler round boundary: the only point where
+            # no dispatch is in flight and the channels are consistent
+            plane.tick()
             batch = self.strategy.pop_batch(batch_width)
             if not batch:
                 break

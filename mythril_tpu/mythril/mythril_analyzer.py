@@ -84,6 +84,8 @@ class MythrilAnalyzer:
         lockstep_dispatch: bool = False,
         proof_log: bool = False,
         async_dispatch: bool = True,
+        checkpoint_dir: Optional[str] = None,
+        resume_from: Optional[str] = None,
     ):
         self.eth = disassembler.eth
         self.contracts: List[EVMContract] = disassembler.contracts or []
@@ -114,6 +116,11 @@ class MythrilAnalyzer:
         args.lockstep_dispatch = lockstep_dispatch
         args.proof_log = proof_log
         args.async_dispatch = async_dispatch
+        # preemption safety: the checkpoint plane late-binds to these
+        # (resilience/checkpoint.py pulls them at the first transaction
+        # boundary); --resume implies journaling into the same dir
+        args.checkpoint_dir = checkpoint_dir or resume_from
+        args.resume_from = resume_from
 
     # ------------------------------------------------------------------
     # symbolic-executor factory — single assembly point for every mode

@@ -90,9 +90,31 @@ class DispatchStats:
     solver-statistics report so speedup claims stay attributable)."""
 
     def __init__(self):
-        self.reset()
+        # construction must NOT cascade into the resilience/coalescer
+        # resets below: this module imports lazily, and a run that never
+        # dispatched (e.g. a resumed analysis whose journal already
+        # covered every transaction) would otherwise wipe live
+        # resilience counters (resumes, checkpoints_written) the moment
+        # the checkpoint plane first touches dispatch_stats
+        self._reset_own()
 
     def reset(self):
+        self._reset_own()
+        # degradation counters (watchdog_trips, dispatch_retries,
+        # demotions, rpc_retries, faults_fired) live in the resilience
+        # package and reset with this object so per-contract rows stay
+        # per-contract
+        from mythril_tpu.resilience.telemetry import resilience_stats
+
+        resilience_stats.reset()
+        # the admission queue is generation-scoped; clearing it with the
+        # stats keeps per-contract bench rows from inheriting a stale
+        # window (lazy import — coalesce reads these stats back)
+        from mythril_tpu.ops.coalesce import reset_coalescer
+
+        reset_coalescer()
+
+    def _reset_own(self):
         self.dispatches = 0        # device solve invocations
         self.lanes = 0             # total lanes sent to device
         self.unsat = 0             # lanes decided UNSAT on device
@@ -159,19 +181,6 @@ class DispatchStats:
         # transaction seeds replaced by dispatcher pre-split states
         # (laser/ethereum/lockstep_dispatch.py)
         self.presplit_states = 0
-        # degradation counters (watchdog_trips, dispatch_retries,
-        # demotions, rpc_retries, faults_fired) live in the resilience
-        # package and reset with this object so per-contract rows stay
-        # per-contract
-        from mythril_tpu.resilience.telemetry import resilience_stats
-
-        resilience_stats.reset()
-        # the admission queue is generation-scoped; clearing it with the
-        # stats keeps per-contract bench rows from inheriting a stale
-        # window (lazy import — coalesce reads these stats back)
-        from mythril_tpu.ops.coalesce import reset_coalescer
-
-        reset_coalescer()
 
     def as_dict(self):
         from mythril_tpu.resilience.telemetry import resilience_stats
@@ -735,14 +744,25 @@ class BatchedSatBackend:
         key ``{key_base}:{budget}`` so the latency-EWMA deadline model
         tracks the round's actual step budget, and each round fires the
         dispatch fault point (chaos tests exercise every rung through
-        this path).  Raises DispatchAbandoned when the ladder gives up
-        — callers demote the context exactly as before.
+        this path).
+
+        A round whose dispatch fails *repeatably* (the retry rung
+        exhausted) is bisected instead of demoting the context: halves
+        of the live lanes re-dispatch (single attempt each, log2
+        re-dispatches over the existing lane buckets) until the failing
+        lane(s) are isolated and quarantined to the CDCL tail — see
+        :meth:`_dispatch_round`.  Only when every lane fails alone does
+        the ladder give up and raise DispatchAbandoned for the caller's
+        context demotion, exactly as before.
+
+        A drain request (resilience/checkpoint.py) is honored between
+        rounds: survivors retire undecided so the analysis can land a
+        final checkpoint instead of dying mid-dispatch.
 
         Returns (status[batch] int32 with bails mapped to undecided,
         final assign[batch, V1] int8).
         """
-        from mythril_tpu.resilience import faults
-        from mythril_tpu.resilience.watchdog import get_watchdog
+        from mythril_tpu.resilience.checkpoint import drain_requested
 
         _, jnp = _require_jax()
         assign = np.asarray(assign, dtype=np.int8)
@@ -782,22 +802,18 @@ class BatchedSatBackend:
         for budget in budgets:
             if live.size == 0:
                 break
+            if drain_requested():
+                # cooperative drain checkpoint: abandon the remaining
+                # rounds — survivors retire undecided (the CDCL tail or
+                # the resumed run finishes them, findings unchanged)
+                break
             state["step"][:] = 0  # per-round active-sweep counters
             step_fn = self._cached_round(V1 - 1, budget)
-            vals = [jnp.asarray(state[k]) for k in order]
-
-            def _thunk():
-                faults.maybe_fault_dispatch()
-                out = step_fn(lits, *vals)
-                # the host copy blocks until the round finished — the
-                # wedge point, so it belongs inside the supervision
-                # (np.array, not asarray: the ladder mutates the state
-                # between rounds and jax exports read-only views)
-                return [np.array(o) for o in out]
-
-            out = get_watchdog().supervised(f"{key_base}:{budget}",
-                                            _thunk)
-            state = dict(zip(order, out))
+            state, quarantined = self._dispatch_round(
+                f"{key_base}:{budget}", step_fn, lits, state, order, live
+            )
+            for local in quarantined:
+                state["status"][local] = 3  # undecided -> CDCL tail
             dispatch_stats.rounds += 1
             steps_live = state["step"][: live.size]
             steps_used = int(steps_live.max()) if live.size else 0
@@ -831,6 +847,100 @@ class BatchedSatBackend:
             statuses_out[live[local]] = state["status"][local]
             assign_out[live[local]] = state["assign"][local]
         return np.where(statuses_out == 3, 0, statuses_out), assign_out
+
+    def _dispatch_round(self, key, step_fn, lits, state, order, live):
+        """One supervised ladder round over ``state`` (bucket-sized
+        arrays, rows < live.size live) with poisoned-lane bisection.
+
+        The happy path is the classic retry rung.  When it exhausts
+        (repeatable failure), the live lanes are bisected: each half
+        re-dispatches once (no retries — the failure is already proven
+        repeatable), failing halves split again, and lanes that fail
+        alone are quarantined (returned for the caller to retire to the
+        CDCL tail; ``quarantined_lanes``/``bisect_dispatches``
+        telemetry).  The context stays on device.  Only when every lane
+        fails alone — the failure is not lane-dependent — does the
+        ladder escalate through watchdog.give_up (re-probe, demotion
+        accounting, DispatchAbandoned) exactly like the pre-bisection
+        ladder.
+
+        Returns (state', quarantined local positions).
+        """
+        from mythril_tpu.resilience import faults
+        from mythril_tpu.resilience.telemetry import resilience_stats
+        from mythril_tpu.resilience.watchdog import (
+            DispatchFailed, get_watchdog,
+        )
+
+        _, jnp = _require_jax()
+        dog = get_watchdog()
+
+        def attempt(sub_state, sub_ids, retries=None):
+            vals = [jnp.asarray(sub_state[k]) for k in order]
+
+            def _thunk():
+                faults.maybe_fault_dispatch(lane_ids=sub_ids)
+                out = step_fn(lits, *vals)
+                # the host copy blocks until the round finished — the
+                # wedge point, so it belongs inside the supervision
+                # (np.array, not asarray: the ladder mutates the state
+                # between rounds and jax exports read-only views)
+                return [np.array(o) for o in out]
+
+            return dict(zip(order, dog.run_attempts(
+                key, _thunk, retries=retries
+            )))
+
+        batch_ids = [int(i) for i in live]
+        try:
+            return attempt(state, batch_ids), []
+        except DispatchFailed as exc:
+            last = exc.last
+        n = int(live.size)
+        if n == 1:
+            # a single-lane batch cannot be bisected: lane poison and
+            # device failure are indistinguishable — escalate
+            dog.give_up(key, last)
+        quarantined: List[int] = []
+
+        def bisect(positions):
+            resilience_stats.bisect_dispatches += 1
+            B_sub = lane_bucket(len(positions))
+            idx = np.concatenate(
+                [positions,
+                 np.repeat(positions[:1], B_sub - len(positions))]
+            )
+            sub = {k: np.ascontiguousarray(state[k][idx]) for k in order}
+            sub["status"][len(positions):] = 3  # pads stay inert
+            try:
+                out = attempt(sub, [int(live[p]) for p in positions],
+                              retries=0)
+            except DispatchFailed:
+                if len(positions) == 1:
+                    quarantined.append(int(positions[0]))
+                    return
+                half = len(positions) // 2
+                bisect(positions[:half])
+                bisect(positions[half:])
+                return
+            for j, p in enumerate(positions):
+                for k in order:
+                    state[k][p] = out[k][j]
+
+        half = n // 2
+        bisect(np.arange(half))
+        bisect(np.arange(half, n))
+        if len(quarantined) == n:
+            # every lane fails alone: the device (or this shape) is the
+            # problem, not a lane — classic escalation
+            dog.give_up(key, last)
+        resilience_stats.quarantined_lanes += len(quarantined)
+        log.warning(
+            "poisoned-lane bisection on %s: quarantined %d/%d lanes to "
+            "the CDCL tail; context stays on device", key,
+            len(quarantined), n,
+        )
+        return state, quarantined
 
     def _build_cone_batch(self, ctx, assumption_sets):
         """Device inputs for the union-cone tier: (rows [N,K] int32

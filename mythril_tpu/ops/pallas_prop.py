@@ -1032,6 +1032,7 @@ def _run_dense_ladder(
     """
     from mythril_tpu.ops.batched_sat import dispatch_stats
     from mythril_tpu.resilience import faults
+    from mythril_tpu.resilience.checkpoint import drain_requested
     from mythril_tpu.resilience.watchdog import raise_if_cancelled
 
     B, V = A0.shape
@@ -1054,8 +1055,12 @@ def _run_dense_ladder(
             break
         # cooperative checkpoints: the whole ladder runs inside one
         # supervised "pallas" dispatch, so an abandoned worker bails
-        # between rounds instead of racing the host on shared state
+        # between rounds instead of racing the host on shared state —
+        # and a graceful drain lands here too, retiring survivors
+        # undecided so a final checkpoint can be written
         raise_if_cancelled()
+        if drain_requested():
+            break
         faults.maybe_fault_dispatch()
         fn = round_fn(B, budget, hot_c)
         out = fn(*planes, *state)

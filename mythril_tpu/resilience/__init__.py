@@ -14,15 +14,23 @@ closes that gap:
   own observed latency EWMA, plus the escalation ladder a tripped
   deadline walks (bounded retry with backoff → subprocess re-probe →
   context demotion → process demotion);
+- :mod:`checkpoint` — the durable checkpoint/resume plane (atomic
+  CRC-checked journal under ``--checkpoint-dir``, transaction-boundary
+  frontier snapshots, periodic channel refresh, ``--resume``) plus the
+  graceful-drain flag SIGTERM/SIGINT set and every long loop polls;
 - :mod:`telemetry` — the counters (``watchdog_trips``,
-  ``dispatch_retries``, ``demotions``, ``rpc_retries``,
-  ``faults_fired``) threaded through the dispatch stats, the bench
-  headline, and the jsonv2 report.
+  ``dispatch_retries``, ``demotions``, ``quarantined_lanes``,
+  ``bisect_dispatches``, ``checkpoints_written``, ``resumes``,
+  ``rpc_retries``, ``faults_fired``) threaded through the dispatch
+  stats, the bench headline, and the jsonv2 report.
 
 Design rule shared by every consumer: degradation never changes
 *results*, only who computes them — a demoted analysis re-solves every
-in-flight lane on the native CDCL tail, so findings are identical to
-the fault-free run and only the batching speedup is lost.
+in-flight lane on the native CDCL tail, a quarantined lane is re-solved
+there alone (the context stays on device), and a killed-and-resumed
+analysis rebuilds its frontier from the journal — findings are
+identical to the fault-free, uninterrupted run in every case; only
+speedup is lost.
 """
 
 from mythril_tpu.resilience.telemetry import resilience_stats  # noqa: F401

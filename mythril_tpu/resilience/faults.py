@@ -28,23 +28,39 @@ Injection points:
                      batch must be dropped, never decided)
 ``rpc_error``        the RPC transport raises a transient ``OSError``
 ``rpc_http_500``     the RPC transport answers HTTP 500
+``lane_poison``      a device dispatch raises ONLY while a designated
+                     lane is in the dispatched batch (models a
+                     lane-dependent kernel abort: one query's data
+                     wedges the kernel while its siblings are fine) —
+                     the poisoned-lane bisection's territory
 ==================  =====================================================
 
 Faults are armed either through the API (:meth:`FaultPlane.arm`) or the
 environment::
 
-    MYTHRIL_TPU_FAULT="dispatch_hang:3:1,rpc_error"
+    MYTHRIL_TPU_FAULT="dispatch_hang:3:1,rpc_error,lane_poison:9:0:2"
 
-Each comma-separated spec is ``point[:times[:skip]]`` — fire ``times``
-shots (default 1) after letting ``skip`` clean hits through (default 0,
-so ``skip`` is how a fault lands *mid*-analysis instead of on the first
-dispatch).  ``MYTHRIL_TPU_FAULT_HANG_S`` sets the hang duration
-(default 30 s — far past any test deadline, so an untripped watchdog is
-a loud failure, not a flake).
+Each comma-separated spec is ``point[:times[:skip[:lane]]]`` — fire
+``times`` shots (default 1) after letting ``skip`` clean hits through
+(default 0, so ``skip`` is how a fault lands *mid*-analysis instead of
+on the first dispatch); ``lane`` designates the poisoned lane for
+``lane_poison``.  A malformed spec (typo'd point name, non-integer
+field) raises :class:`FaultSpecError` — a chaos run configured to
+inject nothing must die at startup, not pass vacuously.
+``MYTHRIL_TPU_FAULT_HANG_S`` sets the hang duration (default 30 s —
+far past any test deadline, so an untripped watchdog is a loud
+failure, not a flake).
 
 Firing is deterministic: a shot is consumed per hit of the point, under
 a lock, with no randomness — the same schedule fires the same faults in
 the same order on every run.
+
+Kill-resume hook: ``MYTHRIL_TPU_KILL_AT="point[:skip]"`` SIGKILLs the
+process the moment the named injection point is *reached* (after
+``skip`` clean hits), whether or not a fault is armed there — the
+checkpoint/resume chaos driver (``scripts/chaos_corpus.py
+--kill-resume``) uses it to die at every seam and prove the journal
+restores identical findings.
 """
 
 import logging
@@ -67,6 +83,7 @@ FAULT_POINTS = (
     "prefetch_error",
     "rpc_error",
     "rpc_http_500",
+    "lane_poison",
 )
 
 DEFAULT_HANG_S = 30.0
@@ -78,6 +95,12 @@ class FaultInjected(RuntimeError):
     injection point)."""
 
 
+class FaultSpecError(ValueError):
+    """A malformed ``MYTHRIL_TPU_FAULT`` / ``MYTHRIL_TPU_KILL_AT``
+    spec.  Raised at plane construction so a chaos run whose schedule
+    would silently inject nothing dies at startup instead."""
+
+
 class FaultPlane:
     """Armed fault shots, keyed by injection point."""
 
@@ -85,33 +108,45 @@ class FaultPlane:
         self._lock = threading.Lock()
         self._armed: Dict[str, dict] = {}
         self.fired: Dict[str, int] = {}
+        self.hits: Dict[str, int] = {}
+        self._kill_at: Optional[str] = None
+        self._kill_skip = 0
         self._load_env()
 
     # -- arming --------------------------------------------------------
 
     def arm(self, point: str, times: int = 1, skip: int = 0,
-            hang_s: Optional[float] = None) -> None:
+            hang_s: Optional[float] = None,
+            lane: Optional[int] = None) -> None:
         """Arm ``times`` shots of ``point``, skipping the first ``skip``
-        hits (a skip is how a fault lands mid-run)."""
+        hits (a skip is how a fault lands mid-run).  ``lane`` names the
+        poisoned lane for ``lane_poison`` — the shot only fires (and
+        only counts a hit) while that lane is in the dispatched
+        batch."""
         if point not in FAULT_POINTS:
-            raise ValueError(
+            raise FaultSpecError(
                 f"unknown fault point {point!r} (choose from {FAULT_POINTS})"
+            )
+        if point == "lane_poison" and lane is None:
+            raise FaultSpecError(
+                "lane_poison needs a lane (arm(..., lane=K) or the "
+                "fourth spec field: lane_poison:times:skip:K)"
             )
         with self._lock:
             self._armed[point] = {
                 "times": times, "skip": skip, "hang_s": hang_s,
+                "lane": lane,
             }
 
     def clear(self) -> None:
         with self._lock:
             self._armed.clear()
             self.fired.clear()
+            self.hits.clear()
 
     def _load_env(self) -> None:
         spec = os.environ.get("MYTHRIL_TPU_FAULT", "").strip()
-        if not spec:
-            return
-        for part in spec.split(","):
+        for part in spec.split(",") if spec else ():
             fields = part.strip().split(":")
             if not fields[0]:
                 continue
@@ -120,30 +155,70 @@ class FaultPlane:
                     fields[0],
                     times=int(fields[1]) if len(fields) > 1 else 1,
                     skip=int(fields[2]) if len(fields) > 2 else 0,
+                    lane=int(fields[3]) if len(fields) > 3 else None,
                 )
+            except FaultSpecError:
+                raise
             except (ValueError, IndexError) as exc:
-                log.warning("ignoring bad MYTHRIL_TPU_FAULT spec %r (%s)",
-                            part, exc)
+                raise FaultSpecError(
+                    f"bad MYTHRIL_TPU_FAULT spec {part!r}: {exc}"
+                ) from exc
+        kill = os.environ.get("MYTHRIL_TPU_KILL_AT", "").strip()
+        if kill:
+            fields = kill.split(":")
+            if fields[0] not in FAULT_POINTS:
+                raise FaultSpecError(
+                    f"MYTHRIL_TPU_KILL_AT names unknown point "
+                    f"{fields[0]!r} (choose from {FAULT_POINTS})"
+                )
+            try:
+                self._kill_skip = int(fields[1]) if len(fields) > 1 else 0
+            except ValueError as exc:
+                raise FaultSpecError(
+                    f"bad MYTHRIL_TPU_KILL_AT spec {kill!r}: {exc}"
+                ) from exc
+            self._kill_at = fields[0]
 
     # -- firing --------------------------------------------------------
 
-    def fire(self, point: str) -> Optional[dict]:
+    def fire(self, point: str, lane_ids=None) -> Optional[dict]:
         """Consume one hit of ``point``.  Returns the armed spec when a
         shot fires, None when the point is unarmed or the hit was a
-        configured skip.  The caller applies the effect."""
+        configured skip.  The caller applies the effect.  For
+        ``lane_poison``, a hit only registers while the armed lane is
+        present in ``lane_ids`` — absence neither fires nor consumes.
+        The kill-at hook (see module docstring) triggers on hits of its
+        named point regardless of what is armed."""
+        kill_now = False
         with self._lock:
             spec = self._armed.get(point)
-            if spec is None:
-                return None
-            if spec["skip"] > 0:
-                spec["skip"] -= 1
-                return None
-            if spec["times"] <= 0:
-                return None
-            spec["times"] -= 1
-            self.fired[point] = self.fired.get(point, 0) + 1
-            resilience_stats.faults_fired += 1
-        log.info("fault plane: firing %s", point)
+            if spec is not None and spec.get("lane") is not None and (
+                lane_ids is None or spec["lane"] not in lane_ids
+            ):
+                spec = None
+            self.hits[point] = self.hits.get(point, 0) + 1
+            if point == self._kill_at:
+                if self._kill_skip > 0:
+                    self._kill_skip -= 1
+                else:
+                    kill_now = True
+            if spec is not None:
+                if spec["skip"] > 0:
+                    spec["skip"] -= 1
+                    spec = None
+                elif spec["times"] <= 0:
+                    spec = None
+                else:
+                    spec["times"] -= 1
+                    self.fired[point] = self.fired.get(point, 0) + 1
+                    resilience_stats.faults_fired += 1
+        if kill_now:
+            log.warning("fault plane: SIGKILL at injection point %s "
+                        "(MYTHRIL_TPU_KILL_AT)", point)
+            logging.shutdown()
+            os.kill(os.getpid(), 9)  # SIGKILL: no cleanup, by design
+        if spec is not None:
+            log.info("fault plane: firing %s", point)
         return spec
 
 
@@ -173,13 +248,18 @@ def _hang_s(spec: dict) -> float:
     return float(os.environ.get("MYTHRIL_TPU_FAULT_HANG_S", DEFAULT_HANG_S))
 
 
-def maybe_fault_dispatch() -> None:
+def maybe_fault_dispatch(lane_ids=None) -> None:
     """Device-dispatch seam: called inside the watchdog-supervised
     thunk, so a hang is tripped by the deadline and an error lands in
     the retry rung.  A hang sleeps and then RAISES (never falls through
     to the real dispatch): a real wedge parks the worker inside the
     runtime forever, so the worker resuming and racing the host would
-    be an artifact of injection, not a behavior to simulate."""
+    be an artifact of injection, not a behavior to simulate.
+
+    ``lane_ids`` names the lanes riding this dispatch (the round
+    ladder's global batch positions): an armed ``lane_poison`` raises
+    only while its lane is aboard, which is what lets the bisection
+    isolate it."""
     plane = get_fault_plane()
     spec = plane.fire("dispatch_hang")
     if spec is not None:
@@ -190,6 +270,10 @@ def maybe_fault_dispatch() -> None:
     if plane.fire("dispatch_error") is not None:
         raise FaultInjected(
             "injected XlaRuntimeError: device dispatch failed"
+        )
+    if plane.fire("lane_poison", lane_ids=lane_ids) is not None:
+        raise FaultInjected(
+            "injected lane-dependent kernel abort (poisoned lane aboard)"
         )
 
 

@@ -26,6 +26,16 @@ class ResilienceStats:
         #                             channel abandoned)
         self.rpc_retries = 0        # transient RPC failures retried
         self.faults_fired = 0       # injected faults actually fired
+        # poisoned-lane bisection (ops/batched_sat._solve_gather_ladder):
+        # a repeatably failing round dispatch is bisected instead of
+        # demoting the whole context — only the offending lane(s) go to
+        # the CDCL tail and the context stays on device
+        self.quarantined_lanes = 0  # lanes isolated to the CDCL tail
+        self.bisect_dispatches = 0  # re-dispatches spent isolating them
+        # checkpoint/resume plane (resilience/checkpoint.py)
+        self.checkpoints_written = 0  # journal generations persisted
+        self.resumes = 0              # analyses rebuilt from a journal
+        self.checkpoint_s = 0.0       # wall-clock spent writing journals
 
     def as_dict(self):
         return dict(self.__dict__)

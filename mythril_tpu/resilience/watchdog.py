@@ -32,6 +32,15 @@ runtime returns (host-side chunk loops that touch the blast context)
 must call :func:`raise_if_cancelled` between chunks so an abandoned
 worker can never race the host on shared native state.
 
+Between the retry rung and context demotion sits the poisoned-lane
+bisection (ops/batched_sat.py): a repeatably failing round-ladder
+dispatch is bisected over the lane buckets and only the offending
+lane(s) are quarantined to the CDCL tail — the context stays on
+device.  This module exposes the rungs separately for it:
+:meth:`DispatchWatchdog.run_attempts` (retry rung, raises
+:class:`DispatchFailed`) and :meth:`DispatchWatchdog.give_up`
+(re-probe + demotion accounting + :class:`DispatchAbandoned`).
+
 Env knobs:
   MYTHRIL_TPU_DISPATCH_TIMEOUT   deadline cap in seconds (default 120;
                                  first compile of a shape can be slow)
@@ -39,6 +48,8 @@ Env knobs:
   MYTHRIL_TPU_DISPATCH_BACKOFF_S retry backoff base (default 0.05)
   MYTHRIL_TPU_REPROBE_TIMEOUT    subprocess re-probe deadline (default 20)
   MYTHRIL_TPU_REPROBE=0          skip the re-probe rung entirely
+  MYTHRIL_TPU_EWMA_CAP           latency-table entry cap (default 64,
+                                 LRU eviction like the probe memo)
 """
 
 import logging
@@ -56,10 +67,37 @@ DEADLINE_FLOOR_S = 5.0   # warm deadlines never drop below this
 DEADLINE_MULT = 8.0      # deadline = EWMA x this (dispatch latency has
 #                          heavy tails: pool refresh, cache miss)
 EWMA_ALPHA = 0.3
+# latency-table entry cap: round-ladder keys ("gather:64", "cone:512")
+# multiply the key space per bucket, and a long soak over many pool
+# shapes would otherwise grow the table without bound.  LRU like
+# PROBE_MEMO_CAP: hits refresh recency, the stale quarter is evicted.
+EWMA_CAP = 64
+
+
+def ewma_cap() -> int:
+    """Effective latency-table cap: ``MYTHRIL_TPU_EWMA_CAP`` when set,
+    floored so the eviction quarter never rounds to zero."""
+    try:
+        return max(8, int(os.environ.get("MYTHRIL_TPU_EWMA_CAP",
+                                         EWMA_CAP)))
+    except ValueError:
+        return EWMA_CAP
 
 
 class WatchdogTimeout(RuntimeError):
     """A supervised dispatch exceeded its deadline."""
+
+
+class DispatchFailed(RuntimeError):
+    """The retry rung exhausted its attempts for one dispatch.  Raised
+    by :meth:`DispatchWatchdog.run_attempts` WITHOUT demoting anything:
+    the caller decides whether to escalate (``give_up`` — the classic
+    context demotion) or to bisect the batch for a poisoned lane
+    (ops/batched_sat.py)."""
+
+    def __init__(self, message: str, last: Optional[BaseException] = None):
+        super().__init__(message)
+        self.last = last
 
 
 class WatchdogCancelled(RuntimeError):
@@ -111,14 +149,28 @@ class DispatchWatchdog:
 
     def deadline_for(self, key: str) -> float:
         cap = _env_f("MYTHRIL_TPU_DISPATCH_TIMEOUT", 120.0)
-        ewma = self._ewma.get(key)
+        with self._lock:
+            ewma = self._ewma.get(key)
+            if ewma is not None:
+                # refresh recency (dict preserves insertion order): a
+                # shape still dispatching must never be the one evicted
+                del self._ewma[key]
+                self._ewma[key] = ewma
         if ewma is None:
             return cap  # cold key: jit compile dominates, grant the cap
         return min(cap, max(DEADLINE_FLOOR_S, ewma * DEADLINE_MULT))
 
     def observe(self, key: str, elapsed_s: float) -> None:
         with self._lock:
-            prev = self._ewma.get(key)
+            prev = self._ewma.pop(key, None)
+            if prev is None:
+                cap = ewma_cap()
+                if len(self._ewma) >= cap:
+                    # bounded like the probe memo: round-ladder keys
+                    # ("gather:64" x pool buckets) grow the table per
+                    # shape — drop the least-recently-used quarter
+                    for stale in list(self._ewma)[: cap // 4]:
+                        del self._ewma[stale]
             self._ewma[key] = (
                 elapsed_s if prev is None
                 else prev + EWMA_ALPHA * (elapsed_s - prev)
@@ -160,10 +212,15 @@ class DispatchWatchdog:
 
     # -- the escalation ladder -----------------------------------------
 
-    def supervised(self, key: str, thunk: Callable):
-        """Run ``thunk`` under the full ladder; returns its result or
-        raises :class:`DispatchAbandoned` after every rung failed."""
-        retries = int(_env_f("MYTHRIL_TPU_DISPATCH_RETRIES", 2))
+    def run_attempts(self, key: str, thunk: Callable,
+                     retries: Optional[int] = None):
+        """The retry rung alone: bounded attempts with exponential
+        backoff + jitter.  Returns the thunk's result or raises
+        :class:`DispatchFailed` — no re-probe, no demotion accounting,
+        so callers with a cheaper recovery (poisoned-lane bisection)
+        can try it before escalating via :meth:`give_up`."""
+        if retries is None:
+            retries = int(_env_f("MYTHRIL_TPU_DISPATCH_RETRIES", 2))
         backoff = _env_f("MYTHRIL_TPU_DISPATCH_BACKOFF_S", 0.05)
         last: Optional[BaseException] = None
         for attempt in range(retries + 1):
@@ -190,13 +247,33 @@ class DispatchWatchdog:
                     "%s dispatch raised (%s: %s) (attempt %d/%d)",
                     key, type(exc).__name__, exc, attempt + 1, retries + 1,
                 )
+        raise DispatchFailed(
+            f"{key} dispatch failed after {retries + 1} attempts ({last})",
+            last=last,
+        )
+
+    def give_up(self, key: str, last: Optional[BaseException]):
+        """Terminal escalation for a dispatch nothing could recover:
+        subprocess re-probe, demotion accounting, a checkpoint nudge
+        (a degrading run is exactly the run about to be preempted), and
+        :class:`DispatchAbandoned` for the caller's context demotion."""
         process_demoted = self._reprobe_and_maybe_demote(key, last)
         resilience_stats.demotions += 1
+        from mythril_tpu.resilience.checkpoint import get_checkpoint_plane
+
+        get_checkpoint_plane().note_demotion()
         raise DispatchAbandoned(
-            f"{key} dispatch abandoned after {retries + 1} attempts "
-            f"({last})",
+            f"{key} dispatch abandoned ({last})",
             process_demoted=process_demoted,
         )
+
+    def supervised(self, key: str, thunk: Callable):
+        """Run ``thunk`` under the full ladder; returns its result or
+        raises :class:`DispatchAbandoned` after every rung failed."""
+        try:
+            return self.run_attempts(key, thunk)
+        except DispatchFailed as exc:
+            self.give_up(key, exc.last)
 
     def _reprobe_and_maybe_demote(self, key: str, last) -> bool:
         """Ladder rung 3: ask a killable subprocess whether the device

@@ -52,6 +52,12 @@ class Args(object, metaclass=Singleton):
         # and merge them with the next compatible batch so lane
         # buckets ship full; off routes every batch straight through
         self.device_coalesce = True
+        # preemption safety (resilience/checkpoint.py): journal the
+        # exploration frontier + findings + solver channels under this
+        # directory (None = checkpointing off); resume_from rebuilds
+        # the frontier from an existing journal and continues
+        self.checkpoint_dir = None
+        self.resume_from = None
         # concrete-prefix dispatcher pre-split (SoA-validated): replace
         # each transaction seed with per-selector states at the
         # function entries (laser/ethereum/lockstep_dispatch.py).

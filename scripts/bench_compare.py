@@ -22,8 +22,10 @@ import os
 import re
 import sys
 
-#: headline metrics gated on regression (larger = worse)
-GATED = ("t3_wall_s", "device_s")
+#: headline metrics gated on regression (larger = worse);
+#: checkpoint_overhead_s gates checkpoint-cadence regressions — a
+#: costlier journal format or an over-eager cadence shows up here
+GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s")
 #: floor below which a baseline is noise and ratios are meaningless
 MIN_BASE = 0.05
 

@@ -1,15 +1,26 @@
 """Chaos soak: run the bench corpus under a randomized fault schedule.
 
 Manual driver (not CI — the deterministic tier-1 chaos tests live in
-tests/test_faults.py).  Each round analyzes the embedded corpus with a
-randomly drawn fault armed on the resilience plane mid-run, then checks
-the two ladder invariants:
+tests/test_faults.py and tests/test_checkpoint.py).  Each round
+analyzes the embedded corpus with a randomly drawn fault armed on the
+resilience plane mid-run, then checks the two ladder invariants:
 
 - findings identical to the fault-free reference run;
 - the matching degradation counter moved.
 
 Usage:
     JAX_PLATFORMS=cpu python scripts/chaos_corpus.py [--rounds N] [--seed S]
+
+``--kill-resume`` instead drives the checkpoint/resume plane: for each
+named injection point the chaos-tree analysis runs in a subprocess that
+is SIGKILLed the moment the point is hit (``MYTHRIL_TPU_KILL_AT``,
+journaling under a fresh ``--checkpoint-dir`` at every scheduler
+round), then a second subprocess resumes from the journal, and the
+round passes only when the resumed findings are identical to the
+uninterrupted reference run.  A final round arms a lane-dependent
+``lane_poison`` fault (no kill) and asserts the poisoned lane is
+quarantined alone: ``quarantined_lanes`` >= 1 with ``demotions``
+unchanged at 0, the context still on device.
 
 Exit status is nonzero when any round broke findings parity, so the
 script doubles as a soak gate before hardware rounds.
@@ -19,7 +30,9 @@ import argparse
 import json
 import os
 import random
+import subprocess
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -65,11 +78,192 @@ def _analyze_corpus():
     return results, counters
 
 
+# kill-resume schedule: (injection point, clean hits let through before
+# the SIGKILL) — early and mid-analysis seams of every point the
+# chaos-tree workload actually reaches (a point that is never hit makes
+# its round vacuous, which the driver reports as a failure)
+KILL_POINTS = [
+    ("dispatch_hang", 0),    # first device dispatch (often pre-boundary)
+    ("dispatch_hang", 4),    # a dispatch mid-analysis
+    ("dispatch_garbage", 1), # after a dispatch returned (the point is
+    #                          hit once per dispatch, and the chaos
+    #                          tree makes two)
+    ("cdcl_error", 0),       # first native CDCL call
+    ("cdcl_error", 25),      # deep in the CDCL tail
+    ("probe_flap", 1),       # a device health check mid-run
+]
+
+KR_TX_COUNT = 2  # two transactions => at least one mid-run boundary
+
+
+def _kr_configure():
+    """Child/process-local knobs shared by every kill-resume analysis
+    (mirrors the soak configuration above: the workload must actually
+    reach the device paths the kill points live on)."""
+    import logging
+
+    logging.basicConfig(level=logging.ERROR)
+    from mythril_tpu.support.support_args import args
+
+    args.device_min_lanes = 2
+    args.device_force_dispatch = True
+    args.word_probing = False
+    args.async_dispatch = False  # dispatches stay on the kill path
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        os.environ.setdefault("MYTHRIL_TPU_PALLAS", "off")
+
+
+def _kr_child(checkpoint_dir, resume) -> int:
+    """Subprocess body: one chaos-tree analysis, journaling under
+    ``checkpoint_dir`` (and resuming from it when ``resume``); prints
+    one JSON line with the findings and the resilience counters.  The
+    kill variant never reaches the print — MYTHRIL_TPU_KILL_AT lands
+    first."""
+    _kr_configure()
+    import bench
+    from mythril_tpu.support.support_args import args
+
+    args.checkpoint_dir = checkpoint_dir
+    args.resume_from = checkpoint_dir if resume else None
+    found, row = bench._analyze_one(
+        "chaos_tree", bench.chaos_tree_contract(), KR_TX_COUNT,
+        execution_timeout=120, max_depth=128,
+    )
+    print(json.dumps({
+        "found": sorted(found),
+        "resumes": row.get("resumes", 0),
+        "checkpoints_written": row.get("checkpoints_written", 0),
+        "quarantined_lanes": row.get("quarantined_lanes", 0),
+        "bisect_dispatches": row.get("bisect_dispatches", 0),
+        "demotions": row.get("demotions", 0),
+        "dispatches": row.get("dispatches", 0),
+        "fused": row.get("fused", False),
+    }))
+    return 0
+
+
+def _kr_spawn(checkpoint_dir=None, resume=False, extra_env=None):
+    """Run one child analysis; returns (returncode, payload|None)."""
+    env = dict(os.environ)
+    env.pop("MYTHRIL_TPU_KILL_AT", None)
+    env.pop("MYTHRIL_TPU_FAULT", None)
+    env["MYTHRIL_TPU_CHECKPOINT_PERIOD"] = "0"  # refresh every round
+    env.update(extra_env or {})
+    cmd = [sys.executable, os.path.abspath(__file__), "--kr-child"]
+    if checkpoint_dir:
+        cmd += ["--kr-dir", checkpoint_dir]
+    if resume:
+        cmd += ["--kr-resume"]
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=600, env=env,
+    )
+    payload = None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            payload = json.loads(line)
+            break
+    return proc.returncode, payload
+
+
+def kill_resume_main() -> int:
+    """The --kill-resume driver: SIGKILL at every seam, resume, demand
+    identical findings; then the lane-poison quarantine round."""
+    failures = []
+    print("kill-resume: uninterrupted reference pass ...", file=sys.stderr)
+    rc, reference = _kr_spawn()
+    if rc != 0 or reference is None:
+        print(json.dumps({"error": f"reference child exited {rc}"}))
+        return 1
+    print(json.dumps({"reference": reference}), file=sys.stderr)
+
+    for point, skip in KILL_POINTS:
+        with tempfile.TemporaryDirectory(prefix="mtpu-ckpt-") as ckpt:
+            began = time.time()
+            rc, _ = _kr_spawn(
+                checkpoint_dir=ckpt,
+                extra_env={"MYTHRIL_TPU_KILL_AT": f"{point}:{skip}"},
+            )
+            killed = rc == -9
+            if not killed:
+                # the child survived: the point was never hit, so the
+                # round proved nothing — loud failure, not a pass
+                failures.append({"point": point, "skip": skip,
+                                 "error": f"never reached (exit {rc})"})
+                print(json.dumps({"point": point, "skip": skip,
+                                  "killed": False}))
+                continue
+            rc, resumed = _kr_spawn(checkpoint_dir=ckpt, resume=True)
+            parity = (
+                rc == 0 and resumed is not None
+                and resumed["found"] == reference["found"]
+            )
+            row = {
+                "point": point, "skip": skip, "killed": True,
+                "wall_s": round(time.time() - began, 1),
+                "findings_parity": parity,
+                "resumes": resumed.get("resumes") if resumed else None,
+                "checkpoints_written": (
+                    resumed.get("checkpoints_written") if resumed else None
+                ),
+            }
+            print(json.dumps(row))
+            if not parity:
+                failures.append({
+                    "point": point, "skip": skip,
+                    "found": resumed and resumed.get("found"),
+                    "reference": reference["found"], "exit": rc,
+                })
+
+    # poisoned-lane quarantine: a repeatably failing lane must go to
+    # the CDCL tail ALONE — context on device, no context demotion
+    began = time.time()
+    rc, poisoned = _kr_spawn(
+        extra_env={"MYTHRIL_TPU_FAULT": "lane_poison:99:0:2"},
+    )
+    quarantine_ok = (
+        rc == 0 and poisoned is not None
+        and poisoned["found"] == reference["found"]
+        and poisoned["quarantined_lanes"] >= 1
+        and poisoned["demotions"] == reference["demotions"]
+        and not poisoned["fused"]
+    )
+    print(json.dumps({
+        "point": "lane_poison", "wall_s": round(time.time() - began, 1),
+        "quarantine_ok": quarantine_ok,
+        "quarantined_lanes": poisoned and poisoned.get("quarantined_lanes"),
+        "bisect_dispatches": poisoned and poisoned.get("bisect_dispatches"),
+        "demotions": poisoned and poisoned.get("demotions"),
+    }))
+    if not quarantine_ok:
+        failures.append({"point": "lane_poison", "result": poisoned,
+                         "exit": rc})
+
+    if failures:
+        print(json.dumps({"kill_resume_failures": failures}))
+        return 1
+    print(json.dumps({"kill_resume_ok": True,
+                      "rounds": len(KILL_POINTS) + 1}))
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--rounds", type=int, default=6)
     parser.add_argument("--seed", type=int, default=1337)
+    parser.add_argument("--kill-resume", action="store_true",
+                        help="checkpoint/resume chaos: SIGKILL at every "
+                        "injection point, resume, demand identical "
+                        "findings")
+    parser.add_argument("--kr-child", action="store_true",
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--kr-dir", default=None, help=argparse.SUPPRESS)
+    parser.add_argument("--kr-resume", action="store_true",
+                        help=argparse.SUPPRESS)
     args_ns = parser.parse_args()
+    if args_ns.kr_child:
+        return _kr_child(args_ns.kr_dir, args_ns.kr_resume)
+    if args_ns.kill_resume:
+        return kill_resume_main()
     rng = random.Random(args_ns.seed)
 
     import logging

@@ -371,17 +371,33 @@ def test_cancellation_checkpoint_stops_abandoned_workers(monkeypatch):
 
 
 def test_fault_env_spec_parsing(monkeypatch):
-    monkeypatch.setenv("MYTHRIL_TPU_FAULT",
-                       "dispatch_hang:3:1, rpc_error, bogus_point:2")
+    monkeypatch.setenv("MYTHRIL_TPU_FAULT", "dispatch_hang:3:1, rpc_error")
     faults.reset_for_tests()
     plane = faults.get_fault_plane()
     assert plane._armed["dispatch_hang"]["times"] == 3
     assert plane._armed["dispatch_hang"]["skip"] == 1
     assert plane._armed["rpc_error"]["times"] == 1
-    assert "bogus_point" not in plane._armed  # logged + ignored
     # skip consumes hits before the first shot fires
     assert plane.fire("dispatch_hang") is None
     assert plane.fire("dispatch_hang") is not None
+
+
+def test_malformed_fault_spec_fails_loudly(monkeypatch):
+    """A typo'd injection point (or non-integer field) must die at
+    plane construction — a chaos run configured to inject nothing used
+    to pass vacuously."""
+    for bad in ("bogus_point:2", "dispatch_hang:lots", "dispatch_hang:1:x"):
+        monkeypatch.setenv("MYTHRIL_TPU_FAULT", bad)
+        faults.reset_for_tests()
+        with pytest.raises(faults.FaultSpecError):
+            faults.get_fault_plane()
+    monkeypatch.delenv("MYTHRIL_TPU_FAULT")
+    monkeypatch.setenv("MYTHRIL_TPU_KILL_AT", "no_such_point")
+    faults.reset_for_tests()
+    with pytest.raises(faults.FaultSpecError):
+        faults.get_fault_plane()
+    monkeypatch.delenv("MYTHRIL_TPU_KILL_AT")
+    faults.reset_for_tests()
 
 
 def test_shutdown_join_is_bounded(monkeypatch):
