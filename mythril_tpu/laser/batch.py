@@ -9,10 +9,12 @@ in one VM step and returns the feasible subset.  Pipeline:
    dropped without any solver work; states whose constraint sets are
    memoized keep their verdicts;
 2. batched TPU check: remaining lanes are packed and handed to
-   ops.batched_sat (WalkSAT finds models for the SAT-majority in
-   lockstep on device);
+   ops.batched_sat — batched DPLL over the device-resident clause pool,
+   with lanes warm-started from parent models and cones served by the
+   cross-dispatch memo (the incremental dispatch plane; docs/perf.md);
 3. CDCL tail: lanes the batch pass could not decide go to the native
-   incremental solver (authoritative for UNSAT).
+   incremental solver (authoritative for UNSAT); its SAT models feed
+   the recent-models channel that warm-starts the next dispatch.
 """
 
 import logging

@@ -224,7 +224,9 @@ class AsyncDispatcher:
                         ok = False
                         break
                 if ok:
-                    ctx._remember_model(env)
+                    # tag with the device truth row so harvested models
+                    # seed later dispatches' warm starts too
+                    ctx._remember_model(env, truth=assign[lane])
                     async_stats.models += 1
         async_stats.harvested += 1
         async_stats.harvest_s += time.monotonic() - began

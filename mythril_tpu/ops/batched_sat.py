@@ -113,6 +113,13 @@ class DispatchStats:
         from mythril_tpu.ops.coalesce import reset_coalescer
 
         reset_coalescer()
+        # the cone memo is (generation, pool_version)-scoped and would
+        # invalidate itself, but clearing it with the stats keeps a
+        # per-contract row's cone_memo_hits from counting against a
+        # predecessor's still-cached entries
+        from mythril_tpu.ops.incremental import reset_cone_memo
+
+        reset_cone_memo()
 
     def _reset_own(self):
         self.dispatches = 0        # device solve invocations
@@ -181,6 +188,17 @@ class DispatchStats:
         # transaction seeds replaced by dispatcher pre-split states
         # (laser/ethereum/lockstep_dispatch.py)
         self.presplit_states = 0
+        # incremental dispatch plane (ops/incremental.py; this PR):
+        # host->device payload bytes actually shipped (clause pools,
+        # incidence coordinates, cone rows, assumption columns), full
+        # pool (re)uploads vs delta appends into the resident pool,
+        # lanes whose decision phases were seeded from a parent model,
+        # and host-side cone/remap builds skipped via the cone memo
+        self.h2d_bytes = 0
+        self.pool_uploads = 0
+        self.delta_uploads = 0
+        self.warm_start_hits = 0
+        self.cone_memo_hits = 0
 
     def as_dict(self):
         from mythril_tpu.resilience.telemetry import resilience_stats
@@ -224,6 +242,20 @@ class DevicePool:
             size *= 2
         return size
 
+    @staticmethod
+    def _safe_to_donate() -> bool:
+        """True when no async prefetch worker could be holding the
+        stale pool array (ops/async_dispatch.py runs one at a time)."""
+        from mythril_tpu.ops import async_dispatch
+
+        dispatcher = async_dispatch._dispatcher
+        if dispatcher is None:
+            return True
+        thread = dispatcher._live_thread
+        return dispatcher.pending is None and (
+            thread is None or not thread.is_alive()
+        )
+
     def refresh(self, ctx, num_vars: int):
         """Full rebuild from the native pool's CSR store (one bulk
         padded-row fetch — no Python tuple traffic)."""
@@ -238,7 +270,22 @@ class DevicePool:
             mat[: len(rows)] = rows
         self.lits_np = mat  # host mirror
         # (the mesh path shards from here without a device round-trip)
+        stale = self.lits
         self.lits = jnp.asarray(self.lits_np)
+        if stale is not None and self._safe_to_donate():
+            # donate the stale device buffer eagerly: a refresh doubles
+            # the pool bucket, and holding both generations until GC
+            # runs is exactly the HBM spike that evicts sibling arrays.
+            # Skipped while an async prefetch is in flight — its worker
+            # may have captured this very array, and deleting it under
+            # the kernel would fail the (opportunistic) batch for no
+            # HBM win worth having.
+            try:
+                stale.delete()
+            except Exception:  # noqa: BLE001 — donation is best-effort
+                pass
+        dispatch_stats.pool_uploads += 1
+        dispatch_stats.h2d_bytes += int(mat.nbytes)
         self.num_vars = self._bucket(num_vars)
         self.num_clauses = target_c
         self.dropped = dropped
@@ -274,6 +321,9 @@ class DevicePool:
             self.filled += len(rows)
             occurring = np.abs(rows).ravel()
             self.used[occurring[occurring <= self.num_vars]] = True
+            # the dispatch ships only the appended rows, not the pool
+            dispatch_stats.delta_uploads += 1
+            dispatch_stats.h2d_bytes += int(rows.nbytes)
         self.consumed = total
         return True
 
@@ -288,8 +338,14 @@ def build_round_lane(
     of the gather tier).
 
     ``round_lane(lits[C,K], assign, lvl, dvar, dphase, dflip, depth,
-    status, step) -> same tuple`` advances the lane's search by at most
-    ``budget`` sweeps from the given state.  Status is RAW: 0 live,
+    status, step, pref) -> same tuple`` advances the lane's search by
+    at most ``budget`` sweeps from the given state.  ``pref[V1]`` int8
+    is the warm-start decision-phase preference (0 = no preference,
+    DLIS majority polarity as before): it rides the lane state so
+    bucket re-packs carry it, is never written, and only biases which
+    phase a decision tries FIRST — backtracking still explores the
+    flip, so UNSAT/SAT semantics are untouched (ops/incremental.py).
+    Status is RAW: 0 live,
     1 complete assignment for the device clause subset (host verifies),
     2 sound UNSAT, 3 decision-stack bail (the ladder retires such lanes
     as undecided and never re-enters them).  ``step`` must be zeroed by
@@ -341,7 +397,7 @@ def build_round_lane(
         return forced_pos, forced_neg, conflict, spos, sneg
 
     def round_lane(lits, assign, lvl0, dvar0, dphase0, dflip0, depth0,
-                   status0, step0):
+                   status0, step0, pref0):
         idx = jnp.arange(V1)
         didx = jnp.arange(D)  # slot l holds decision level l+1
 
@@ -398,7 +454,13 @@ def build_round_lane(
             bail = want & (~can)
             score = jnp.where(free, spos + sneg + 1, -1)
             var = jnp.argmax(score)
-            phase = jnp.where(spos[var] >= sneg[var], 1, -1).astype(
+            dlis = jnp.where(spos[var] >= sneg[var], 1, -1).astype(
+                jnp.int8
+            )
+            # warm start: a parent model's phase wins over DLIS where
+            # one exists (search-order bias only; the flip is still
+            # explored on backtrack)
+            phase = jnp.where(pref0[var] != 0, pref0[var], dlis).astype(
                 jnp.int8
             )
             ndepth = depth + 1
@@ -433,7 +495,8 @@ def build_round_lane(
 
         init = (assign, lvl0, dvar0, dphase0, dflip0, depth0, status0,
                 step0)
-        return jax.lax.while_loop(cond, body, init)
+        out = jax.lax.while_loop(cond, body, init)
+        return out + (pref0,)  # pref rides the state tuple, unchanged
 
     return round_lane
 
@@ -489,6 +552,7 @@ def build_solve_lane(
             jnp.int32(0),
             jnp.int32(0),
             jnp.int32(0),
+            jnp.zeros(V1, dtype=jnp.int8),  # no warm-start preference
         )
         assign, status = out[0], out[6]
         status = jnp.where(status == 3, 0, status)  # bailed = undecided
@@ -513,9 +577,48 @@ def make_round_step(num_vars: int, budget: int):
 
     batched = jax.vmap(
         build_round_lane(num_vars, budget),
-        in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0),
+        in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0, 0),
     )
     return jax.jit(batched)
+
+
+def warm_pref_row(ctx, width: int, cone_vars=None, offset: int = 1,
+                  lanes: int = 0, dtype=np.int8):
+    """Warm-start decision-phase row for one dispatch, or None.
+
+    Pulls the newest tagged SAT model from the blast context's
+    recent-models channel (BlastContext.warm_phase_vector — phase
+    saving across the fork tree: sibling/ancestor states share long
+    constraint prefixes, so the parent's satisfying phases are the
+    best first guess for the child's search).  ``cone_vars`` remaps
+    the var-indexed phases onto compact cone columns
+    (``cone_vars[i] -> column i + offset``: the gather cone tier packs
+    at offset 1, the Pallas union layout at offset 2); None means the
+    identity layout of the full-pool tier.  Counts ``lanes`` into
+    ``warm_start_hits`` when a usable row exists.  Honors the
+    ``MYTHRIL_TPU_WARM_START`` kill switch."""
+    from mythril_tpu.ops.incremental import warm_start_enabled
+
+    if not warm_start_enabled():
+        return None
+    warm = ctx.warm_phase_vector(ctx.solver.num_vars)
+    if warm is None:
+        return None
+    row = np.zeros(width, dtype)
+    if cone_vars is None:
+        n = min(width, len(warm))
+        row[:n] = warm[:n]
+    else:
+        cv = np.asarray(cone_vars, np.int64)
+        vals = np.zeros(len(cv), np.int8)
+        valid = cv < len(warm)
+        vals[valid] = warm[cv[valid]]
+        limit = max(0, min(len(cv), width - offset))
+        row[offset:offset + limit] = vals[:limit]
+    if not np.any(row):
+        return None
+    dispatch_stats.warm_start_hits += lanes
+    return row
 
 
 def lane_bucket(n: int, floor: int = 4) -> int:
@@ -633,6 +736,9 @@ class BatchedSatBackend:
             )
 
             pool_lits_np = self.pool.lits_np
+            # the sharded layout re-broadcasts the pool mirror per
+            # dispatch; the resident-pool savings are single-chip only
+            dispatch_stats.h2d_bytes += int(pool_lits_np.nbytes)
 
             def _solve_mesh():
                 faults.maybe_fault_dispatch()
@@ -665,7 +771,9 @@ class BatchedSatBackend:
             # injection happen per round inside the ladder)
             try:
                 status, final_assign = self._solve_gather_ladder(
-                    "gather", self.pool.lits, assign
+                    "gather", self.pool.lits, assign,
+                    pref=warm_pref_row(ctx, assign.shape[1],
+                                       lanes=batch),
                 )
             except DispatchAbandoned as exc:
                 return self._abandon(ctx, exc, batch)
@@ -733,9 +841,14 @@ class BatchedSatBackend:
                         del self._step_cache[stale]
         return step
 
-    def _solve_gather_ladder(self, key_base: str, lits, assign):
+    def _solve_gather_ladder(self, key_base: str, lits, assign,
+                             pref=None):
         """Round-laddered lockstep solve over assumption-seeded
         assignment vectors ``assign [batch, V1]`` (int8).
+
+        ``pref`` (optional ``[V1]`` int8 row) is the warm-start
+        decision-phase preference broadcast to every lane — see
+        build_round_lane; it rides the lane state so re-packs carry it.
 
         Replaces the monolithic while_loop dispatch: budgeted rounds
         (GATHER_ROUND_BUDGETS), decided lanes retired between rounds,
@@ -781,10 +894,16 @@ class BatchedSatBackend:
             "depth": np.zeros(B, np.int32),
             "status": np.zeros(B, np.int32),
             "step": np.zeros(B, np.int32),
+            "pref": np.zeros((B, V1), np.int8),
         }
         order = ("assign", "lvl", "dvar", "dphase", "dflip", "depth",
-                 "status", "step")
+                 "status", "step", "pref")
         state["assign"][:batch] = assign
+        if pref is not None:
+            row = np.zeros(V1, np.int8)
+            n = min(V1, len(pref))
+            row[:n] = np.asarray(pref[:n], np.int8)
+            state["pref"][:] = row
         state["status"][batch:] = 3  # bucket pads: retired from step 0
 
         statuses_out = np.zeros(batch, np.int32)
@@ -945,8 +1064,14 @@ class BatchedSatBackend:
     def _build_cone_batch(self, ctx, assumption_sets):
         """Device inputs for the union-cone tier: (rows [N,K] int32
         with literals remapped to compact var ids, assign [B,n+1]
-        int8, cone_vars [n] int64 original ids) — or None when the
-        union cone exceeds the tier caps (or is empty).
+        int8, cone_vars [n] int64 original ids, roots key) — or None
+        when the union cone exceeds the tier caps (or is empty).
+
+        The cone walk + dedupe/remap (``_build_cone_rows``) is served
+        by the cross-dispatch cone memo keyed on the union roots:
+        sibling batches and repeat dispatches over an unchanged pool
+        skip the host-side CSR work entirely; only the per-dispatch
+        assumption columns are rebuilt here.
 
         Soundness matches the per-lane cone contract documented on
         BlastContext.cone: every shipped clause holds globally, so a
@@ -954,11 +1079,39 @@ class BatchedSatBackend:
         candidate and is verified against the original terms by the
         caller.  Clauses wider than MAX_CLAUSE_WIDTH are dropped
         (weakens BCP, never soundness)."""
-        roots = sorted({lit for lane in assumption_sets for lit in lane})
+        roots = tuple(
+            sorted({lit for lane in assumption_sets for lit in lane})
+        )
         if not roots:
             return None
+        from mythril_tpu.ops.incremental import get_cone_memo
+
+        built = get_cone_memo().get_or_build(
+            ctx, ("cone_rows", roots),
+            lambda: self._build_cone_rows(ctx, roots),
+        )
+        if built is None:
+            return None
+        rows, cone_vars, anchor = built
+        n = int(cone_vars.size)
+        assign = np.zeros((len(assumption_sets), n + 1), np.int8)
+        assign[:, anchor] = 1
+        for lane, assumptions in enumerate(assumption_sets):
+            for lit in assumptions:
+                var = abs(lit)
+                pos = int(np.searchsorted(cone_vars, var))
+                if pos < n and cone_vars[pos] == var:
+                    assign[lane, pos + 1] = 1 if lit > 0 else -1
+        dispatch_stats.h2d_bytes += int(assign.nbytes)
+        return rows, assign, cone_vars, roots
+
+    def _build_cone_rows(self, ctx, roots):
+        """The memoized half of :meth:`_build_cone_batch`: cone walk,
+        CSR fetch, width filter, compact remap, anchor row.  Returns
+        (rows, cone_vars, anchor_column) or None when the cone exceeds
+        the tier caps."""
         try:
-            clause_ids, cone_vars = ctx.pool.cone(roots)
+            clause_ids, cone_vars = ctx.pool.cone(list(roots))
         except Exception:  # noqa: BLE001 — optimization tier only
             return None
         if (
@@ -1002,15 +1155,7 @@ class BatchedSatBackend:
         # FALSE literal conflicts in BCP instead of "completing"
         anchor = int(np.searchsorted(cone_vars, 1)) + 1
         rows[len(kept_widths), 0] = anchor
-        assign = np.zeros((len(assumption_sets), n + 1), np.int8)
-        assign[:, anchor] = 1
-        for lane, assumptions in enumerate(assumption_sets):
-            for lit in assumptions:
-                var = abs(lit)
-                pos = int(np.searchsorted(cone_vars, var))
-                if pos < n and cone_vars[pos] == var:
-                    assign[lane, pos + 1] = 1 if lit > 0 else -1
-        return rows, assign, cone_vars
+        return rows, cone_vars, anchor
 
     def check_cone_gather(self, ctx, assumption_sets):
         """Dispatch the batch against its union cone only.  Multi-
@@ -1028,7 +1173,7 @@ class BatchedSatBackend:
         built = self._build_cone_batch(ctx, assumption_sets)
         if built is None:
             return None
-        rows, assign, cone_vars = built
+        rows, assign, cone_vars, roots = built
         jax, jnp = _require_jax()
         n = int(cone_vars.size)
         self.device_engaged = True
@@ -1036,6 +1181,10 @@ class BatchedSatBackend:
             from mythril_tpu.parallel.mesh import (
                 get_mesh, sharded_frontier_solve,
             )
+
+            # the sharded path re-ships the cone rows per dispatch
+            # (shard layout, not a resident buffer)
+            dispatch_stats.h2d_bytes += int(rows.nbytes)
 
             def _solve_mesh_cone():
                 faults.maybe_fault_dispatch()
@@ -1065,10 +1214,26 @@ class BatchedSatBackend:
                               bucket + 1 - assign.shape[1]), np.int8)],
                     axis=1,
                 )
+            # the cone rows stay resident across sibling dispatches:
+            # the memo hands back the SAME device buffer while the
+            # (generation, pool_version, roots) scope holds, so a
+            # repeat dispatch uploads only the assumption columns
+            from mythril_tpu.ops.incremental import get_cone_memo
 
+            def _upload_rows():
+                dispatch_stats.h2d_bytes += int(rows.nbytes)
+                return jnp.asarray(rows)
+
+            rows_dev = get_cone_memo().get_or_build(
+                ctx, ("cone_dev", roots), _upload_rows
+            )
             try:
                 status, final_assign = self._solve_gather_ladder(
-                    "cone", jnp.asarray(rows), assign
+                    "cone", rows_dev, assign,
+                    pref=warm_pref_row(
+                        ctx, assign.shape[1], cone_vars=cone_vars,
+                        offset=1, lanes=len(assumption_sets),
+                    ),
                 )
             except DispatchAbandoned as exc:
                 return self._abandon(ctx, exc, len(assumption_sets))
@@ -1126,12 +1291,19 @@ class BatchedSatBackend:
         """Shared prep for the sync and async gather paths: reflect the
         pool delta on device and build the assumption-seeded assignment
         matrix."""
+        from mythril_tpu.ops.incremental import resident_pool_enabled
+
         _require_jax()
-        if self.pool_generation != ctx.generation:
+        if self.pool_generation != ctx.generation or (
+            not resident_pool_enabled()
+        ):
             # a new BlastContext (reset between analyses): the resident
             # pool describes a different formula — appending would graft
             # the new clauses onto it at stale offsets and make device
-            # UNSAT verdicts unsound, so always rebuild from scratch
+            # UNSAT verdicts unsound, so always rebuild from scratch.
+            # The MYTHRIL_TPU_RESIDENT_POOL=0 kill switch takes the same
+            # path every dispatch: full rebuild + full upload (the
+            # pre-incremental behavior, for A/B attribution runs).
             self.pool.refresh(ctx, num_vars)
             self.pool.version = ctx.pool_version
             self.pool_generation = ctx.generation
@@ -1139,7 +1311,8 @@ class BatchedSatBackend:
             self.pool.num_vars < num_vars
         ):
             # delta append into the existing buckets when possible; full
-            # rebuild + upload only when a bucket grows
+            # rebuild + upload only when a bucket grows (repack) or the
+            # resident mirror was invalidated
             if not self.pool.append(ctx, num_vars):
                 self.pool.refresh(ctx, num_vars)
             self.pool.version = ctx.pool_version
@@ -1158,6 +1331,9 @@ class BatchedSatBackend:
                 var = abs(lit)
                 if var < V1:
                     assign[lane, var] = 1 if lit > 0 else -1
+        # with the pool resident, the assumption matrix IS the
+        # per-dispatch payload (plus lane descriptors); count it
+        dispatch_stats.h2d_bytes += int(assign.nbytes)
         return assign
 
     def prepare_gather(self, ctx, assumption_sets):
@@ -1180,7 +1356,7 @@ class BatchedSatBackend:
             built = self._build_cone_batch(ctx, assumption_sets)
             if built is None:
                 return None
-            rows, assign, cone_vars = built
+            rows, assign, cone_vars, _roots = built
             _, jnp = _require_jax()
             n = int(cone_vars.size)
             bucket = DevicePool._bucket(n)
@@ -1195,6 +1371,9 @@ class BatchedSatBackend:
 
             def run_cone():
                 step = self._cached_step(bucket)
+                # worker-thread upload (never through the shared memo:
+                # the host could be mutating it concurrently)
+                dispatch_stats.h2d_bytes += int(rows.nbytes)
                 assign_dev, status_dev = step(
                     jnp.asarray(rows), jnp.asarray(assign)
                 )
@@ -1233,6 +1412,22 @@ def get_backend() -> BatchedSatBackend:
     if _backend is None:
         _backend = BatchedSatBackend()
     return _backend
+
+
+def reset_resident_pools() -> None:
+    """Invalidate every process-global device-resident structure: the
+    gather tier's resident clause pool and the cross-dispatch cone
+    memo.  Called by the checkpoint plane on resume — the resumed
+    process re-interns nodes and re-blasts literals, so clause indices
+    and literal numbering never match what an earlier pool upload (or
+    memoized cone layout) described; serving them would be silently
+    unsound, not just stale."""
+    from mythril_tpu.ops.incremental import reset_cone_memo
+
+    if _backend is not None:
+        _backend.pool = DevicePool()
+        _backend.pool_generation = -1
+    reset_cone_memo()
 
 
 def batch_check_states(constraint_sets) -> List[Optional[bool]]:
@@ -1526,8 +1721,12 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         if first_for_lane:
             if ok:
                 # a verified device model serves future host probes the
-                # same way a CDCL model would
-                ctx._remember_model(env)
+                # same way a CDCL model would; the literal-level truth
+                # row tags it for warm starts (phase saving across the
+                # fork tree — ops/incremental.py)
+                ctx._remember_model(
+                    env, truth=backend.last_assignments[lane]
+                )
                 dispatch_stats.sat_verified += 1
                 device_decided += 1
             else:
@@ -1560,7 +1759,9 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
                     ok = False
                     break
             if ok:
-                ctx._remember_model(env)
+                ctx._remember_model(
+                    env, truth=backend.last_assignments[n_rep + pos]
+                )
                 dispatch_stats.sat_verified += 1
                 device_decided += 1
     if engaged:

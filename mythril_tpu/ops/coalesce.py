@@ -27,6 +27,14 @@ The queue also feeds the async prefetch path: when the profit gate
 declines a frontier and launches it as an idle-time prefetch, queued
 lanes ride along to fill that bucket too.
 
+Interplay with the incremental dispatch plane (ops/incremental.py):
+deferred lanes are answered by the CDCL tail first, and the tail's SAT
+models land tagged in the recent-models channel — so by the time the
+merged dispatch ships, its lanes warm-start from exactly the sibling
+models the deferral produced.  Deferral windows also tend to batch
+pool growth: the merged dispatch sees one pool version instead of
+several, which is what keeps its cones memo-servable.
+
 Env knobs: ``MYTHRIL_TPU_COALESCE`` (0 disables, overrides
 ``args.device_coalesce``), ``MYTHRIL_TPU_COALESCE_WINDOW``,
 ``MYTHRIL_TPU_COALESCE_FILL``.

@@ -1,0 +1,140 @@
+"""Incremental device dispatch: policy knobs + the cross-dispatch
+cone memo.
+
+Forked LASER states share long path-constraint prefixes, yet every
+device dispatch used to re-extract, dedupe, remap, and re-upload full
+cones, and cold-start every lane's search (BENCH_r05: 9,698 full
+sweeps for 158 lanes, microbench_speedup 0.09 — host prep and transfer
+charged to every batch).  Incremental SMT solvers win precisely by
+reusing work across near-identical queries, and hardware BCP
+accelerators keep the clause database resident and ship only deltas;
+this module is the shared policy layer of the same design here:
+
+- **Resident clause pool** (``MYTHRIL_TPU_RESIDENT_POOL``, default on):
+  ops/batched_sat.DevicePool keeps the deduped clause matrix on device
+  keyed by the blast context's ``pool_version`` and ships only appended
+  rows between dispatches; the kill switch forces a full rebuild +
+  re-upload per dispatch (the pre-incremental behavior, for A/B runs).
+
+- **Parent-model warm starts** (``MYTHRIL_TPU_WARM_START``, default
+  on): lanes seed their DPLL *decision phases* from the most recent
+  SAT model in the blast context's recent-models channel
+  (BlastContext.warm_phase_vector).  Phase preference only biases
+  search order — UNSAT still requires an exhausted search or a
+  zero-decision conflict, and SAT candidates are host-verified — so
+  verdict semantics are untouched by construction.
+
+- **Cone memo** (:class:`ConeMemo`): cone extraction + remap results
+  cached by ``(generation, pool_version, key)``.  The whole table is
+  dropped the moment either component moves (a repacked or regrown
+  pool describes different clause indices), so a hit is always exact —
+  sibling batches over an unchanged pool skip the host-side CSR walk,
+  the dedupe/remap pass, and (for cached device buffers) the upload.
+
+Everything here is host-side policy: no jax import at module load.
+"""
+
+import logging
+import os
+from typing import Callable, Dict, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+#: cone-memo entry cap: entries hold coordinate arrays (and sometimes a
+#: device buffer for the cone-tier rows), so the table stays small; the
+#: least-recently-used quarter is evicted when full (hits refresh
+#: recency, matching the probe-memo idiom in smt/bitblast.py)
+CONE_MEMO_CAP = 128
+
+
+def _env_on(name: str) -> bool:
+    return os.environ.get(name, "1").lower() not in ("0", "off", "false")
+
+
+def resident_pool_enabled() -> bool:
+    """``MYTHRIL_TPU_RESIDENT_POOL=0`` forces a full clause-pool
+    rebuild + upload on every dispatch (kill switch / A-B ablation);
+    default keeps the pool device-resident with delta appends."""
+    return _env_on("MYTHRIL_TPU_RESIDENT_POOL")
+
+
+def warm_start_enabled() -> bool:
+    """``MYTHRIL_TPU_WARM_START=0`` disables parent-model phase
+    seeding (lanes cold-start their decision phases from DLIS alone)."""
+    return _env_on("MYTHRIL_TPU_WARM_START")
+
+
+class ConeMemo:
+    """Cross-dispatch memo for cone extraction / remap / device-row
+    builds, scoped to one ``(blast generation, pool_version)``.
+
+    The scope key makes correctness trivial: any pool growth (delta or
+    repack) or context reset drops the whole table, so a surviving
+    entry describes exactly the pool the next dispatch will solve
+    against.  Staleness-tolerant caching (cones are clause *subsets*,
+    sound for UNSAT even stale) was considered and rejected — the memo
+    also serves remapped coordinate layouts and device buffers, where
+    a stale clause-index base would be silently wrong, not just weak.
+    """
+
+    def __init__(self):
+        self._scope: Tuple[int, int] = (-1, -1)
+        self._table: Dict[tuple, object] = {}
+
+    def _sync(self, ctx) -> None:
+        scope = (ctx.generation, ctx.pool_version)
+        if scope != self._scope:
+            self._scope = scope
+            self._table.clear()
+
+    def get_or_build(self, ctx, key: tuple, build: Callable[[], object]):
+        """Return the cached value for ``key`` under the context's
+        current (generation, pool_version) scope, building (and
+        caching) it on a miss.  ``None`` results are cached too — a
+        declined cone tier declines identically until the pool moves,
+        and re-walking the cone to re-decline is exactly the host work
+        this memo exists to skip."""
+        self._sync(ctx)
+        if key in self._table:
+            value = self._table.pop(key)
+            self._table[key] = value  # hit refreshes recency
+            from mythril_tpu.ops.batched_sat import dispatch_stats
+
+            dispatch_stats.cone_memo_hits += 1
+            return value
+        value = build()
+        if len(self._table) >= CONE_MEMO_CAP:
+            for stale in list(self._table)[: CONE_MEMO_CAP // 4]:
+                del self._table[stale]
+        self._table[key] = value
+        return value
+
+    def cone(self, ctx, root_lits) -> tuple:
+        """Memoized ``ctx.pool.cone(root_lits)`` — the per-lane entry
+        point (sibling lanes across batches repeat root sets)."""
+        key = ("cone", tuple(sorted(root_lits)))
+        return self.get_or_build(
+            ctx, key, lambda: ctx.pool.cone(list(root_lits))
+        )
+
+    def reset(self) -> None:
+        self._scope = (-1, -1)
+        self._table.clear()
+
+    def __len__(self) -> int:
+        return len(self._table)
+
+
+_cone_memo: Optional[ConeMemo] = None
+
+
+def get_cone_memo() -> ConeMemo:
+    global _cone_memo
+    if _cone_memo is None:
+        _cone_memo = ConeMemo()
+    return _cone_memo
+
+
+def reset_cone_memo() -> None:
+    if _cone_memo is not None:
+        _cone_memo.reset()

@@ -311,6 +311,13 @@ class DenseClausePool:
             _bucket(max(1, len(pos_r)), floor=256),
             _bucket(max(1, len(neg_r)), floor=256),
         )
+        # the dispatch ships only literal coordinates; the [C, V]
+        # planes are scatter-built on device (counted h2d = coords)
+        from mythril_tpu.ops.batched_sat import dispatch_stats
+
+        dispatch_stats.h2d_bytes += (
+            4 * 2 * (build.n_pos + build.n_neg) + int(width.nbytes)
+        )
         # committed inputs pin the jitted build (and everything
         # downstream that consumes its outputs) to the corpus shard's
         # device — contract-level data parallelism over chips
@@ -536,19 +543,29 @@ def _make_dpll_sweep(
 
 
 #: field order of the resumable solver state (see _dpll_round_loop);
-#: drivers index status/active out of round outputs by these positions
+#: drivers index status/active out of round outputs by these positions.
+#: ``pref`` is the warm-start decision-phase plane ([B, V] f32, 0 = no
+#: preference): it rides the state so lane compaction carries it, is
+#: never written by the kernel, and only biases which polarity a
+#: decision tries first (ops/incremental.py — verdicts untouched).
 DPLL_STATE_FIELDS = (
     "A", "lvl", "dvar", "dphase", "dflip", "dbulk", "depth", "status",
-    "taint", "active",
+    "taint", "active", "pref",
 )
 _STATUS_IDX = DPLL_STATE_FIELDS.index("status")
 _ACTIVE_IDX = DPLL_STATE_FIELDS.index("active")
 
 
-def _dpll_state0(A0: np.ndarray, D: int, n_real: int) -> list:
+def _dpll_state0(A0: np.ndarray, D: int, n_real: int,
+                 pref_row=None) -> list:
     """Host-side zero state for a round ladder over ``A0 [B, V]``;
-    rows past ``n_real`` are bucket padding, retired from step 0."""
+    rows past ``n_real`` are bucket padding, retired from step 0.
+    ``pref_row`` ([V] or broadcastable) seeds the warm-start phase
+    plane for every lane."""
     B, V = A0.shape
+    pref = np.zeros((B, V), np.float32)
+    if pref_row is not None:
+        pref[:] = np.asarray(pref_row, np.float32)
     state = [
         A0.astype(np.float32, copy=True),
         np.zeros((B, V), np.int32),
@@ -560,6 +577,7 @@ def _dpll_state0(A0: np.ndarray, D: int, n_real: int) -> list:
         np.zeros((B, 1), np.int32),
         np.zeros((B, 1), np.float32),
         np.zeros((B, 1), np.int32),
+        pref,
     ]
     state[_STATUS_IDX][n_real:] = 3
     return state
@@ -599,14 +617,14 @@ def _dpll_round_loop(sweep, B, V, budget, max_decisions, sweep_hot=None,
     tiered = sweep_hot is not None and tier_period > 1
 
     def rounds(P, N, width, A0, lvl0, dvar0, dphase0, dflip0, dbulk0,
-               depth0, status0, taint0, active0):
+               depth0, status0, taint0, active0, pref0):
         col = lax.broadcasted_iota(jnp.int32, (B, V), 1)
         dcol = lax.broadcasted_iota(jnp.int32, (B, D), 1)  # slot l ↔ level l+1
         krow = jnp.arange(DPLL_BULK_K)[None, :]            # [1, K]
 
         def body(carry):
             (A, lvl, dvar, dphase, dflip, dbulk, depth, status, taint,
-             sweeps, step) = carry
+             sweeps, pref, step) = carry
             if tiered:
                 full_view = (step % tier_period) == 0
                 outs = lax.cond(
@@ -712,7 +730,13 @@ def _dpll_round_loop(sweep, B, V, budget, max_decisions, sweep_hot=None,
                     & keep[:, None, :],
                     axis=2,
                 )                                           # [B,V]
-                ph_full = jnp.where(spos >= sneg, 1.0, -1.0)
+                # warm start: a parent model's phase wins over the DLIS
+                # majority where one exists (search-order bias only —
+                # the flip is still explored on backtrack)
+                ph_full = jnp.where(
+                    pref != 0.0, pref,
+                    jnp.where(spos >= sneg, 1.0, -1.0),
+                )
                 primary = idxs[:, :1]
                 phase = jnp.take_along_axis(ph_full, primary, axis=1)
                 # a level is "bulk" (taints on backtrack) only when it
@@ -767,7 +791,7 @@ def _dpll_round_loop(sweep, B, V, budget, max_decisions, sweep_hot=None,
             status1 = jnp.where(bail, 3, status1)  # 3 = budget-bailed
             sweeps1 = sweeps + active.astype(jnp.int32)
             return (A3, lvl3, dvar2, dphase2, dflip2, dbulk2, depth2,
-                    status1, taint1, sweeps1, step + 1)
+                    status1, taint1, sweeps1, pref, step + 1)
 
         def cond(carry):
             status, step = carry[_STATUS_IDX], carry[-1]
@@ -775,7 +799,7 @@ def _dpll_round_loop(sweep, B, V, budget, max_decisions, sweep_hot=None,
 
         init = (
             A0, lvl0, dvar0, dphase0, dflip0, dbulk0, depth0, status0,
-            taint0, active0, jnp.int32(0),
+            taint0, active0, pref0, jnp.int32(0),
         )
         out = lax.while_loop(cond, body, init)
         return out[:-1] + (out[-1],)
@@ -805,6 +829,7 @@ def _dpll_solve_loop(sweep, B, V, steps, max_decisions):
             z((B, 1), dtype=jnp.int32),
             z((B, 1), dtype=jnp.float32),
             z((B, 1), dtype=jnp.int32),
+            z((B, V), dtype=jnp.float32),  # no warm-start preference
         )
         A, status, steps_used = out[0], out[_STATUS_IDX], out[-1]
         status = jnp.where(status == 3, 0, status)  # bailed = undecided
@@ -1006,6 +1031,7 @@ def _run_dense_ladder(
     lane_floor: int = 8,
     compact_planes=None,
     grow_hot=None,
+    pref_row=None,
 ):
     """Host driver for the round ladder over a dense solve.
 
@@ -1037,7 +1063,10 @@ def _run_dense_ladder(
 
     B, V = A0.shape
     D = max(1, min(max_decisions, V))
-    state = _dpll_state0(A0, D, n_real)
+    state = _dpll_state0(A0, D, n_real, pref_row)
+    # per-dispatch lane payload: assumption-seeded assignment plane
+    # (the incidence planes are accounted at their build sites)
+    dispatch_stats.h2d_bytes += int(A0.nbytes)
     statuses_out = np.zeros(n_real, np.int32)
     A_out = np.zeros((n_real, V), np.float32)
     live = np.arange(n_real)
@@ -1157,8 +1186,14 @@ class PallasSatBackend:
             return [], np.zeros((0, ctx.solver.num_vars + 1), np.int8)
         # host-side cone extraction FIRST: the layout/fits verdict needs
         # no device, and initializing the backend (a cold TPU tunnel
-        # client costs ~7 s) would be pure waste for impossible cones
-        lane_cones = [ctx.cone(lits) for lits in assumption_sets]
+        # client costs ~7 s) would be pure waste for impossible cones.
+        # Per-lane cones go through the cross-dispatch cone memo:
+        # sibling batches repeat assumption sets, so an unchanged pool
+        # serves them without re-walking the CSR store.
+        from mythril_tpu.ops.incremental import get_cone_memo
+
+        memo = get_cone_memo()
+        lane_cones = [memo.cone(ctx, lits) for lits in assumption_sets]
         batch = len(assumption_sets)
         union_ci = np.unique(np.concatenate(
             [ci for ci, _ in lane_cones]
@@ -1257,7 +1292,21 @@ class PallasSatBackend:
         assignments = np.zeros((batch, orig_v1), dtype=np.int8)
         assignments[:, 1] = 1
 
-        urow, ulit, width_arr = remap_cone_csr(ctx, clause_idx, cone_vars)
+        # union remap through the cone memo: the dedupe/remap pass over
+        # a ~10k-clause union cone is pure host CPU, and sibling
+        # frontier batches present the same union while the pool holds
+        # still.  Hit-or-miss, the returned arrays are never mutated —
+        # the hot-tier growth below permutes COPIES into its layout.
+        import zlib
+
+        from mythril_tpu.ops.incremental import get_cone_memo
+
+        digest = (int(clause_idx.size),
+                  zlib.crc32(clause_idx.tobytes()))
+        urow, ulit, width_arr = get_cone_memo().get_or_build(
+            ctx, ("union_remap", digest),
+            lambda: remap_cone_csr(ctx, clause_idx, cone_vars),
+        )
         n_rows = len(clause_idx)
         seed_lists = [
             np.abs(assumption_columns(cone_vars, lits))
@@ -1298,6 +1347,16 @@ class PallasSatBackend:
             DPLL_MAX_VARS_INTERPRET if interpret else DPLL_MAX_VARS
         )
         decisions = MAX_DECISIONS if (search and V <= search_ceiling) else 0
+        # warm start: phases of the newest tagged SAT model, remapped
+        # onto the union-cone columns (cone_vars[i] -> column i + 2).
+        # Decision bias only, so BCP-only dispatches skip the work.
+        from mythril_tpu.ops.batched_sat import warm_pref_row
+
+        pref_row = (
+            warm_pref_row(ctx, V, cone_vars=cone_vars, offset=2,
+                          lanes=batch, dtype=np.float32)
+            if decisions else None
+        )
 
         def round_fn(Bc, round_budget, hot_rows):
             return make_dense_rounds(
@@ -1363,6 +1422,7 @@ class PallasSatBackend:
                 n, decisions, steps, interpret,
                 hot_c=hot_c, lane_floor=8,
                 grow_hot=grow_hot if tier_on else None,
+                pref_row=pref_row,
             )
             # trail growth may have reordered rows for the next chunk;
             # refresh the chunk-level views
@@ -1415,6 +1475,10 @@ class PallasSatBackend:
         decisions = (
             MAX_DECISIONS if (search and max_V <= search_ceiling) else 0
         )
+        from mythril_tpu.ops.batched_sat import warm_pref_row
+        from mythril_tpu.ops.incremental import get_cone_memo
+
+        memo = get_cone_memo()
 
         for start in range(0, batch, chunk_lanes):
             chunk = assumption_sets[start : start + chunk_lanes]
@@ -1425,6 +1489,8 @@ class PallasSatBackend:
             A0[:, 1] = 1.0
             A0[len(chunk):, :] = 1.0  # pad lanes fully assigned
             width = np.zeros((B, max_C), dtype=np.float32)
+            pref_plane = np.zeros((B, max_V), dtype=np.float32)
+            pref_seeded = False
             pos_l, pos_r, pos_c = [], [], []
             neg_l, neg_r, neg_c = [], [], []
             inverses = []
@@ -1436,7 +1502,20 @@ class PallasSatBackend:
                 inverse[2:] = cv
                 inverses.append(inverse)
                 A0[lane, len(cv) + 2:] = 1.0  # per-lane padding cols
-                urow, ulit, width_arr = remap_cone_csr(ctx, ci, cv)
+                if decisions:
+                    row = warm_pref_row(
+                        ctx, max_V, cone_vars=cv, offset=2, lanes=1,
+                        dtype=np.float32,
+                    )
+                    if row is not None:
+                        pref_plane[lane] = row
+                        pref_seeded = True
+                # per-lane remap through the cone memo (sibling batches
+                # repeat assumption sets against an unchanged pool)
+                urow, ulit, width_arr = memo.get_or_build(
+                    ctx, ("lane_remap", tuple(sorted(lits))),
+                    lambda ci=ci, cv=cv: remap_cone_csr(ctx, ci, cv),
+                )
                 width[lane, : len(ci)] = width_arr
                 pos = ulit > 0
                 pos_l.append(np.full(int(pos.sum()), lane, dtype=np.int64))
@@ -1457,6 +1536,12 @@ class PallasSatBackend:
                 B, max_C, max_V,
                 _bucket(max(1, len(pos_l)), floor=256),
                 _bucket(max(1, len(neg_l)), floor=256),
+            )
+            # h2d: (lane, row, col) coordinate triples + the width plane
+            from mythril_tpu.ops.batched_sat import dispatch_stats as _ds
+
+            _ds.h2d_bytes += (
+                4 * 3 * (build.n_pos + build.n_neg) + int(width.nbytes)
             )
             P, N, W = build(
                 place(_pad_coords(pos_l, build.n_pos)),
@@ -1482,6 +1567,7 @@ class PallasSatBackend:
             st_out, A_host = _run_dense_ladder(
                 round_fn, (P, N, W), A0, n, decisions, steps, interpret,
                 lane_floor=lane_floor, compact_planes=compact_planes,
+                pref_row=pref_plane if pref_seeded else None,
             )
             dispatch_stats.lane_slots_filled += n
             dispatch_stats.lane_slots_total += B

@@ -581,6 +581,13 @@ class CheckpointPlane:
             if name in findings.get("caches", {}):
                 module.cache = set(findings["caches"][name])
         thaw_channels(get_blast_context(), payload.get("channels", {}))
+        # device-resident state never survives a resume: the resumed
+        # process re-interns nodes and re-blasts literals, so a pool or
+        # cone layout uploaded before the journal describes clause
+        # indices that no longer exist — drop them all
+        from mythril_tpu.ops.batched_sat import reset_resident_pools
+
+        reset_resident_pools()
         if payload.get("device_verdict") is False:
             device_health._verdict = False
         resumed_stats = payload.get("stats", {}).get("resilience", {})

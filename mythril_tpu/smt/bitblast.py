@@ -710,7 +710,9 @@ class BlastContext:
                 self.note_unsat(nodes)
             return status, None
         env = self._extract_model()
-        self._remember_model(env)
+        # tag with the native truth snapshot: CDCL-tail models are the
+        # primary warm-start seed for sibling device lanes
+        self._remember_model(env, truth=self._model_arr)
         return status, env
 
     def _solve_native(self, assumptions, conflict_budget, timeout_s) -> int:
@@ -1183,7 +1185,18 @@ class BlastContext:
                     changed = True
         return changed
 
-    def _remember_model(self, env: T.EvalEnv, keep: int = 6) -> None:
+    def _remember_model(
+        self, env: T.EvalEnv, keep: int = 6, truth=None
+    ) -> None:
+        """Insert a verified model at the front of the recent-models
+        channel.  ``truth`` (a var-indexed int8 assignment row — the
+        native model snapshot or a host-verified device lane) tags the
+        env for the warm-start plane: the newest tagged model seeds
+        sibling lanes' decision phases (see :meth:`warm_phase_vector`).
+        Word-level probe models carry no literal truth and stay
+        untagged — they still serve the probe, just not warm starts."""
+        if truth is not None:
+            env.truth_snapshot = np.asarray(truth, dtype=np.int8)
         for index, known in enumerate(self.recent_models):
             if known is env:
                 # re-hit of a stored model: move to front WITHOUT a
@@ -1196,6 +1209,30 @@ class BlastContext:
         self.recent_models.insert(0, env)
         del self.recent_models[keep:]
         self.model_version += 1  # expires negative batch-probe memos
+
+    def warm_phase_vector(self, num_vars: int):
+        """Decision-phase seed ``[num_vars + 1]`` int8 from the newest
+        recent model that carries a literal-level truth snapshot, or
+        None when no tagged model exists.
+
+        Recency approximates tree proximity: paths fork one branch
+        condition at a time, so the most recently remembered SAT model
+        is almost always an ancestor or sibling of the lanes about to
+        dispatch, and its phases satisfy their shared constraint
+        prefix (phase saving across the fork tree).  The vector only
+        biases which polarity a device decision tries first — it never
+        pre-assigns anything, so UNSAT/SAT semantics are untouched."""
+        for env in self.recent_models:
+            truth = getattr(env, "truth_snapshot", None)
+            if truth is None:
+                continue
+            out = np.zeros(num_vars + 1, dtype=np.int8)
+            n = min(len(truth), num_vars + 1)
+            out[:n] = np.sign(truth[:n]).astype(np.int8)
+            out[0] = 0
+            out[1] = 1  # constant-TRUE anchor
+            return out
+        return None
 
     def _var_matrix(self):
         """var_bits as (node_ids, FALSE_LIT-padded literal matrix);

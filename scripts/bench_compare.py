@@ -1,11 +1,14 @@
 """Perf regression gate over the bench artifacts.
 
 Diffs the two most recent ``BENCH_r*.json`` headlines and exits
-non-zero when ``t3_wall_s`` or ``device_s`` regressed by more than the
-threshold (default 20%) — the tripwire the straggler-aware sweep
-scheduling work is held to round over round.  Everything else on the
-headline (sweep_util, dispatch counts, degradation counters) is printed
-as an informational delta.
+non-zero when a gated metric regressed by more than the threshold
+(default 20%): ``t3_wall_s`` / ``device_s`` (the straggler-aware sweep
+scheduling tripwire), ``checkpoint_overhead_s`` (journal cadence), and
+``device_sweeps`` / ``h2d_bytes`` (the incremental dispatch plane —
+warm starts must keep cutting sweeps, and the resident pool / delta
+uploads / cone memo must keep payload bytes down).  Everything else on
+the headline (sweep_util, dispatch counts, degradation counters) is
+printed as an informational delta.
 
 Usage:
     python scripts/bench_compare.py [--dir REPO] [--threshold 0.20]
@@ -24,8 +27,11 @@ import sys
 
 #: headline metrics gated on regression (larger = worse);
 #: checkpoint_overhead_s gates checkpoint-cadence regressions — a
-#: costlier journal format or an over-eager cadence shows up here
-GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s")
+#: costlier journal format or an over-eager cadence shows up here;
+#: device_sweeps / h2d_bytes gate the incremental dispatch plane
+#: (cold-started lanes / full re-uploads creeping back in)
+GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
+         "device_sweeps", "h2d_bytes")
 #: floor below which a baseline is noise and ratios are meaningless
 MIN_BASE = 0.05
 
