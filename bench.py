@@ -415,6 +415,7 @@ def _analyze_one(name, code, tx_count, execution_timeout, max_depth):
     from mythril_tpu.analysis.security import fire_lasers
     from mythril_tpu.analysis.symbolic import SymExecWrapper
     from mythril_tpu.laser.ethereum.time_handler import time_handler
+    from mythril_tpu.observability import spans as obs_spans
     from mythril_tpu.ops.async_dispatch import async_stats, get_async_dispatcher
     from mythril_tpu.ops.batched_sat import dispatch_stats
     from mythril_tpu.smt.solver import SolverStatistics, reset_blast_context
@@ -433,6 +434,11 @@ def _analyze_one(name, code, tx_count, execution_timeout, max_depth):
     stats.reset()
     contract = EVMContract(code=code, name=name)
     time_handler.start_execution(execution_timeout)
+    # span-derived per-phase breakdown: snapshot the tracer's per-name
+    # totals so this contract's cone/upload/sweep/tail seconds come
+    # from the SAME spans --trace-out would show (zeros when the
+    # tracer is off)
+    span_base = obs_spans.totals_snapshot()
     t0 = time.time()
     sym = SymExecWrapper(
         contract,
@@ -468,6 +474,12 @@ def _analyze_one(name, code, tx_count, execution_timeout, max_depth):
         **dd,
         **{k: round(v, 3) if isinstance(v, float) else v
            for k, v in async_stats.as_dict().items()},
+        # per-phase wall breakdown derived from the observability
+        # spans (cone extraction / H2D upload / device sweep rounds /
+        # CDCL tail) — the same data --trace-out exports, not a
+        # parallel set of ad-hoc monotonic pairs
+        **{f"span_{k}": v
+           for k, v in obs_spans.phase_totals(base=span_base).items()},
         "device_status": DEVICE_STATUS,
     }
     return found, row
@@ -755,6 +767,10 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
         # former, the resident pool / cone memo cut the latter
         "device_sweeps": summary.get("device_sweeps", 0),
         "h2d_bytes": summary.get("h2d_bytes", 0),
+        # observability-plane self-cost: estimated wall spent on span
+        # bookkeeping this run (bench_compare gates regressions; 0.0
+        # with tracing killed via MYTHRIL_TPU_TRACE=0)
+        "trace_overhead_s": summary.get("trace_overhead_s", 0.0),
     }
     if "t3_wall_s" in summary:
         headline["t3_wall_s"] = summary["t3_wall_s"]
@@ -772,14 +788,38 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
     line = json.dumps(headline)
     if len(line) > 500:  # hard cap so the tail capture can never lose it
         for key in ("microbench_speedup", "microbench_device_warm_s",
-                    "mesh_row_ok", "sweep_util", "h2d_bytes",
-                    "device_sweeps", "checkpoint_overhead_s",
-                    "t3_wall_s", "error", "watchdog_trips", "demotions"):
+                    "mesh_row_ok", "trace_overhead_s", "sweep_util",
+                    "h2d_bytes", "device_sweeps",
+                    "checkpoint_overhead_s", "t3_wall_s", "error",
+                    "watchdog_trips", "demotions"):
             headline.pop(key, None)
             line = json.dumps(headline)
             if len(line) <= 500:
                 break
     return line
+
+
+def _enable_tracing_and_calibrate() -> float:
+    """Enable the observability span tracer in totals-only mode (per-
+    name durations, no event buffer) so every row's phase breakdown is
+    span-derived, and measure the per-span bookkeeping cost.  The
+    headline ``trace_overhead_s`` is that unit cost times the spans
+    actually recorded over the run — the number the <2%% disabled-path
+    budget is judged on.  Honors the ``MYTHRIL_TPU_TRACE=0`` kill
+    switch (returns 0.0: spans are no-ops, breakdowns read zero)."""
+    from mythril_tpu.observability import spans as obs_spans
+
+    tracer = obs_spans.get_tracer()
+    if not tracer.enable(record_events=False):
+        return 0.0
+    n = 20_000
+    began = time.perf_counter()
+    for _ in range(n):
+        with obs_spans.span("bench.calibrate"):
+            pass
+    per_span = (time.perf_counter() - began) / n
+    tracer.reset()  # calibration spans must not pollute row breakdowns
+    return per_span
 
 
 def _enable_compile_cache() -> str:
@@ -805,6 +845,7 @@ def main() -> None:
     logging.basicConfig(level=logging.CRITICAL)
     logging.getLogger("mythril_tpu").setLevel(logging.CRITICAL)
     _enable_compile_cache()
+    per_span_s = _enable_tracing_and_calibrate()
 
     argv = sys.argv[1:]
     all_modes = "--all-modes" in argv
@@ -987,6 +1028,16 @@ def main() -> None:
         ]
         if t3_missed:
             summary["t3_error"] = f"t3 missed findings: {t3_missed}"
+    # tracing self-cost estimate: measured per-span bookkeeping cost x
+    # events actually recorded across every pass of this process (the
+    # headline field bench_compare gates; 0.0 with MYTHRIL_TPU_TRACE=0)
+    from mythril_tpu.observability import spans as obs_spans
+
+    tracer = obs_spans.get_tracer()
+    summary["trace_events"] = tracer.span_count + tracer.instant_count
+    summary["trace_overhead_s"] = round(
+        per_span_s * summary["trace_events"], 4
+    )
     summary["solver_batch_microbench"] = microbench
     summary["scale_mesh_virtual"] = mesh_scale
     # headline sweep utilization: over the corpus pass AND the scale

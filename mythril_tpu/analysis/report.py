@@ -267,6 +267,14 @@ class Report:
                 meta["resilience"] = degraded
         except Exception:  # noqa: BLE001 — telemetry never breaks reports
             pass
+        try:
+            # stable observability section: artifact paths + event
+            # counts, every key always present (docs/observability.md)
+            from mythril_tpu.observability import observability_meta
+
+            meta["observability"] = observability_meta()
+        except Exception:  # noqa: BLE001 — telemetry never breaks reports
+            pass
         result = [
             {
                 "issues": issues,

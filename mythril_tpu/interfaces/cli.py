@@ -287,6 +287,23 @@ def create_analyzer_parser(analyzer_parser: argparse.ArgumentParser) -> None:
         metavar="DIR",
     )
     options.add_argument(
+        "--trace-out",
+        help="Write a Chrome/Perfetto trace_event JSON timeline of the "
+        "analysis to FILE: hierarchical spans across CLI -> analyzer -> "
+        "svm rounds -> dispatch -> ladder rounds -> H2D uploads -> the "
+        "CDCL tail, with watchdog trips / fault injections / demotions "
+        "/ checkpoint writes as instant events (open at "
+        "https://ui.perfetto.dev; kill switch MYTHRIL_TPU_TRACE=0)",
+        metavar="FILE",
+    )
+    options.add_argument(
+        "--metrics-out",
+        help="Dump the unified metrics registry (resilience, dispatch, "
+        "async-prefetch and trace counters) in Prometheus text format "
+        "to FILE when the analysis ends",
+        metavar="FILE",
+    )
+    options.add_argument(
         "--proof-log",
         action="store_true",
         help="Record a DRAT-style proof stream on the native solver and "
@@ -572,19 +589,27 @@ def _build_analyzer(
 
 
 def _fire_and_print(analyzer: MythrilAnalyzer, args: argparse.Namespace) -> None:
-    report = analyzer.fire_lasers(
-        modules=[m.strip() for m in args.modules.strip().split(",")]
-        if args.modules
-        else None,
-        transaction_count=args.transaction_count,
-    )
-    renderers = {
-        "json": report.as_json,
-        "jsonv2": report.as_swc_standard_format,
-        "text": report.as_text,
-        "markdown": report.as_markdown,
-    }
-    print(renderers[getattr(args, "outform", "text")]())
+    from mythril_tpu.observability import finalize_outputs, span
+
+    with span("cli.analyze", cat="cli"):
+        report = analyzer.fire_lasers(
+            modules=[m.strip() for m in args.modules.strip().split(",")]
+            if args.modules
+            else None,
+            transaction_count=args.transaction_count,
+        )
+        renderers = {
+            "json": report.as_json,
+            "jsonv2": report.as_swc_standard_format,
+            "text": report.as_text,
+            "markdown": report.as_markdown,
+        }
+        rendered = renderers[getattr(args, "outform", "text")]()
+    # --trace-out / --metrics-out artifacts land BEFORE the report hits
+    # stdout: a consumer that closes the pipe early (head, a crashed
+    # reader) must not cost the run its timeline
+    finalize_outputs()
+    print(rendered)
 
 
 def execute_truffle(args: argparse.Namespace) -> None:
@@ -802,6 +827,15 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
         from mythril_tpu.resilience.checkpoint import install_signal_handlers
 
         install_signal_handlers()
+        # observability plane: --trace-out enables the span tracer,
+        # --metrics-out requests a Prometheus dump at exit; both hook
+        # the flight recorder's crash dump (docs/observability.md)
+        from mythril_tpu.observability import configure_from_cli
+
+        configure_from_cli(
+            getattr(args, "trace_out", None),
+            getattr(args, "metrics_out", None),
+        )
 
     if args.command == "function-to-hash":
         print(MythrilDisassembler.hash_for_function_signature(args.func_name))

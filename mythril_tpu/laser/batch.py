@@ -21,6 +21,7 @@ import logging
 from typing import List
 
 from mythril_tpu.exceptions import UnsatError
+from mythril_tpu.observability import spans as obs
 from mythril_tpu.support.support_args import args
 
 log = logging.getLogger(__name__)
@@ -39,6 +40,11 @@ def _structurally_false(constraints) -> bool:
 
 def prune_infeasible(states: List) -> List:
     """Return the subset of states whose path constraints are satisfiable."""
+    with obs.span("batch.prune", cat="batch", states=len(states)):
+        return _prune_infeasible(states)
+
+
+def _prune_infeasible(states: List) -> List:
     undecided = []
     feasible = []
     for state in states:

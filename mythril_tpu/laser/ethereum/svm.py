@@ -16,6 +16,7 @@ from datetime import datetime, timedelta
 from typing import Callable, Dict, List, Optional, Tuple, Union
 
 from mythril_tpu.laser.batch import prune_infeasible
+from mythril_tpu.observability import spans as obs
 from mythril_tpu.laser.ethereum.cfg import Edge, JumpType, Node, NodeFlags
 from mythril_tpu.laser.ethereum.evm_exceptions import StackUnderflowException, VmException
 from mythril_tpu.laser.ethereum.instructions import Instruction
@@ -198,24 +199,26 @@ class LaserEVM:
             # Frontier pruning across transactions: the reference issues
             # one solver call per open state (svm.py:201-204); here the
             # whole frontier goes through one batched pass.
-            old_states = self.open_states
-            self.open_states = [
-                pseudo.world_state
-                for pseudo in prune_infeasible(
-                    [_WorldStateView(ws) for ws in old_states]
+            with obs.span("svm.transaction", cat="svm", tx=i,
+                          open_states=len(self.open_states)):
+                old_states = self.open_states
+                self.open_states = [
+                    pseudo.world_state
+                    for pseudo in prune_infeasible(
+                        [_WorldStateView(ws) for ws in old_states]
+                    )
+                ]
+                self.iteration_states.append(len(self.open_states))
+                plane.transaction_boundary(self, address, i)
+                log.info(
+                    "Starting message call transaction, iteration: %d, "
+                    "%d initial states",
+                    i,
+                    len(self.open_states),
                 )
-            ]
-            self.iteration_states.append(len(self.open_states))
-            plane.transaction_boundary(self, address, i)
-            log.info(
-                "Starting message call transaction, iteration: %d, "
-                "%d initial states",
-                i,
-                len(self.open_states),
-            )
-            self._execute_hooks(self._start_exec_hooks)
-            execute_message_call(self, address)
-            self._execute_hooks(self._stop_exec_hooks)
+                self._execute_hooks(self._start_exec_hooks)
+                execute_message_call(self, address)
+                self._execute_hooks(self._stop_exec_hooks)
         else:
             if not drain_requested():
                 # completed every transaction: journal the final
@@ -272,51 +275,68 @@ class LaserEVM:
             # (executed state, op_code, successor states) per lane
             rounds: List[Tuple[GlobalState, Optional[str], List[GlobalState]]] = []
             timed_out = None
-            for lane, global_state in enumerate(batch):
-                deadline = (
-                    self.create_timeout
-                    if create
-                    else self.execution_timeout
+            round_span = obs.span("svm.round", cat="svm",
+                                  batch=len(batch))
+            round_span.__enter__()
+            try:
+                timed_out = self._exec_round(
+                    batch, rounds, create, track_gas, final_states
                 )
-                if (
-                    deadline
-                    and self.time + timedelta(seconds=deadline)
-                    <= datetime.now()
-                ):
-                    log.debug("Hit %s timeout, returning.",
-                              "create" if create else "execution")
-                    # already-executed lanes still get their successors
-                    # pruned and recorded below; unexecuted lanes return
-                    # to the work list
-                    self.work_list += batch[lane + 1 :]
-                    timed_out = global_state
-                    break
-
-                try:
-                    new_states, op_code = self.execute_state(global_state)
-                except NotImplementedError:
-                    log.debug("Encountered unimplemented instruction")
-                    continue
-                rounds.append((global_state, op_code, new_states))
-
-            all_new = [s for _, _, succ in rounds for s in succ]
-            if not args.sparse_pruning and all_new:
-                kept = {id(s) for s in prune_infeasible(all_new)}
-            else:
-                kept = {id(s) for s in all_new}
-
-            for global_state, op_code, new_states in rounds:
-                surviving = [s for s in new_states if id(s) in kept]
-                self.manage_cfg(op_code, surviving)
-                if surviving:
-                    self.work_list += surviving
-                elif track_gas:
-                    final_states.append(global_state)
-                self.total_states += len(surviving)
+            finally:
+                round_span.__exit__(None, None, None)
 
             if timed_out is not None:
                 return final_states + [timed_out] if track_gas else None
         return final_states if track_gas else None
+
+    def _exec_round(self, batch, rounds, create, track_gas,
+                    final_states):
+        """One scheduler round: execute the drawn batch, prune the
+        union of successors, record survivors.  Returns the state that
+        hit the wall-clock deadline (the caller unwinds), or None."""
+        timed_out = None
+        for lane, global_state in enumerate(batch):
+            deadline = (
+                self.create_timeout
+                if create
+                else self.execution_timeout
+            )
+            if (
+                deadline
+                and self.time + timedelta(seconds=deadline)
+                <= datetime.now()
+            ):
+                log.debug("Hit %s timeout, returning.",
+                          "create" if create else "execution")
+                # already-executed lanes still get their successors
+                # pruned and recorded below; unexecuted lanes return
+                # to the work list
+                self.work_list += batch[lane + 1 :]
+                timed_out = global_state
+                break
+
+            try:
+                new_states, op_code = self.execute_state(global_state)
+            except NotImplementedError:
+                log.debug("Encountered unimplemented instruction")
+                continue
+            rounds.append((global_state, op_code, new_states))
+
+        all_new = [s for _, _, succ in rounds for s in succ]
+        if not args.sparse_pruning and all_new:
+            kept = {id(s) for s in prune_infeasible(all_new)}
+        else:
+            kept = {id(s) for s in all_new}
+
+        for global_state, op_code, new_states in rounds:
+            surviving = [s for s in new_states if id(s) in kept]
+            self.manage_cfg(op_code, surviving)
+            if surviving:
+                self.work_list += surviving
+            elif track_gas:
+                final_states.append(global_state)
+            self.total_states += len(surviving)
+        return timed_out
 
     def execute_state(
         self, global_state: GlobalState

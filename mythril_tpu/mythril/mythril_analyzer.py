@@ -239,6 +239,8 @@ class MythrilAnalyzer:
         SolverStatistics().enabled = True
         from mythril_tpu.ops.device_placement import corpus_shard
 
+        from mythril_tpu.observability import spans as obs
+
         all_issues: List[Issue] = []
         exceptions: List[str] = []
         execution_info = None
@@ -246,7 +248,11 @@ class MythrilAnalyzer:
         for index, contract in enumerate(self.contracts):
             # contract-level data parallelism: pin this contract's
             # device work to devices[index % n] (no-op on 1 device)
-            with corpus_shard(index if shard else None):
+            with obs.span("analyzer.contract", cat="analyzer",
+                          contract=getattr(contract, "name", "") or "",
+                          index=index), corpus_shard(
+                index if shard else None
+            ):
                 issues, info, failure = self._analyze_contract(
                     contract, modules, transaction_count
                 )

@@ -1,0 +1,92 @@
+"""Observability plane: hierarchical tracing, unified metrics, and a
+crash-safe flight recorder (docs/observability.md).
+
+Three pillars, all zero-dependency and kill-switchable via
+``MYTHRIL_TPU_TRACE=0``:
+
+- :mod:`.spans` — the tracer: context-manager/decorator spans with
+  thread-local nesting across the whole pipeline, plus instant events
+  (watchdog trips, faults, demotions, checkpoint writes), exported as
+  Chrome/Perfetto ``trace_event`` JSON via ``--trace-out``;
+- :mod:`.metrics` — one process-wide registry of named
+  counters/gauges/histograms that absorbs the resilience telemetry
+  (``resilience/telemetry.py`` is a shim over it) and mirrors
+  ``DispatchStats``/``AsyncStats`` at render time; Prometheus text
+  dump via ``--metrics-out``;
+- :mod:`.flight` — a bounded ring of the most recent events, dumped on
+  watchdog trip, ladder demotion, graceful drain, and unhandled
+  exception.
+
+This package imports only the stdlib at module load, so every layer of
+the system (including the leaf telemetry module) can depend on it
+without cycles.
+"""
+
+from mythril_tpu.observability.flight import (  # noqa: F401
+    get_flight_recorder,
+    install_excepthook,
+)
+from mythril_tpu.observability.metrics import get_registry  # noqa: F401
+from mythril_tpu.observability.spans import (  # noqa: F401
+    get_tracer,
+    instant,
+    phase_totals,
+    span,
+    totals_snapshot,
+    traced,
+)
+
+
+def configure_from_cli(trace_out, metrics_out) -> None:
+    """CLI entry wiring (``myth analyze --trace-out F --metrics-out G``):
+    publish the paths on the args bus (the report's meta block and the
+    flight recorder read them), enable the tracer when a trace file was
+    requested, and hook the crash dump."""
+    from mythril_tpu.support.support_args import args
+
+    args.trace_out = trace_out
+    args.metrics_out = metrics_out
+    if trace_out:
+        get_tracer().enable(record_events=True)
+    if trace_out or metrics_out:
+        install_excepthook()
+
+
+def finalize_outputs() -> None:
+    """Write the requested artifact files (end of a CLI analysis).
+    Never raises — a full disk must not fail an analysis that already
+    produced its report."""
+    import logging
+
+    from mythril_tpu.support.support_args import args
+
+    log = logging.getLogger(__name__)
+    trace_out = getattr(args, "trace_out", None)
+    metrics_out = getattr(args, "metrics_out", None)
+    if trace_out:
+        try:
+            get_tracer().export_chrome(trace_out)
+        except Exception as exc:  # noqa: BLE001
+            log.error("trace export to %s failed: %s", trace_out, exc)
+    if metrics_out:
+        try:
+            get_registry().dump(metrics_out)
+        except Exception as exc:  # noqa: BLE001
+            log.error("metrics dump to %s failed: %s", metrics_out, exc)
+
+
+def observability_meta() -> dict:
+    """Stable ``meta.observability`` block for the jsonv2 report:
+    artifact paths and event counts, every key always present."""
+    from mythril_tpu.support.support_args import args
+
+    tracer = get_tracer()
+    return {
+        "enabled": bool(tracer.enabled),
+        "trace_out": getattr(args, "trace_out", None),
+        "metrics_out": getattr(args, "metrics_out", None),
+        "span_events": int(tracer.span_count),
+        "instant_events": int(tracer.instant_count),
+        "dropped_events": int(tracer.dropped),
+        "flight_dumps": int(get_flight_recorder().dumps_written),
+    }

@@ -29,10 +29,11 @@ Sharding: the lane axis is data-parallel; ``parallel.mesh`` shards
 """
 
 import logging
-import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from mythril_tpu.observability import spans as obs
 
 log = logging.getLogger(__name__)
 
@@ -271,7 +272,9 @@ class DevicePool:
         self.lits_np = mat  # host mirror
         # (the mesh path shards from here without a device round-trip)
         stale = self.lits
-        self.lits = jnp.asarray(self.lits_np)
+        with obs.span("upload.pool", cat="h2d", bytes=int(mat.nbytes),
+                      rows=target_c):
+            self.lits = jnp.asarray(self.lits_np)
         if stale is not None and self._safe_to_donate():
             # donate the stale device buffer eagerly: a refresh doubles
             # the pool bucket, and holding both generations until GC
@@ -315,9 +318,11 @@ class DevicePool:
         self.dropped += dropped
         if len(rows):
             self.lits_np[self.filled : self.filled + len(rows)] = rows
-            self.lits = self.lits.at[
-                self.filled : self.filled + len(rows)
-            ].set(rows)
+            with obs.span("upload.delta", cat="h2d",
+                          bytes=int(rows.nbytes), rows=len(rows)):
+                self.lits = self.lits.at[
+                    self.filled : self.filled + len(rows)
+                ].set(rows)
             self.filled += len(rows)
             occurring = np.abs(rows).ravel()
             self.used[occurring[occurring <= self.num_vars]] = True
@@ -928,9 +933,13 @@ class BatchedSatBackend:
                 break
             state["step"][:] = 0  # per-round active-sweep counters
             step_fn = self._cached_round(V1 - 1, budget)
-            state, quarantined = self._dispatch_round(
-                f"{key_base}:{budget}", step_fn, lits, state, order, live
-            )
+            with obs.span("dispatch.round", cat="sweep",
+                          key=f"{key_base}:{budget}",
+                          lanes=int(live.size), bucket=B):
+                state, quarantined = self._dispatch_round(
+                    f"{key_base}:{budget}", step_fn, lits, state, order,
+                    live,
+                )
             for local in quarantined:
                 state["status"][local] = 3  # undecided -> CDCL tail
             dispatch_stats.rounds += 1
@@ -1110,6 +1119,10 @@ class BatchedSatBackend:
         CSR fetch, width filter, compact remap, anchor row.  Returns
         (rows, cone_vars, anchor_column) or None when the cone exceeds
         the tier caps."""
+        with obs.span("cone.build", cat="cone", roots=len(roots)):
+            return self._build_cone_rows_inner(ctx, roots)
+
+    def _build_cone_rows_inner(self, ctx, roots):
         try:
             clause_ids, cone_vars = ctx.pool.cone(list(roots))
         except Exception:  # noqa: BLE001 — optimization tier only
@@ -1222,7 +1235,9 @@ class BatchedSatBackend:
 
             def _upload_rows():
                 dispatch_stats.h2d_bytes += int(rows.nbytes)
-                return jnp.asarray(rows)
+                with obs.span("upload.cone_rows", cat="h2d",
+                              bytes=int(rows.nbytes)):
+                    return jnp.asarray(rows)
 
             rows_dev = get_cone_memo().get_or_build(
                 ctx, ("cone_dev", roots), _upload_rows
@@ -1492,26 +1507,27 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     from mythril_tpu.support.model import peek_model_verdict
 
     stats = SolverStatistics()
-    probe_began = time.monotonic()
-    for i, nodes in enumerate(node_sets):
-        if nodes is None:
-            continue
-        if ctx.unsat_memo_hit(tuple(sorted(n.id for n in nodes))):
-            decided[i] = False  # permanent verdict (see BlastContext)
-            continue
-        # the per-query funnel may have solved this exact set already
-        # (frontier sets repeat across rounds); a cached verdict beats
-        # re-probing against the rotating recent-model set
-        cached = peek_model_verdict(constraint_sets[i])
-        if cached is not None:
-            decided[i] = cached
-            continue
-        if not getattr(args, "word_probing", True):
-            continue
-        if ctx.probe_with_memo(nodes) is not None:
-            decided[i] = True
-            dispatch_stats.host_probe_sat += 1
-    stats.probe_s += time.monotonic() - probe_began
+    with obs.span("solver.probe", sink=(stats, "probe_s"), cat="solver",
+                  lanes=len(node_sets)):
+        for i, nodes in enumerate(node_sets):
+            if nodes is None:
+                continue
+            if ctx.unsat_memo_hit(tuple(sorted(n.id for n in nodes))):
+                decided[i] = False  # permanent verdict (see BlastContext)
+                continue
+            # the per-query funnel may have solved this exact set
+            # already (frontier sets repeat across rounds); a cached
+            # verdict beats re-probing against the rotating
+            # recent-model set
+            cached = peek_model_verdict(constraint_sets[i])
+            if cached is not None:
+                decided[i] = cached
+                continue
+            if not getattr(args, "word_probing", True):
+                continue
+            if ctx.probe_with_memo(nodes) is not None:
+                decided[i] = True
+                dispatch_stats.host_probe_sat += 1
 
     proof_log = getattr(args, "proof_log", False)
     # --proof-log no longer disables the accelerator (VERDICT r4 #6):
@@ -1649,12 +1665,16 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         return decided
     extras = admitted
     prefetch_inflight = get_async_dispatcher().pending is not None
-    dispatch_began = time.monotonic()
-    verdicts = backend.check_assumption_sets(
-        ctx, rep_sets + [q.lits for q in extras],
-    )
-    dispatch_elapsed = time.monotonic() - dispatch_began
-    dispatch_stats.device_s += dispatch_elapsed
+    # the span is the timing primitive: it feeds device_s whether or
+    # not tracing is on, and lands on the --trace-out timeline when it
+    # is (observability/spans.py), so the two can never disagree
+    with obs.span("dispatch.batch_check",
+                  sink=(dispatch_stats, "device_s"), cat="dispatch",
+                  lanes=len(rep_sets) + len(extras)) as dispatch_span:
+        verdicts = backend.check_assumption_sets(
+            ctx, rep_sets + [q.lits for q in extras],
+        )
+    dispatch_elapsed = dispatch_span.elapsed_s
     # attribution counters tally only real device (or interpret-mode
     # kernel) passes — a bail-out to the CDCL tail is not a dispatch
     engaged = getattr(backend, "device_engaged", False)

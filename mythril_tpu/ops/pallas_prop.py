@@ -1056,6 +1056,7 @@ def _run_dense_ladder(
     Returns (status[n_real] int32 with bails mapped to 0, final
     A[n_real, V] float32).
     """
+    from mythril_tpu.observability import spans as obs
     from mythril_tpu.ops.batched_sat import dispatch_stats
     from mythril_tpu.resilience import faults
     from mythril_tpu.resilience.checkpoint import drain_requested
@@ -1091,9 +1092,13 @@ def _run_dense_ladder(
         if drain_requested():
             break
         faults.maybe_fault_dispatch()
-        fn = round_fn(B, budget, hot_c)
-        out = fn(*planes, *state)
-        state, steps_used = list(out[:-1]), int(out[-1])
+        # int(out[-1]) blocks until the round finished, so the span
+        # brackets the real device wall for this budget rung
+        with obs.span("pallas.round", cat="sweep", budget=budget,
+                      bucket=B, lanes=int(live.size)):
+            fn = round_fn(B, budget, hot_c)
+            out = fn(*planes, *state)
+            state, steps_used = list(out[:-1]), int(out[-1])
         dispatch_stats.rounds += 1
         dispatch_stats.device_sweeps += steps_used
         dispatch_stats.lane_sweeps_total += steps_used * B

@@ -366,6 +366,11 @@ def request_drain(reason: str = "signal") -> None:
             "drain requested (%s): finishing in-flight rounds, writing a "
             "final checkpoint, and emitting a partial report", reason,
         )
+        from mythril_tpu.observability import flight as obs_flight
+        from mythril_tpu.observability import spans as obs
+
+        obs.instant("drain.requested", cat="resilience", reason=reason)
+        obs_flight.get_flight_recorder().dump("drain")
     _drain_event.set()
 
 
@@ -496,6 +501,10 @@ class CheckpointPlane:
         elapsed = time.monotonic() - began
         resilience_stats.checkpoints_written += 1
         resilience_stats.checkpoint_s += elapsed
+        from mythril_tpu.observability import spans as obs
+
+        obs.instant("checkpoint.write", cat="resilience",
+                    elapsed_ms=round(elapsed * 1e3, 3))
         self._last_write = time.monotonic()
         self._demotion_pending = False
 

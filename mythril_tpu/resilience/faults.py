@@ -218,6 +218,9 @@ class FaultPlane:
             logging.shutdown()
             os.kill(os.getpid(), 9)  # SIGKILL: no cleanup, by design
         if spec is not None:
+            from mythril_tpu.observability import spans as obs
+
+            obs.instant("fault.fired", cat="resilience", point=point)
             log.info("fault plane: firing %s", point)
         return spec
 

@@ -1,44 +1,84 @@
-"""Degradation telemetry counters.
+"""Degradation telemetry counters — now a compatibility shim over the
+unified metrics registry (observability/metrics.py).
 
-Kept in a leaf module (no imports beyond the stdlib) so the fault
-plane, the watchdog, the RPC client, and the dispatch stats can all
-increment/merge the same counters without import cycles.
-``DispatchStats.as_dict`` (ops/batched_sat.py) merges these into every
-per-contract bench row, ``bench.py`` sums them into the summary and
-headline, and the jsonv2 report attaches the nonzero subset to its
-``meta`` block — a degraded run is attributable from the artifact
-alone.
+The counters keep their historical API (``resilience_stats.demotions
++= 1``, ``reset()``, ``as_dict()``) so every seam — the fault plane,
+the watchdog, the RPC client, the dispatch stats, the checkpoint
+restore path — keeps working unchanged, but the *storage* is a single
+registry counter per field (``mythril_tpu_resilience_<field>``).  One
+source of truth means a Prometheus dump (``--metrics-out``) and the
+bench rows can never disagree, and no counter is ever counted twice:
+``DispatchStats.as_dict`` (ops/batched_sat.py) reads these values into
+every per-contract bench row, while the registry render emits the
+``mythril_tpu_resilience_*`` series directly (the DispatchStats mirror
+covers only its own fields).
+
+Reset semantics are unchanged: counters reset per analyzed contract
+alongside ``DispatchStats``, so per-contract rows stay per-contract
+(the Prometheus dump therefore reflects the *current* contract, same
+as the report's ``meta.resilience`` block).
 """
+
+from mythril_tpu.observability.metrics import get_registry
+
+_PREFIX = "mythril_tpu_resilience_"
+
+#: field -> help string; the field ORDER is the historical as_dict order
+_FIELDS = {
+    "watchdog_trips": "dispatch deadlines exceeded",
+    "dispatch_retries": "ladder retries spent (device + CDCL)",
+    "demotions": (
+        "contexts/channels demoted to the native CDCL tail "
+        "(or prefetch channel abandoned)"
+    ),
+    "rpc_retries": "transient RPC failures retried",
+    "faults_fired": "injected faults actually fired",
+    # poisoned-lane bisection (ops/batched_sat._solve_gather_ladder):
+    # a repeatably failing round dispatch is bisected instead of
+    # demoting the whole context — only the offending lane(s) go to
+    # the CDCL tail and the context stays on device
+    "quarantined_lanes": "lanes isolated to the CDCL tail",
+    "bisect_dispatches": "re-dispatches spent isolating them",
+    # checkpoint/resume plane (resilience/checkpoint.py)
+    "checkpoints_written": "journal generations persisted",
+    "resumes": "analyses rebuilt from a journal",
+    "checkpoint_s": "wall-clock spent writing journals",
+}
 
 
 class ResilienceStats:
     """Process-wide degradation counters (reset per analyzed contract
-    alongside ``DispatchStats``)."""
+    alongside ``DispatchStats``); attribute access is a thin shim over
+    the unified metrics registry."""
+
+    __slots__ = ()
 
     def __init__(self):
         self.reset()
 
+    @staticmethod
+    def _cell(field: str):
+        return get_registry().counter(_PREFIX + field, _FIELDS[field])
+
     def reset(self):
-        self.watchdog_trips = 0     # dispatch deadlines exceeded
-        self.dispatch_retries = 0   # ladder retries spent (device + CDCL)
-        self.demotions = 0          # contexts/channels demoted to the
-        #                             native CDCL tail (or prefetch
-        #                             channel abandoned)
-        self.rpc_retries = 0        # transient RPC failures retried
-        self.faults_fired = 0       # injected faults actually fired
-        # poisoned-lane bisection (ops/batched_sat._solve_gather_ladder):
-        # a repeatably failing round dispatch is bisected instead of
-        # demoting the whole context — only the offending lane(s) go to
-        # the CDCL tail and the context stays on device
-        self.quarantined_lanes = 0  # lanes isolated to the CDCL tail
-        self.bisect_dispatches = 0  # re-dispatches spent isolating them
-        # checkpoint/resume plane (resilience/checkpoint.py)
-        self.checkpoints_written = 0  # journal generations persisted
-        self.resumes = 0              # analyses rebuilt from a journal
-        self.checkpoint_s = 0.0       # wall-clock spent writing journals
+        for field in _FIELDS:
+            self._cell(field).set(0.0 if field == "checkpoint_s" else 0)
+
+    def __getattr__(self, name):
+        if name in _FIELDS:
+            return self._cell(name).value
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name not in _FIELDS:
+            raise AttributeError(
+                f"unknown resilience counter {name!r} "
+                f"(registered: {tuple(_FIELDS)})"
+            )
+        self._cell(name).set(value)
 
     def as_dict(self):
-        return dict(self.__dict__)
+        return {field: self._cell(field).value for field in _FIELDS}
 
 
 resilience_stats = ResilienceStats()

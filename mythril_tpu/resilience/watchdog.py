@@ -59,6 +59,8 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from mythril_tpu.observability import flight as obs_flight
+from mythril_tpu.observability import spans as obs
 from mythril_tpu.resilience.telemetry import resilience_stats
 
 log = logging.getLogger(__name__)
@@ -236,6 +238,12 @@ class DispatchWatchdog:
                 return self.run(key, thunk)
             except WatchdogTimeout as exc:
                 resilience_stats.watchdog_trips += 1
+                # timeline + post-mortem: the trip lands as an instant
+                # event and the flight ring is dumped so the spans
+                # leading up to the wedge survive the retry/demotion
+                obs.instant("watchdog.trip", cat="resilience", key=key,
+                            attempt=attempt + 1)
+                obs_flight.get_flight_recorder().dump("watchdog_trip")
                 last = exc
                 log.warning("%s (attempt %d/%d)", exc, attempt + 1,
                             retries + 1)
@@ -259,6 +267,9 @@ class DispatchWatchdog:
         :class:`DispatchAbandoned` for the caller's context demotion."""
         process_demoted = self._reprobe_and_maybe_demote(key, last)
         resilience_stats.demotions += 1
+        obs.instant("ladder.demotion", cat="resilience", key=key,
+                    process_demoted=process_demoted)
+        obs_flight.get_flight_recorder().dump("demotion")
         from mythril_tpu.resilience.checkpoint import get_checkpoint_plane
 
         get_checkpoint_plane().note_demotion()

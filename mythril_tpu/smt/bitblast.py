@@ -660,15 +660,22 @@ class BlastContext:
         from mythril_tpu.support.support_args import args as _args
 
         stats = _solver_stats()
+        # spans are the timing primitive here (observability/spans.py):
+        # each one feeds the SolverStatistics split field exactly like
+        # the old time.monotonic() pairs, and additionally lands on the
+        # --trace-out timeline when tracing is on — the bench breakdown
+        # and the trace can never disagree
+        from mythril_tpu.observability import spans as obs
+
         if getattr(_args, "word_probing", True):
-            t0 = time.monotonic()
-            env = self.probe_with_memo(nodes)
-            stats.probe_s += time.monotonic() - t0
+            with obs.span("solver.probe", sink=(stats, "probe_s"),
+                          cat="solver"):
+                env = self.probe_with_memo(nodes)
             if env is not None:
                 return SatSolver.SAT, env
-        t0 = time.monotonic()
-        assumptions = [self.blast_lit(c) for c in nodes]
-        stats.blast_s += time.monotonic() - t0
+        with obs.span("solver.blast", sink=(stats, "blast_s"),
+                      cat="solver"):
+            assumptions = [self.blast_lit(c) for c in nodes]
         # restrict CDCL decisions to the query's cone: against a large
         # shared pool, VSIDS otherwise wanders into foreign gates and
         # pays full-pool propagation per irrelevant decision
@@ -686,22 +693,26 @@ class BlastContext:
                 )
             except Exception:  # noqa: BLE001 — telemetry only
                 pass
-        t0 = time.monotonic()
-        if getattr(_args, "cone_decisions", True):
-            try:
-                # one native call: each root's memoized cone vars are
-                # marked straight into the CDCL relevance bitmap (no
-                # union materialization, no host-side fetch)
-                self.pool.relevant_cone(assumptions)
-            except Exception:  # noqa: BLE001 — optimization only
+        with obs.span("solver.cone", sink=(stats, "cone_s"),
+                      cat="cone"):
+            if getattr(_args, "cone_decisions", True):
+                try:
+                    # one native call: each root's memoized cone vars
+                    # are marked straight into the CDCL relevance
+                    # bitmap (no union materialization, no host-side
+                    # fetch)
+                    self.pool.relevant_cone(assumptions)
+                except Exception:  # noqa: BLE001 — optimization only
+                    self.solver.set_relevant([])
+            else:
+                # a stale restriction from an earlier query would be
+                # unsound
                 self.solver.set_relevant([])
-        else:
-            # a stale restriction from an earlier query would be unsound
-            self.solver.set_relevant([])
-        stats.cone_s += time.monotonic() - t0
-        t0 = time.monotonic()
-        status = self._solve_native(assumptions, conflict_budget, timeout_s)
-        stats.native_s += time.monotonic() - t0
+        with obs.span("cdcl.solve", sink=(stats, "native_s"),
+                      cat="tail", assumptions=len(assumptions)):
+            status = self._solve_native(
+                assumptions, conflict_budget, timeout_s
+            )
         stats.native_calls += 1
         if status != SatSolver.SAT:
             if status == SatSolver.UNSAT:

@@ -58,6 +58,11 @@ class Args(object, metaclass=Singleton):
         # the frontier from an existing journal and continues
         self.checkpoint_dir = None
         self.resume_from = None
+        # observability plane (mythril_tpu/observability/): Chrome/
+        # Perfetto trace_event JSON timeline and Prometheus metrics
+        # dump destinations (--trace-out / --metrics-out; None = off)
+        self.trace_out = None
+        self.metrics_out = None
         # concrete-prefix dispatcher pre-split (SoA-validated): replace
         # each transaction seed with per-selector states at the
         # function entries (laser/ethereum/lockstep_dispatch.py).

@@ -29,9 +29,12 @@ import sys
 #: checkpoint_overhead_s gates checkpoint-cadence regressions — a
 #: costlier journal format or an over-eager cadence shows up here;
 #: device_sweeps / h2d_bytes gate the incremental dispatch plane
-#: (cold-started lanes / full re-uploads creeping back in)
+#: (cold-started lanes / full re-uploads creeping back in);
+#: trace_overhead_s gates the observability plane's self-cost (span
+#: bookkeeping creeping onto hot paths shows up here before it is
+#: visible in t3_wall_s)
 GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
-         "device_sweeps", "h2d_bytes")
+         "device_sweeps", "h2d_bytes", "trace_overhead_s")
 #: floor below which a baseline is noise and ratios are meaningless
 MIN_BASE = 0.05
 

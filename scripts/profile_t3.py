@@ -1,10 +1,19 @@
 """Profiling harness for the -t3 depth rows (CDCL iteration loop).
 
 Runs one contract at transaction depth 3 with NO execution cap and
-prints the wall, the solver split, native-CDCL counters, and (with
-MYTHRIL_CONE_HISTO=1) the per-query cone-size histogram.
+prints the wall, the span-derived phase breakdown (the same spans
+``--trace-out`` exports — observability/spans.py is the single timing
+source, so this output and a trace file can never disagree), the
+solver split, native-CDCL counters, and (with MYTHRIL_CONE_HISTO=1)
+the per-query cone-size histogram.
 
-Usage:  JAX_PLATFORMS=cpu python scripts/profile_t3.py [ether_send|overflow|batchtoken]
+Usage:  JAX_PLATFORMS=cpu python scripts/profile_t3.py \
+            [ether_send|overflow|batchtoken] [timeout_s] \
+            [--trace-out FILE]
+
+``--trace-out FILE`` additionally records the full event timeline and
+writes Chrome/Perfetto trace_event JSON (open at
+https://ui.perfetto.dev).
 """
 
 import json
@@ -23,8 +32,17 @@ def main() -> None:
     logging.basicConfig(level=logging.CRITICAL)
     logging.disable(logging.CRITICAL)
 
-    which = sys.argv[1] if len(sys.argv) > 1 else "batchtoken"
-    timeout = int(sys.argv[2]) if len(sys.argv) > 2 else 3600
+    argv = list(sys.argv[1:])
+    trace_out = None
+    if "--trace-out" in argv:
+        flag = argv.index("--trace-out")
+        if flag + 1 >= len(argv):
+            sys.exit("--trace-out needs a file path")
+        trace_out = argv[flag + 1]
+        del argv[flag:flag + 2]
+
+    which = argv[0] if argv else "batchtoken"
+    timeout = int(argv[1]) if len(argv) > 1 else 3600
 
     if which == "batchtoken":
         code = bench.batchtoken_contract()
@@ -34,10 +52,16 @@ def main() -> None:
         code = open(path).read().strip()
         expected = {"101", "105"} if which == "ether_send" else {"101"}
 
+    from mythril_tpu.observability import spans as obs_spans
     from mythril_tpu.support.support_args import args
 
     for key, value in bench.MODES["full"].items():
         setattr(args, key, value)
+
+    # same span plane as bench.py / --trace-out: totals-only unless a
+    # trace file was requested (honors MYTHRIL_TPU_TRACE=0)
+    tracer = obs_spans.get_tracer()
+    tracer.enable(record_events=trace_out is not None)
 
     bench.DEVICE_STATUS = "cpu-only"
     t0 = time.time()
@@ -46,6 +70,15 @@ def main() -> None:
     )
     row["total_wall_s"] = round(time.time() - t0, 2)
     row["expected_ok"] = bool(expected & found)
+    # span totals by name (top 12 by wall) — the raw data behind the
+    # row's span_{cone,upload,sweep,tail}_s fields
+    totals = tracer.totals_snapshot()
+    row["span_totals_s"] = {
+        name: round(seconds, 3)
+        for name, seconds in sorted(
+            totals.items(), key=lambda kv: -kv[1]
+        )[:12]
+    }
 
     from mythril_tpu.smt.solver import get_blast_context
 
@@ -64,6 +97,8 @@ def main() -> None:
     histo = getattr(ctx, "cone_histogram", None)
     if histo:
         row["cone_histogram"] = histo
+    if trace_out:
+        row["trace_out"] = tracer.export_chrome(trace_out)
     print(json.dumps(row, indent=1))
 
 

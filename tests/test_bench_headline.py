@@ -5,6 +5,7 @@ required schema keys, and the 500-char hard cap under adversarial
 summary contents."""
 
 import json
+import os
 
 import bench
 
@@ -100,6 +101,40 @@ def test_scale_summary_reports_ladder_telemetry():
     assert out["repacks"] == 4
     assert out["coalesced_dispatches"] == 2
     assert out["sweep_util"] == 0.75
+
+
+def test_headline_carries_trace_overhead():
+    """The observability plane's self-cost rides the headline (and the
+    regression gate in scripts/bench_compare.py): present with a 0.0
+    default, carrying the measured estimate when set, and droppable
+    under the 500-char cap."""
+    payload = json.loads(
+        bench.build_headline_line(dict(BASE_SUMMARY), None, None)
+    )
+    assert payload["trace_overhead_s"] == 0.0
+    summary = dict(BASE_SUMMARY, trace_overhead_s=0.042)
+    payload = json.loads(bench.build_headline_line(summary, None, None))
+    assert payload["trace_overhead_s"] == 0.042
+    summary = dict(BASE_SUMMARY, trace_overhead_s=0.042,
+                   error="missed findings: " + "x" * 1000)
+    line = bench.build_headline_line(summary, None, None)
+    assert len(line) <= 500
+    assert json.loads(line)["metric"] == "analyze_corpus_wall_s"
+
+
+def test_trace_overhead_is_gated_in_bench_compare():
+    """bench_compare must treat the observability self-cost as a gated
+    (larger = worse) headline metric."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "bench_compare.py"),
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    assert "trace_overhead_s" in module.GATED
 
 
 def test_headline_carries_degradation_counters():

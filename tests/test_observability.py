@@ -1,0 +1,489 @@
+"""Observability-plane tests: span nesting/thread-safety, Perfetto
+JSON schema validity, the metrics registry (and the exactly-once
+contract for the absorbed telemetry counters), the flight recorder's
+dump-on-trip via a ``MYTHRIL_TPU_FAULT`` injection, the disabled-path
+overhead guard, and the CLI/report surface (``--trace-out`` /
+``--metrics-out`` / ``meta.observability``)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from mythril_tpu.observability import flight, metrics, spans
+
+pytestmark = pytest.mark.obs
+
+MYTH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "myth"
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_plane(monkeypatch):
+    """Fresh tracer/registry/recorder per test; the telemetry shim
+    re-creates its counters in the new registry on first touch."""
+    monkeypatch.delenv("MYTHRIL_TPU_TRACE", raising=False)
+    spans.reset_for_tests()
+    metrics.reset_for_tests()
+    flight.reset_for_tests()
+    yield
+    spans.reset_for_tests()
+    metrics.reset_for_tests()
+    flight.reset_for_tests()
+
+
+# -- spans ------------------------------------------------------------------
+
+
+def test_span_nesting_records_parent_and_totals():
+    tracer = spans.get_tracer()
+    assert tracer.enable()
+    with spans.span("outer"):
+        with spans.span("inner"):
+            time.sleep(0.01)
+    events = {e["name"]: e for e in tracer.events()}
+    assert set(events) == {"outer", "inner"}
+    assert events["inner"]["args"]["parent"] == "outer"
+    assert "parent" not in events["outer"].get("args", {})
+    # ts/dur containment: the inner span lies inside the outer one
+    outer, inner = events["outer"], events["inner"]
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1
+    totals = tracer.totals_snapshot()
+    assert totals["inner"] >= 0.01
+    assert totals["outer"] >= totals["inner"]
+
+
+def test_span_exception_recorded_and_stack_unwound():
+    tracer = spans.get_tracer()
+    tracer.enable()
+    with pytest.raises(ValueError):
+        with spans.span("exploder"):
+            raise ValueError("boom")
+    (event,) = tracer.events()
+    assert event["args"]["error"] == "ValueError"
+    # the thread-local stack unwound: a following span has no parent
+    with spans.span("after"):
+        pass
+    after = [e for e in tracer.events() if e["name"] == "after"][0]
+    assert "parent" not in after.get("args", {})
+
+
+def test_span_thread_safety():
+    tracer = spans.get_tracer()
+    tracer.enable()
+    threads, per_thread = 8, 200
+    barrier = threading.Barrier(threads)
+
+    def work():
+        barrier.wait()  # overlap all workers: distinct thread idents
+        for _ in range(per_thread):
+            with spans.span("worker.outer"):
+                with spans.span("worker.inner"):
+                    pass
+
+    pool = [threading.Thread(target=work) for _ in range(threads)]
+    for t in pool:
+        t.start()
+    for t in pool:
+        t.join()
+    assert tracer.span_count == threads * per_thread * 2
+    events = tracer.events()
+    assert len(events) == threads * per_thread * 2
+    assert len({e["tid"] for e in events}) == threads
+    # nesting stayed per-thread: every inner's parent is the outer
+    for e in events:
+        if e["name"] == "worker.inner":
+            assert e["args"]["parent"] == "worker.outer"
+
+
+def test_chrome_trace_export_schema(tmp_path):
+    tracer = spans.get_tracer()
+    tracer.enable()
+    with spans.span("a", cat="pipeline", detail=3):
+        spans.instant("tick", cat="event", why="test")
+    path = tracer.export_chrome(str(tmp_path / "trace.json"))
+    payload = json.load(open(path))
+    assert isinstance(payload["traceEvents"], list)
+    phases = set()
+    for event in payload["traceEvents"]:
+        assert isinstance(event["name"], str)
+        assert event["ph"] in ("X", "i")
+        assert isinstance(event["ts"], (int, float))
+        assert isinstance(event["pid"], int)
+        assert isinstance(event["tid"], int)
+        if event["ph"] == "X":
+            assert isinstance(event["dur"], (int, float))
+        phases.add(event["ph"])
+    assert phases == {"X", "i"}
+    assert payload["otherData"]["span_events"] == 1
+    assert payload["otherData"]["instant_events"] == 1
+
+
+def test_trace_buffer_cap_drops_but_keeps_totals(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_TRACE_CAP", "1024")
+    spans.reset_for_tests()
+    tracer = spans.get_tracer()
+    tracer.enable()
+    for _ in range(1500):
+        with spans.span("flood"):
+            pass
+    assert len(tracer.events()) == 1024
+    assert tracer.dropped == 1500 - 1024
+    assert tracer.span_count == 1500
+    assert tracer.counts_snapshot()["flood"] == 1500
+
+
+def test_phase_totals_mapping():
+    tracer = spans.get_tracer()
+    tracer.enable()
+    for name in ("cone.build", "upload.pool", "dispatch.round",
+                 "pallas.round", "cdcl.solve", "svm.transaction"):
+        with spans.span(name):
+            time.sleep(0.002)
+    phases = spans.phase_totals()
+    assert phases["cone_s"] > 0
+    assert phases["upload_s"] > 0
+    assert phases["sweep_s"] > 0  # dispatch.round + pallas.round
+    assert phases["tail_s"] > 0
+    # enclosing layers (svm.transaction) must not leak into a bucket:
+    # the bucketed seconds sum to the five LEAF spans only
+    totals = spans.totals_snapshot()
+    leaves = sum(
+        totals[n] for n in ("cone.build", "upload.pool",
+                            "dispatch.round", "pallas.round",
+                            "cdcl.solve")
+    )
+    assert abs(sum(phases.values()) - leaves) < 1e-3
+
+
+def test_span_sink_feeds_stats_with_tracing_off():
+    class Bag:
+        device_s = 0.0
+
+    bag = Bag()
+    assert not spans.get_tracer().enabled
+    with spans.span("dispatch.batch_check",
+                    sink=(bag, "device_s")) as sp:
+        time.sleep(0.01)
+    assert bag.device_s >= 0.01
+    assert sp.elapsed_s >= 0.01
+    assert spans.get_tracer().span_count == 0  # nothing recorded
+
+
+def test_device_dispatch_span_layers():
+    """The accelerator layers land on the timeline: a pool upload and
+    the ladder's budgeted rounds produce upload.* / dispatch.round
+    spans whose seconds feed the upload/sweep phase buckets (the CPU
+    jax backend runs the same jitted kernels as the TPU)."""
+    from mythril_tpu.ops.batched_sat import (
+        BatchedSatBackend, dispatch_stats,
+    )
+    from mythril_tpu.smt import terms as T
+    from mythril_tpu.smt.bitblast import BlastContext
+
+    dispatch_stats.reset()
+    tracer = spans.get_tracer()
+    tracer.enable()
+    ctx = BlastContext()
+    lits = [
+        ctx.blast_lit(T.eq(T.var(f"ox{i}", 8), T.const(17 * i + 3, 8)))
+        for i in range(4)
+    ]
+    backend = BatchedSatBackend()
+    assign = backend._sync_pool_and_assign(
+        ctx, [[lit] for lit in lits], ctx.solver.num_vars
+    )
+    status, _final = backend._solve_gather_ladder(
+        "gather", backend.pool.lits, assign
+    )
+    assert len(status) == len(lits)
+    names = set(tracer.totals_snapshot())
+    assert "upload.pool" in names
+    assert "dispatch.round" in names
+    phases = spans.phase_totals()
+    assert phases["upload_s"] > 0
+    assert phases["sweep_s"] > 0
+
+
+# -- disabled-path overhead guard -------------------------------------------
+
+
+def test_disabled_path_is_allocation_free_and_cheap(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_TRACE", "0")
+    spans.reset_for_tests()
+    tracer = spans.get_tracer()
+    # the kill switch vetoes programmatic enablement
+    assert tracer.enable() is False
+    assert not tracer.enabled
+    # no allocation: every disabled span() is the same singleton
+    assert spans.span("a") is spans.span("b")
+    spans.instant("never")  # no-op, no error
+    assert tracer.instant_count == 0
+    n = 100_000
+    began = time.perf_counter()
+    for _ in range(n):
+        with spans.span("hot.path"):
+            pass
+    per_call = (time.perf_counter() - began) / n
+    # generous CI bound: the disabled path is one attribute check and
+    # a no-op context manager — single-digit microseconds at worst
+    assert per_call < 10e-6, f"disabled span cost {per_call * 1e6:.2f}us"
+
+
+# -- metrics registry -------------------------------------------------------
+
+
+def test_registry_counter_gauge_histogram_render():
+    registry = metrics.get_registry()
+    counter = registry.counter("mythril_tpu_test_hits", "test counter")
+    counter.inc()
+    counter.inc(2)
+    registry.gauge("mythril_tpu_test_depth", "test gauge").set(7)
+    histogram = registry.histogram(
+        "mythril_tpu_test_latency", "test histogram", buckets=(0.1, 1.0)
+    )
+    histogram.observe(0.05)
+    histogram.observe(5.0)
+    text = registry.render()
+    assert "# TYPE mythril_tpu_test_hits counter" in text
+    assert "mythril_tpu_test_hits 3" in text
+    assert "mythril_tpu_test_depth 7" in text
+    assert 'mythril_tpu_test_latency_bucket{le="0.1"} 1' in text
+    assert 'mythril_tpu_test_latency_bucket{le="+Inf"} 2' in text
+    assert "mythril_tpu_test_latency_count 2" in text
+    # the same metric object comes back on re-registration
+    assert registry.counter("mythril_tpu_test_hits") is counter
+
+
+def test_registry_dump_covers_every_preexisting_counter_bag(tmp_path):
+    """The unified dump absorbs telemetry + DispatchStats + AsyncStats:
+    every pre-existing counter appears, each exactly once."""
+    from mythril_tpu.ops.async_dispatch import async_stats
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+    from mythril_tpu.resilience.telemetry import _FIELDS, resilience_stats
+
+    resilience_stats.reset()
+    path = metrics.get_registry().dump(str(tmp_path / "m.prom"))
+    text = open(path).read()
+    lines = text.splitlines()
+    for field in _FIELDS:
+        name = f"mythril_tpu_resilience_{field}"
+        assert sum(1 for l in lines if l.startswith(name + " ")) == 1, name
+    for field, value in dispatch_stats.__dict__.items():
+        if isinstance(value, (int, float, bool)):
+            name = f"mythril_tpu_dispatch_{field}"
+            assert sum(
+                1 for l in lines if l.startswith(name + " ")
+            ) == 1, name
+    for field in async_stats.as_dict():
+        name = f"mythril_tpu_async_{field}"
+        assert sum(1 for l in lines if l.startswith(name + " ")) == 1, name
+    assert "mythril_tpu_trace_span_events" in text
+
+
+def test_telemetry_shim_is_the_single_source_of_truth():
+    """resilience_stats attribute traffic lands in registry counters;
+    bench rows (DispatchStats.as_dict) and the Prometheus dump read the
+    SAME cell — counted exactly once end-to-end."""
+    from mythril_tpu.ops.batched_sat import DispatchStats
+    from mythril_tpu.resilience.telemetry import resilience_stats
+
+    resilience_stats.reset()
+    resilience_stats.watchdog_trips += 3
+    resilience_stats.checkpoint_s += 0.25
+    registry = metrics.get_registry()
+    assert registry.counter(
+        "mythril_tpu_resilience_watchdog_trips"
+    ).value == 3
+    row = DispatchStats().as_dict()
+    assert row["watchdog_trips"] == 3
+    assert row["checkpoint_s"] == 0.25
+    text = registry.render()
+    assert sum(
+        1 for l in text.splitlines()
+        if l.startswith("mythril_tpu_resilience_watchdog_trips ")
+    ) == 1
+    # the DispatchStats mirror must NOT re-emit the resilience fields
+    assert "mythril_tpu_dispatch_watchdog_trips" not in text
+    # restore path (checkpoint resume) still works through the shim
+    assert hasattr(resilience_stats, "watchdog_trips")
+    assert not hasattr(resilience_stats, "not_a_counter")
+    resilience_stats.watchdog_trips = 11
+    assert resilience_stats.as_dict()["watchdog_trips"] == 11
+
+
+def test_faults_fired_counted_exactly_once_end_to_end():
+    """An injected dispatch fault walks the real retry rung; the
+    faults_fired / dispatch_retries counters land in the registry once
+    each, with instant events on the timeline."""
+    from mythril_tpu.resilience import faults, watchdog
+    from mythril_tpu.resilience.telemetry import resilience_stats
+
+    faults.reset_for_tests()
+    watchdog.reset_for_tests()
+    spans.get_tracer().enable()
+    resilience_stats.reset()
+    faults.get_fault_plane().arm("dispatch_error", times=1)
+
+    def thunk():
+        faults.maybe_fault_dispatch()
+        return 42
+
+    try:
+        result = watchdog.get_watchdog().run_attempts(
+            "obs-test", thunk, retries=2
+        )
+    finally:
+        faults.reset_for_tests()
+        watchdog.reset_for_tests()
+    assert result == 42
+    assert resilience_stats.faults_fired == 1
+    assert resilience_stats.dispatch_retries == 1
+    text = metrics.get_registry().render()
+    assert sum(
+        1 for l in text.splitlines()
+        if l.startswith("mythril_tpu_resilience_faults_fired ")
+    ) == 1
+    fired = [e for e in spans.get_tracer().events()
+             if e["name"] == "fault.fired"]
+    assert fired and fired[0]["args"]["point"] == "dispatch_error"
+
+
+# -- flight recorder --------------------------------------------------------
+
+
+def test_flight_ring_is_bounded_and_dumps_perfetto_json(tmp_path):
+    tracer = spans.get_tracer()
+    tracer.enable()
+    recorder = flight.get_flight_recorder()
+    recorder.configure(str(tmp_path))
+    for i in range(1000):
+        with spans.span("ring.filler", i=i):
+            pass
+    assert len(recorder) <= 512 + 1
+    path = recorder.dump("unit_test")
+    assert path and os.path.dirname(path) == str(tmp_path)
+    payload = json.load(open(path))
+    assert payload["otherData"]["reason"] == "unit_test"
+    names = {e["name"] for e in payload["traceEvents"]}
+    assert names == {"ring.filler"}
+    # the ring holds the most RECENT events
+    last = payload["traceEvents"][-1]
+    assert last["args"]["i"] == 999
+    assert recorder.dumps_written == 1
+
+
+def test_flight_dump_is_noop_when_nothing_buffered(tmp_path):
+    recorder = flight.get_flight_recorder()
+    recorder.configure(str(tmp_path))
+    assert recorder.dump("nothing") is None
+    assert os.listdir(str(tmp_path)) == []
+
+
+def test_flight_dump_on_watchdog_trip_via_fault_injection(
+    tmp_path, monkeypatch
+):
+    """A MYTHRIL_TPU_FAULT dispatch hang trips the watchdog deadline;
+    the trip must dump the flight ring (with the spans leading up to
+    it) and mark the timeline."""
+    monkeypatch.setenv("MYTHRIL_TPU_FAULT", "dispatch_hang")
+    monkeypatch.setenv("MYTHRIL_TPU_FAULT_HANG_S", "0.6")
+    monkeypatch.setenv("MYTHRIL_TPU_DISPATCH_TIMEOUT", "0.1")
+    from mythril_tpu.resilience import faults, watchdog
+
+    faults.reset_for_tests()  # re-read the env schedule
+    watchdog.reset_for_tests()
+    tracer = spans.get_tracer()
+    tracer.enable()
+    recorder = flight.get_flight_recorder()
+    recorder.configure(str(tmp_path))
+    with spans.span("pre.trip.context"):
+        pass
+
+    def thunk():
+        faults.maybe_fault_dispatch()
+        return 1
+
+    try:
+        with pytest.raises(watchdog.DispatchFailed):
+            watchdog.get_watchdog().run_attempts(
+                "obs-hang", thunk, retries=0
+            )
+    finally:
+        faults.reset_for_tests()
+        watchdog.reset_for_tests()
+    from mythril_tpu.resilience.telemetry import resilience_stats
+
+    assert resilience_stats.watchdog_trips >= 1
+    dumps = [n for n in os.listdir(str(tmp_path))
+             if "watchdog_trip" in n]
+    assert dumps, "no flight dump on watchdog trip"
+    payload = json.load(open(os.path.join(str(tmp_path), dumps[0])))
+    names = {e["name"] for e in payload["traceEvents"]}
+    assert "pre.trip.context" in names
+    trips = [e for e in tracer.events() if e["name"] == "watchdog.trip"]
+    assert trips and trips[0]["ph"] == "i"
+
+
+# -- CLI / report surface ---------------------------------------------------
+
+
+def test_report_meta_observability_section_is_stable():
+    from mythril_tpu.analysis.report import Report
+
+    payload = json.loads(Report().as_swc_standard_format())
+    section = payload[0]["meta"]["observability"]
+    assert set(section) == {
+        "enabled", "trace_out", "metrics_out", "span_events",
+        "instant_events", "dropped_events", "flight_dumps",
+    }
+    assert section["enabled"] is False
+    assert section["trace_out"] is None
+
+
+def test_cli_trace_and_metrics_artifacts(tmp_path):
+    """myth analyze --trace-out/--metrics-out writes a Perfetto-loadable
+    trace spanning the pipeline layers and a Prometheus dump carrying
+    the absorbed telemetry counters; the jsonv2 meta names both."""
+    trace_path = tmp_path / "t.json"
+    metrics_path = tmp_path / "m.prom"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, MYTH, "analyze", "-c", "0x6001600101",
+         "--bin-runtime", "-t", "1", "--no-onchain-data",
+         "--execution-timeout", "30", "-o", "jsonv2",
+         "--trace-out", str(trace_path),
+         "--metrics-out", str(metrics_path)],
+        capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(MYTH), env=env,
+    )
+    report = json.loads(proc.stdout)
+    section = report[0]["meta"]["observability"]
+    assert section["enabled"] is True
+    assert section["trace_out"] == str(trace_path)
+    assert section["span_events"] > 0
+
+    trace = json.load(open(trace_path))
+    names = {e["name"] for e in trace["traceEvents"]}
+    # the span tree covers the host pipeline layers (device layers
+    # need an accelerator; they are pinned by the unit tests above)
+    for expected in ("cli.analyze", "analyzer.contract",
+                     "svm.transaction", "svm.round", "batch.prune"):
+        assert expected in names, f"{expected} missing from {names}"
+
+    prom = open(metrics_path).read()
+    from mythril_tpu.resilience.telemetry import _FIELDS
+
+    for field in _FIELDS:
+        name = f"mythril_tpu_resilience_{field}"
+        assert sum(
+            1 for l in prom.splitlines() if l.startswith(name + " ")
+        ) == 1, name
+    assert "mythril_tpu_dispatch_dispatches" in prom
+    assert "mythril_tpu_trace_enabled 1" in prom
