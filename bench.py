@@ -722,6 +722,9 @@ def _scale_summary(row):
         # starts / cone memo)
         "h2d_bytes", "pool_uploads", "delta_uploads",
         "warm_start_hits", "cone_memo_hits",
+        # word-level reasoning tier (pre-blaster decisions + hints)
+        "word_decided_unsat", "word_decided_sat",
+        "word_tightened_bits", "word_prop_s",
     )
     out = {k: row[k] for k in keys if k in row}
     total = out.get("lane_sweeps_total", 0)
@@ -771,6 +774,12 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
         # bookkeeping this run (bench_compare gates regressions; 0.0
         # with tracing killed via MYTHRIL_TPU_TRACE=0)
         "trace_overhead_s": summary.get("trace_overhead_s", 0.0),
+        # word-level tier (gated by bench_compare with blast_s): time
+        # in the abstract-propagation kernels, and the corpus-wide
+        # bit-blasting seconds the tier exists to displace — blast_s
+        # creeping back up means queries are reaching CNF again
+        "word_prop_s": summary.get("word_prop_s", 0.0),
+        "blast_s": summary["solver_split"].get("blast_s", 0.0),
     }
     if "t3_wall_s" in summary:
         headline["t3_wall_s"] = summary["t3_wall_s"]
@@ -788,7 +797,8 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
     line = json.dumps(headline)
     if len(line) > 500:  # hard cap so the tail capture can never lose it
         for key in ("microbench_speedup", "microbench_device_warm_s",
-                    "mesh_row_ok", "trace_overhead_s", "sweep_util",
+                    "mesh_row_ok", "trace_overhead_s", "word_prop_s",
+                    "blast_s", "sweep_util",
                     "h2d_bytes", "device_sweeps",
                     "checkpoint_overhead_s", "t3_wall_s", "error",
                     "watchdog_trips", "demotions"):
@@ -976,6 +986,20 @@ def main() -> None:
         "cone_memo_hits": sum(
             r.get("cone_memo_hits", 0) for r in rows
         ),
+        # word-level reasoning tier: lanes decided without CNF, bits
+        # pinned for the blaster, and time spent in the propagation
+        # kernels (word_prop_s also rides the headline, gated by
+        # scripts/bench_compare.py alongside blast_s — the pair that
+        # shows the tier actually displacing bit-level work)
+        "word_decided_unsat": sum(
+            r.get("word_decided_unsat", 0) for r in rows
+        ),
+        "word_decided_sat": sum(
+            r.get("word_decided_sat", 0) for r in rows
+        ),
+        "word_tightened_bits": sum(
+            r.get("word_tightened_bits", 0) for r in rows
+        ),
         # degradation ladder telemetry (resilience/): a faulted or
         # flaky-device round is attributable from the artifact alone
         "watchdog_trips": sum(r.get("watchdog_trips", 0) for r in rows),
@@ -998,6 +1022,9 @@ def main() -> None:
         "resumes": sum(r.get("resumes", 0) for r in rows),
         "checkpoint_overhead_s": round(
             sum(r.get("checkpoint_s", 0.0) for r in rows), 3
+        ),
+        "word_prop_s": round(
+            sum(r.get("word_prop_s", 0.0) for r in rows), 3
         ),
         "solver_split": {
             k: round(sum(r[k] for r in rows), 2)

@@ -90,6 +90,21 @@ def _prune_infeasible(states: List) -> List:
     else:
         verdicts = [None] * len(undecided)
 
+    # word-level tier over whatever the batch pass left open (or the
+    # whole frontier when it never ran — narrow frontiers and drain
+    # rounds): one batched interval/known-bits pass decides the
+    # interval-UNSAT and constant-fold states before any per-state
+    # CDCL query is issued.  Memoized on the blast context, so lanes
+    # the batch path already consulted cost a dict hit here.
+    open_positions = [k for k, v in enumerate(verdicts) if v is None]
+    if open_positions:
+        try:
+            verdicts = _consult_word_tier(
+                undecided, verdicts, open_positions
+            )
+        except Exception as e:  # tier must never lose states
+            log.debug("word tier unavailable in prune: %s", e)
+
     for state, verdict in zip(undecided, verdicts):
         if verdict is True:
             feasible.append(state)
@@ -99,3 +114,47 @@ def _prune_infeasible(states: List) -> List:
             if state.world_state.constraints.is_possible:
                 feasible.append(state)
     return feasible
+
+
+def _consult_word_tier(undecided, verdicts, open_positions):
+    """Run the word tier over the open states' constraint sets and
+    fold sound verdicts into ``verdicts`` (True = feasible, False =
+    prune, None = leave to the CDCL tail)."""
+    from mythril_tpu.smt import terms as T
+    from mythril_tpu.smt.solver import get_blast_context
+    from mythril_tpu.smt.word_tier import get_word_tier, word_tier_enabled
+
+    if not word_tier_enabled():
+        return verdicts
+    ctx = get_blast_context()
+    node_sets = []
+    for k in open_positions:
+        nodes = []
+        falsy = False
+        for c in undecided[k].world_state.constraints:
+            if isinstance(c, bool):
+                if not c:
+                    falsy = True
+                    break
+                continue
+            node = c.raw if hasattr(c, "raw") else c
+            if node is T.FALSE:
+                falsy = True
+                break
+            if node is T.TRUE:
+                continue
+            nodes.append(node)
+        if falsy:
+            verdicts[k] = False
+            node_sets.append(None)
+        else:
+            node_sets.append(nodes)
+    word_verdicts, _hints, word_envs = get_word_tier().decide(
+        ctx, node_sets
+    )
+    for pos, k in enumerate(open_positions):
+        if verdicts[k] is None and word_verdicts[pos] is not None:
+            verdicts[k] = word_verdicts[pos]
+            if word_verdicts[pos] and word_envs[pos] is not None:
+                ctx._remember_model(word_envs[pos])
+    return verdicts

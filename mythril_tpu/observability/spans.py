@@ -57,8 +57,9 @@ PHASE_PREFIXES = (
     ("dispatch.round", "sweep"),
     ("pallas.round", "sweep"),
     ("cdcl.solve", "tail"),
+    ("word.", "word"),
 )
-PHASE_KEYS = ("cone", "upload", "sweep", "tail")
+PHASE_KEYS = ("cone", "upload", "sweep", "tail", "word")
 
 
 def _kill_switched() -> bool:

@@ -200,6 +200,16 @@ class DispatchStats:
         self.delta_uploads = 0
         self.warm_start_hits = 0
         self.cone_memo_hits = 0
+        # word-level reasoning tier (smt/word_tier.py; this PR): lanes
+        # decided UNSAT by empty abstractions / SAT by constant fold
+        # without ever building CNF, total variable bits pinned by the
+        # tier's known-bits propagation (each becomes a unit assumption
+        # literal for the blaster), and wall-clock spent in the
+        # abstract-propagation kernels (the `word.prop` span's sink)
+        self.word_decided_unsat = 0
+        self.word_decided_sat = 0
+        self.word_tightened_bits = 0
+        self.word_prop_s = 0.0
 
     def as_dict(self):
         from mythril_tpu.resilience.telemetry import resilience_stats
@@ -1436,13 +1446,16 @@ def reset_resident_pools() -> None:
     process re-interns nodes and re-blasts literals, so clause indices
     and literal numbering never match what an earlier pool upload (or
     memoized cone layout) described; serving them would be silently
-    unsound, not just stale."""
+    unsound, not just stale.  The word tier's programs and verdict
+    memos are keyed on interned node ids and die for the same reason."""
     from mythril_tpu.ops.incremental import reset_cone_memo
+    from mythril_tpu.smt.word_tier import reset_word_tier
 
     if _backend is not None:
         _backend.pool = DevicePool()
         _backend.pool_generation = -1
     reset_cone_memo()
+    reset_word_tier()
 
 
 def batch_check_states(constraint_sets) -> List[Optional[bool]]:
@@ -1529,6 +1542,34 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
                 decided[i] = True
                 dispatch_stats.host_probe_sat += 1
 
+    # word-level tier (smt/word_tier.py): batched interval + known-bits
+    # propagation over the whole open frontier — interval-UNSAT and
+    # constant-fold lanes retire HERE, before any CNF exists; surviving
+    # lanes keep their per-variable known bits, which become unit
+    # assumption literals below (smaller effective cones, free BCP)
+    word_hints: List[Optional[dict]] = [None] * len(node_sets)
+    from mythril_tpu.smt.word_tier import (
+        get_word_tier, hint_literals, word_tier_enabled,
+    )
+
+    if word_tier_enabled():
+        open_sets: List[Optional[List]] = [
+            nodes if decided[i] is None else None
+            for i, nodes in enumerate(node_sets)
+        ]
+        word_verdicts, word_hints, word_envs = get_word_tier().decide(
+            ctx, open_sets
+        )
+        for i, verdict in enumerate(word_verdicts):
+            if verdict is None or decided[i] is not None:
+                continue
+            decided[i] = verdict
+            if verdict and word_envs[i] is not None:
+                # a verified word-tier model serves sibling probes the
+                # same way a CDCL model would (no literal truth row, so
+                # it stays out of the warm-start channel)
+                ctx._remember_model(word_envs[i])
+
     proof_log = getattr(args, "proof_log", False)
     # --proof-log no longer disables the accelerator (VERDICT r4 #6):
     # device SAT lanes were always certificate-clean (the model is
@@ -1546,11 +1587,18 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
 
     # blast only the still-open lanes (probe-decided lanes must not grow
     # the clause pool, and an op outside the blaster's fragment should
-    # just leave its lane to the CDCL tail, not fail the batch)
+    # just leave its lane to the CDCL tail, not fail the batch).  Each
+    # lane's word-tier known bits ride along as unit assumption
+    # literals: they are implied by the lane's own constraints, so
+    # satisfiability is untouched, but the device DPLL starts with the
+    # pinned bits pre-assigned and the CDCL propagates them for free
     assumption_sets: List[Optional[List[int]]] = [None] * len(node_sets)
     for i in list(open_indices):
         try:
-            assumption_sets[i] = [ctx.blast_lit(n) for n in node_sets[i]]
+            lits = [ctx.blast_lit(n) for n in node_sets[i]]
+            if word_hints[i]:
+                lits.extend(hint_literals(ctx, word_hints[i]))
+            assumption_sets[i] = list(dict.fromkeys(lits))
         except NotImplementedError:
             decided[i] = None
             open_indices.remove(i)

@@ -109,12 +109,19 @@ class ConeMemo:
         self._table[key] = value
         return value
 
-    def cone(self, ctx, root_lits) -> tuple:
-        """Memoized ``ctx.pool.cone(root_lits)`` — the per-lane entry
-        point (sibling lanes across batches repeat root sets)."""
-        key = ("cone", tuple(sorted(root_lits)))
+    def cone(self, ctx, root_lits, known_bits=None) -> tuple:
+        """Memoized ``ctx.cone(root_lits, known_bits=...)`` — the
+        per-lane entry point (sibling lanes across batches repeat root
+        sets).  ``known_bits`` is the word tier's tightening lowered to
+        unit literals; it is part of the KEY (via its digest) as well
+        as the build, so a memoized untightened cone row can never be
+        served to a tightened query (or vice versa) — see
+        BlastContext.cone's contract."""
+        digest = tuple(sorted(known_bits)) if known_bits else ()
+        key = ("cone", tuple(sorted(root_lits)), digest)
         return self.get_or_build(
-            ctx, key, lambda: ctx.pool.cone(list(root_lits))
+            ctx, key,
+            lambda: ctx.cone(list(root_lits), known_bits=known_bits),
         )
 
     def reset(self) -> None:

@@ -355,17 +355,13 @@ def make_step():
         # --- shifts ---
         def h_shift(s, mask):
             def shift_fn(a, b):
-                # stack order: top = shift amount, second = value
-                amount = a[..., 0]
-                big = ~u256.is_zero(
-                    u256.bit_and(a, jnp.asarray(
-                        u256.from_int(((1 << 256) - 1) ^ 0xFFFFFFFF)
-                    ))
-                )
-                amount = jnp.where(big, 257, amount)
-                shifted_l = u256.shl(b, amount)
-                shifted_r = u256.lshr(b, amount)
-                shifted_a = u256.sar(b, amount)
+                # stack order: top = shift amount (a full 256-bit word,
+                # handled by the wide shifts — any nonzero high limb
+                # means >= 2^32 and shifts everything out), second =
+                # value
+                shifted_l = u256.shl_wide(b, a)
+                shifted_r = u256.lshr_wide(b, a)
+                shifted_a = u256.sar_wide(b, a)
                 return jnp.where(
                     (op == 0x1B)[:, None], shifted_l,
                     jnp.where((op == 0x1C)[:, None], shifted_r, shifted_a),

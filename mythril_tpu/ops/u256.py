@@ -13,6 +13,12 @@ jit/vmap-safe: carry chains are statically unrolled (8 or 16 steps), no
 data-dependent control flow.  64-bit integers are never used (TPU lanes
 are 32-bit; x64 emulation is global and slow), so multiplication works
 in 16-bit half-limbs whose column sums provably fit in uint32.
+
+Every kernel that does not need ``lax`` control flow takes an optional
+``xp`` namespace (default: jax.numpy).  The word-level abstract
+propagation tier (ops/word_prop.py) runs the SAME kernels over plain
+numpy for small host-side batches and over jax.numpy for the batched
+device path — one algorithm, two executors, no drift between them.
 """
 
 from typing import Tuple
@@ -28,6 +34,12 @@ def _jnp():
     import jax.numpy as jnp
 
     return jnp
+
+
+def _ns(xp):
+    """Resolve the array namespace: jax.numpy unless the caller passed
+    one explicitly (ops/word_prop.py passes plain numpy)."""
+    return _jnp() if xp is None else xp
 
 
 # ---------------------------------------------------------------------------
@@ -60,36 +72,46 @@ def to_int(limbs) -> int:
 # ---------------------------------------------------------------------------
 
 
-def add(a, b):
-    """(a + b) mod 2^256, elementwise over leading batch dims."""
-    jnp = _jnp()
+def add_carry(a, b, xp=None):
+    """((a + b) mod 2^256, carry_out) elementwise over leading batch
+    dims; carry_out is uint32 in {0, 1} (the 2^256 overflow bit)."""
+    xp = _ns(xp)
     out = []
-    carry = jnp.zeros(a.shape[:-1], dtype=jnp.uint32)
+    carry = xp.zeros(a.shape[:-1], dtype=xp.uint32)
     for i in range(NUM_LIMBS):
         s = a[..., i] + b[..., i]
-        c1 = (s < a[..., i]).astype(jnp.uint32)
+        c1 = (s < a[..., i]).astype(xp.uint32)
         s2 = s + carry
-        c2 = (s2 < s).astype(jnp.uint32)
+        c2 = (s2 < s).astype(xp.uint32)
         out.append(s2)
         carry = c1 | c2  # at most one of them fires
-    return jnp.stack(out, axis=-1)
+    return xp.stack(out, axis=-1), carry
 
 
-def bit_not(a):
-    jnp = _jnp()
-    return (~a).astype(jnp.uint32)
+def add(a, b, xp=None):
+    """(a + b) mod 2^256, elementwise over leading batch dims."""
+    return add_carry(a, b, xp)[0]
 
 
-def neg(a):
+def bit_not(a, xp=None):
+    xp = _ns(xp)
+    return (~a).astype(xp.uint32)
+
+
+def neg(a, xp=None):
     """two's complement negate mod 2^256"""
-    jnp = _jnp()
-    one = jnp.zeros_like(a).at[..., 0].set(1)
-    return add(bit_not(a), one)
+    xp = _ns(xp)
+    if xp is np:
+        one = np.zeros_like(a)
+        one[..., 0] = 1
+    else:
+        one = xp.zeros_like(a).at[..., 0].set(1)
+    return add(bit_not(a, xp), one, xp)
 
 
-def sub(a, b):
+def sub(a, b, xp=None):
     """(a - b) mod 2^256"""
-    return add(a, neg(b))
+    return add(a, neg(b, xp), xp)
 
 
 # ---------------------------------------------------------------------------
@@ -97,39 +119,41 @@ def sub(a, b):
 # ---------------------------------------------------------------------------
 
 
-def eq(a, b):
-    jnp = _jnp()
-    return jnp.all(a == b, axis=-1)
+def eq(a, b, xp=None):
+    xp = _ns(xp)
+    return xp.all(a == b, axis=-1)
 
 
-def is_zero(a):
-    jnp = _jnp()
-    return jnp.all(a == 0, axis=-1)
+def is_zero(a, xp=None):
+    xp = _ns(xp)
+    return xp.all(a == 0, axis=-1)
 
 
-def ult(a, b):
-    """unsigned a < b (lexicographic from the most significant limb)"""
-    jnp = _jnp()
-    result = jnp.zeros(a.shape[:-1], dtype=bool)
-    decided = jnp.zeros(a.shape[:-1], dtype=bool)
-    for i in range(NUM_LIMBS - 1, -1, -1):
-        lt = a[..., i] < b[..., i]
-        ne = a[..., i] != b[..., i]
-        result = jnp.where(~decided & ne, lt, result)
-        decided = decided | ne
-    return result
+def ult(a, b, xp=None):
+    """unsigned a < b: the verdict is the comparison at the most
+    significant differing limb (argmax over the reversed inequality
+    plane finds it in one vector pass — the unrolled 8-step compare
+    chain this replaces dominated the word-tier profile)."""
+    xp = _ns(xp)
+    ne = a != b
+    rev_ne = ne[..., ::-1]
+    idx = xp.argmax(rev_ne, axis=-1)  # first differing limb from MSB
+    top_lt = xp.take_along_axis(
+        (a < b)[..., ::-1], idx[..., None], axis=-1
+    )[..., 0]
+    return top_lt & xp.any(ne, axis=-1)
 
 
-def ule(a, b):
-    return ~ult(b, a)
+def ule(a, b, xp=None):
+    return ~ult(b, a, xp)
 
 
-def slt(a, b):
+def slt(a, b, xp=None):
     """signed a < b (two's complement)"""
-    jnp = _jnp()
+    xp = _ns(xp)
     sign_a = (a[..., -1] >> 31).astype(bool)
     sign_b = (b[..., -1] >> 31).astype(bool)
-    return jnp.where(sign_a == sign_b, ult(a, b), sign_a)
+    return xp.where(sign_a == sign_b, ult(a, b, xp), sign_a)
 
 
 # ---------------------------------------------------------------------------
@@ -151,87 +175,128 @@ def bit_xor(a, b):
 
 # ---------------------------------------------------------------------------
 # shifts (shift amount is a plain int32/uint32 array, not limbs —
-# amounts >= 256 yield 0 / sign-fill like the EVM)
+# amounts >= 256 yield 0 / sign-fill like the EVM.  The *_wide variants
+# below take the amount as a full 8-limb word, the form the EVM stack
+# actually holds: any nonzero high limb means >= 2^32, which the narrow
+# entry points cannot represent and callers used to hand-guard.)
 # ---------------------------------------------------------------------------
 
 
-def _limb_select(a, idx, fill):
+def _limb_select(a, idx, fill, xp=None):
     """a[..., idx] with out-of-range idx -> fill (idx may be negative)."""
-    jnp = _jnp()
+    xp = _ns(xp)
     valid = (idx >= 0) & (idx < NUM_LIMBS)
-    safe = jnp.clip(idx, 0, NUM_LIMBS - 1)
-    gathered = jnp.take_along_axis(
-        a, safe[..., None].astype(jnp.int32), axis=-1
+    safe = xp.clip(idx, 0, NUM_LIMBS - 1)
+    gathered = xp.take_along_axis(
+        a, safe[..., None].astype(xp.int32), axis=-1
     )[..., 0]
-    return jnp.where(valid, gathered, fill)
+    return xp.where(valid, gathered, fill)
 
 
-def shl(a, amount):
+def _norm_amount(amount, batch_shape, xp):
+    """Shift-amount hygiene shared by the three shifts: accept plain
+    Python ints / lists / any integer dtype (a bare int used to crash
+    on ``.astype``), broadcast scalars over the batch, clamp to 257
+    BEFORE the signed cast (uint32 amounts >= 2^31 must not wrap
+    negative and dodge the >= 256 overflow guard)."""
+    amount = xp.asarray(amount)
+    if amount.ndim == 0:
+        amount = xp.broadcast_to(amount, batch_shape)
+    return xp.minimum(amount.astype(xp.uint32), 257).astype(xp.int32)
+
+
+def shl(a, amount, xp=None):
     """a << amount mod 2^256; amount: uint32[...] (broadcast)"""
-    jnp = _jnp()
-    # clamp before the signed cast: uint32 amounts >= 2^31 must not
-    # wrap negative and dodge the >= 256 overflow guard
-    amount = jnp.minimum(amount.astype(jnp.uint32), 257).astype(jnp.int32)
+    xp = _ns(xp)
+    amount = _norm_amount(amount, a.shape[:-1], xp)
     word = amount // 32
-    bit = (amount % 32).astype(jnp.uint32)
-    zero = jnp.zeros(a.shape[:-1], dtype=jnp.uint32)
+    bit = (amount % 32).astype(xp.uint32)
+    zero = xp.zeros(a.shape[:-1], dtype=xp.uint32)
     out = []
     for i in range(NUM_LIMBS):
-        lo = _limb_select(a, i - word, zero)
-        hi = _limb_select(a, i - word - 1, zero)
+        lo = _limb_select(a, i - word, zero, xp)
+        hi = _limb_select(a, i - word - 1, zero, xp)
         # (lo << bit) | (hi >> (32 - bit)); bit==0 must not shift by 32
-        hi_part = jnp.where(
-            bit == 0, jnp.zeros_like(hi), hi >> (32 - bit)
+        hi_part = xp.where(
+            bit == 0, xp.zeros_like(hi), hi >> (32 - bit)
         )
-        out.append(((lo << bit) | hi_part).astype(jnp.uint32))
-    result = jnp.stack(out, axis=-1)
-    return jnp.where((amount >= 256)[..., None], 0, result)
+        out.append(((lo << bit) | hi_part).astype(xp.uint32))
+    result = xp.stack(out, axis=-1)
+    return xp.where((amount >= 256)[..., None], 0, result)
 
 
-def lshr(a, amount):
+def lshr(a, amount, xp=None):
     """logical a >> amount; amount: uint32[...]"""
-    jnp = _jnp()
-    # clamp before the signed cast: uint32 amounts >= 2^31 must not
-    # wrap negative and dodge the >= 256 overflow guard
-    amount = jnp.minimum(amount.astype(jnp.uint32), 257).astype(jnp.int32)
+    xp = _ns(xp)
+    amount = _norm_amount(amount, a.shape[:-1], xp)
     word = amount // 32
-    bit = (amount % 32).astype(jnp.uint32)
-    zero = jnp.zeros(a.shape[:-1], dtype=jnp.uint32)
+    bit = (amount % 32).astype(xp.uint32)
+    zero = xp.zeros(a.shape[:-1], dtype=xp.uint32)
     out = []
     for i in range(NUM_LIMBS):
-        lo = _limb_select(a, i + word, zero)
-        hi = _limb_select(a, i + word + 1, zero)
+        lo = _limb_select(a, i + word, zero, xp)
+        hi = _limb_select(a, i + word + 1, zero, xp)
         lo_part = lo >> bit
-        hi_part = jnp.where(
-            bit == 0, jnp.zeros_like(hi), hi << (32 - bit)
+        hi_part = xp.where(
+            bit == 0, xp.zeros_like(hi), hi << (32 - bit)
         )
-        out.append((lo_part | hi_part).astype(jnp.uint32))
-    result = jnp.stack(out, axis=-1)
-    return jnp.where((amount >= 256)[..., None], 0, result)
+        out.append((lo_part | hi_part).astype(xp.uint32))
+    result = xp.stack(out, axis=-1)
+    return xp.where((amount >= 256)[..., None], 0, result)
 
 
-def sar(a, amount):
+def sar(a, amount, xp=None):
     """arithmetic a >> amount (EVM SAR: fill with the sign bit)"""
-    jnp = _jnp()
-    sign = (a[..., -1] >> 31).astype(jnp.uint32)  # 0 or 1
-    fill_word = jnp.where(sign == 1, jnp.uint32(MASK32), jnp.uint32(0))
-    # clamp before the signed cast: uint32 amounts >= 2^31 must not
-    # wrap negative and dodge the >= 256 overflow guard
-    amount = jnp.minimum(amount.astype(jnp.uint32), 257).astype(jnp.int32)
+    xp = _ns(xp)
+    sign = (a[..., -1] >> 31).astype(xp.uint32)  # 0 or 1
+    fill_word = xp.where(sign == 1, xp.uint32(MASK32), xp.uint32(0))
+    amount = _norm_amount(amount, a.shape[:-1], xp)
     word = amount // 32
-    bit = (amount % 32).astype(jnp.uint32)
+    bit = (amount % 32).astype(xp.uint32)
     out = []
     for i in range(NUM_LIMBS):
-        lo = _limb_select(a, i + word, fill_word)
-        hi = _limb_select(a, i + word + 1, fill_word)
+        lo = _limb_select(a, i + word, fill_word, xp)
+        hi = _limb_select(a, i + word + 1, fill_word, xp)
         lo_part = lo >> bit
-        hi_part = jnp.where(
-            bit == 0, jnp.zeros_like(hi), hi << (32 - bit)
+        hi_part = xp.where(
+            bit == 0, xp.zeros_like(hi), hi << (32 - bit)
         )
-        out.append((lo_part | hi_part).astype(jnp.uint32))
-    result = jnp.stack(out, axis=-1)
-    overflow = jnp.broadcast_to(fill_word[..., None], result.shape)
-    return jnp.where((amount >= 256)[..., None], overflow, result)
+        out.append((lo_part | hi_part).astype(xp.uint32))
+    result = xp.stack(out, axis=-1)
+    overflow = xp.broadcast_to(fill_word[..., None], result.shape)
+    return xp.where((amount >= 256)[..., None], overflow, result)
+
+
+def _wide_amount(amount_limbs, xp):
+    """Collapse an 8-limb shift amount to a narrow one: any nonzero
+    high limb (or a low limb >= 256) means "shift everything out", for
+    which 257 is the canonical overflow representative the narrow
+    shifts already handle (>= 256 -> zero / sign fill)."""
+    high = xp.any(amount_limbs[..., 1:] != 0, axis=-1)
+    low = amount_limbs[..., 0]
+    return xp.where(high, xp.uint32(257), xp.minimum(low, xp.uint32(257)))
+
+
+def shl_wide(a, amount_limbs, xp=None):
+    """a << amount where the amount is itself a uint32[..., 8] word
+    (EVM SHL semantics: amounts >= 2^32 live in the high limbs and
+    must still zero the result — previously every caller had to guard
+    the high limbs by hand)."""
+    xp = _ns(xp)
+    return shl(a, _wide_amount(amount_limbs, xp), xp)
+
+
+def lshr_wide(a, amount_limbs, xp=None):
+    """logical a >> amount with an 8-limb amount (EVM SHR)."""
+    xp = _ns(xp)
+    return lshr(a, _wide_amount(amount_limbs, xp), xp)
+
+
+def sar_wide(a, amount_limbs, xp=None):
+    """arithmetic a >> amount with an 8-limb amount (EVM SAR: huge
+    amounts collapse to the sign fill)."""
+    xp = _ns(xp)
+    return sar(a, _wide_amount(amount_limbs, xp), xp)
 
 
 # ---------------------------------------------------------------------------
@@ -330,14 +395,14 @@ def exp(a, e):
 # ---------------------------------------------------------------------------
 
 
-def mul(a, b):
+def mul(a, b, xp=None):
     """(a * b) mod 2^256.
 
     Half-limb schoolbook: 16x16-bit products split into lo/hi 16-bit
     halves before column accumulation, so every column sum is bounded by
     32 * (2^16 - 1) < 2^21 — no uint32 overflow, no 64-bit ops.
     """
-    jnp = _jnp()
+    jnp = _ns(xp)
     H = 16  # half-limbs per word
 
     ah = []

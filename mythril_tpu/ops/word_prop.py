@@ -1,0 +1,799 @@
+"""Batched limb-plane abstract domains for the word-level solver tier.
+
+Every 256-bit (or narrower) term value is abstracted by TWO domains at
+once, both stored as 8x32-bit little-endian limb planes (the exact
+layout ops/u256.py uses for concrete lockstep words):
+
+- an unsigned **interval** ``[lo, hi]`` — each bound is a
+  ``uint32[..., 8]`` plane broadcast over the lane batch;
+- **known bits** ``(km, kv)`` — ``km`` has a 1 where the bit's value is
+  the same in every feasible assignment, and ``kv`` holds those values
+  (``kv & ~km == 0`` is an invariant).
+
+Widths below 256 embed in the low bits: every bit at or above the
+width is known-zero and ``hi <= 2^width - 1``, so one plane shape
+serves every EVM sort.  All kernels broadcast over a leading lane axis
+and take the ``xp`` array namespace (numpy for the small host batches
+the CDCL tail issues, jax.numpy for the batched device pass over a
+whole dispatch frontier — same algorithm either way, mirroring the
+``xp``-threaded kernels in ops/u256.py that these extend).
+
+Soundness contract: every transfer function OVER-approximates — the
+result abstraction contains every value the concrete op can produce
+from values in the input abstractions.  An empty abstraction
+(``lo > hi`` after cross-refinement, or conflicting known bits) is
+therefore a proof that no concrete assignment exists; smt/word_tier.py
+turns that into UNSAT verdicts without ever building CNF.  PolySAT
+(arxiv 2406.04696) and Bitwuzla (arxiv 2006.01621) use the same pair
+of domains for their word-level reasoning.
+"""
+
+from typing import Tuple
+
+import numpy as np
+
+from mythril_tpu.ops import u256
+from mythril_tpu.ops.u256 import MASK32, NUM_LIMBS
+
+#: AbstractWord = (lo, hi, km, kv), each uint32[..., NUM_LIMBS]
+Word = Tuple
+
+
+# ---------------------------------------------------------------------------
+# constructors
+# ---------------------------------------------------------------------------
+
+
+def ones_plane(batch_shape, xp=np):
+    return xp.full(tuple(batch_shape) + (NUM_LIMBS,), MASK32, dtype=xp.uint32)
+
+
+def zeros_plane(batch_shape, xp=np):
+    return xp.zeros(tuple(batch_shape) + (NUM_LIMBS,), dtype=xp.uint32)
+
+
+def width_mask(width: int, batch_shape, xp=np):
+    """2^width - 1 as a limb plane (width in [0, 256])."""
+    return xp.asarray(
+        u256.from_int((1 << width) - 1, tuple(batch_shape)), dtype=xp.uint32
+    )
+
+
+def top(width: int, batch_shape, xp=np) -> Word:
+    """No information beyond the width bound."""
+    wm = width_mask(width, batch_shape, xp)
+    return (zeros_plane(batch_shape, xp), wm, u256.bit_not(wm, xp),
+            zeros_plane(batch_shape, xp))
+
+
+def const_word(value: int, width: int, batch_shape, xp=np) -> Word:
+    v = xp.asarray(
+        u256.from_int(value & ((1 << width) - 1), tuple(batch_shape)),
+        dtype=xp.uint32,
+    )
+    return (v, v, ones_plane(batch_shape, xp), v)
+
+
+def to_ints(word: Word, lane) -> Tuple[int, int, int, int]:
+    """One lane's (lo, hi, km, kv) as Python ints (host decisions)."""
+    lo, hi, km, kv = word
+    return (u256.to_int(np.asarray(lo[lane])), u256.to_int(np.asarray(hi[lane])),
+            u256.to_int(np.asarray(km[lane])), u256.to_int(np.asarray(kv[lane])))
+
+
+# ---------------------------------------------------------------------------
+# limb-plane bit machinery
+# ---------------------------------------------------------------------------
+
+
+def any_bit(x, xp=np):
+    """[...] bool: any bit set in the plane."""
+    return xp.any(x != 0, axis=-1)
+
+
+def get_bit(x, index: int, xp=np):
+    """Static bit ``index`` of each plane -> bool[...]"""
+    return ((x[..., index // 32] >> np.uint32(index % 32)) & 1) != 0
+
+
+def umin(a, b, xp=np):
+    return xp.where(u256.ult(a, b, xp)[..., None], a, b)
+
+
+def umax(a, b, xp=np):
+    return xp.where(u256.ult(a, b, xp)[..., None], b, a)
+
+
+def smear_down(x, xp=np):
+    """Propagate every set bit into all lower positions (the 256-bit
+    'fill below the MSB' primitive behind prefix-mask extraction) —
+    limb-local shift-or cascade plus a cross-limb cumulative fill, so
+    the whole plane smears in ~12 vector ops instead of 8 full-word
+    shifts."""
+    for shift in (1, 2, 4, 8, 16):
+        x = x | (x >> np.uint32(shift))
+    # limbs strictly below any nonzero higher limb become all-ones
+    nz = (x != 0).astype(xp.int32)
+    rev = nz[..., ::-1]
+    cum = xp.cumsum(rev, axis=-1)
+    above = ((cum - rev) > 0)[..., ::-1]
+    return xp.where(above, xp.uint32(MASK32), x)
+
+
+def prefix_mask(x, xp=np):
+    """Mask of the bits strictly above the most significant set bit of
+    ``x`` (all-ones when x == 0): the bit positions where two interval
+    endpoints still agree."""
+    return u256.bit_not(smear_down(x, xp), xp)
+
+
+def trailing_known_mask(km, xp=np):
+    """Mask of the contiguous known bits starting at bit 0 (the region
+    where carry chains are fully determined, so add/sub/mul results
+    are exactly known)."""
+    full = np.uint32(MASK32)
+    limb_trail = km & ~(km + np.uint32(1))  # per-limb trailing-ones mask
+    nf = (km != full).astype(xp.int32)
+    cum = xp.cumsum(nf, axis=-1)
+    lower_all_full = (cum - nf) == 0  # every lower limb is all-ones
+    return xp.where(lower_all_full, limb_trail, xp.uint32(0))
+
+
+_POP_M1 = np.uint32(0x55555555)
+_POP_M2 = np.uint32(0x33333333)
+_POP_M4 = np.uint32(0x0F0F0F0F)
+
+
+def popcount(x, xp=np):
+    """int32[...] population count of the whole 256-bit plane."""
+    v = x
+    v = v - ((v >> np.uint32(1)) & _POP_M1)
+    v = (v & _POP_M2) + ((v >> np.uint32(2)) & _POP_M2)
+    v = (v + (v >> np.uint32(4))) & _POP_M4
+    per_limb = (v * np.uint32(0x01010101)) >> np.uint32(24)
+    return xp.sum(per_limb.astype(xp.int32), axis=-1)
+
+
+def bit_length(x, xp=np):
+    """int32[...]: position of the MSB + 1 (0 for x == 0)."""
+    return popcount(smear_down(x, xp), xp)
+
+
+# ---------------------------------------------------------------------------
+# refinement / meet
+# ---------------------------------------------------------------------------
+
+
+def refine(lo, hi, km, kv, wm, xp=np):
+    """Cross-refine interval <-> known bits and detect emptiness.
+
+    - known bits bound the interval: the least member is ``kv``
+      (unknowns 0) and the greatest is ``kv | (~km & wm)``;
+    - the interval grants known bits: every value in ``[lo, hi]``
+      shares the common binary prefix of the two endpoints.
+
+    Returns ``((lo, hi, km, kv), empty)`` where ``empty`` flags lanes
+    whose abstraction admits no value at all.
+    """
+    kv = kv & km  # invariant guard
+    minv = kv
+    maxv = kv | (u256.bit_not(km, xp) & wm)
+    lo = umax(lo, minv, xp)
+    hi = umin(hi, maxv, xp)
+    agree = prefix_mask(lo ^ hi, xp)
+    # a prefix bit the endpoints share but km already knows differently
+    # means no value fits both sources
+    conflict = any_bit(km & agree & (kv ^ (lo & agree)), xp)
+    km = km | agree
+    kv = (kv | (lo & agree)) & km
+    empty = u256.ult(hi, lo, xp) | conflict
+    return (lo, hi, km, kv), empty
+
+
+def meet(a: Word, b: Word, wm, xp=np):
+    """Greatest lower bound of two abstractions of the SAME value
+    (assert both).  Returns ``(word, empty)``."""
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    conflict = any_bit(km_a & km_b & (kv_a ^ kv_b), xp)
+    word, empty = refine(
+        umax(lo_a, lo_b, xp), umin(hi_a, hi_b, xp),
+        km_a | km_b, (kv_a | kv_b) & (km_a | km_b), wm, xp,
+    )
+    return word, empty | conflict
+
+
+def join(a: Word, b: Word, wm, xp=np):
+    """Least upper bound (either value possible — the ite merge)."""
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    km = km_a & km_b & u256.bit_not(kv_a ^ kv_b, xp)
+    return (umin(lo_a, lo_b, xp), umax(hi_a, hi_b, xp), km, kv_a & km)
+
+
+def select_word(mask, a: Word, b: Word, xp=np):
+    """Per-lane select: ``a`` where mask else ``b`` (mask is [...])."""
+    m = mask[..., None]
+    return tuple(xp.where(m, x, y) for x, y in zip(a, b))
+
+
+# ---------------------------------------------------------------------------
+# forward transfer functions (all return an UNREFINED word + empty via
+# the closing refine() so callers get one uniform contract)
+# ---------------------------------------------------------------------------
+
+
+def f_add(a: Word, b: Word, width: int, wm, xp=np):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    s_lo, c_lo = u256.add_carry(lo_a, lo_b, xp)
+    s_hi, c_hi = u256.add_carry(hi_a, hi_b, xp)
+    if width == 256:
+        w_lo, w_hi = c_lo != 0, c_hi != 0
+    else:
+        # operands < 2^width, width < 256: the wrap bit is bit `width`
+        w_lo, w_hi = get_bit(s_lo, width, xp), get_bit(s_hi, width, xp)
+    same = (w_lo == w_hi)[..., None]
+    lo = xp.where(same, s_lo & wm, xp.uint32(0))
+    hi = xp.where(same, s_hi & wm, wm)
+    tm = trailing_known_mask(km_a, xp) & trailing_known_mask(km_b, xp) & wm
+    km = tm | u256.bit_not(wm, xp)
+    kv = u256.add(kv_a, kv_b, xp) & tm
+    return refine(lo, hi, km, kv, wm, xp)
+
+
+def f_sub(a: Word, b: Word, width: int, wm, xp=np):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    # extremes of a - b: [lo_a - hi_b, hi_a - lo_b]; a borrow on both
+    # or neither keeps the order after masking (2^width | 2^256)
+    b_lo = u256.ult(lo_a, hi_b, xp)
+    b_hi = u256.ult(hi_a, lo_b, xp)
+    same = (b_lo == b_hi)[..., None]
+    lo = xp.where(same, u256.sub(lo_a, hi_b, xp) & wm, xp.uint32(0))
+    hi = xp.where(same, u256.sub(hi_a, lo_b, xp) & wm, wm)
+    tm = trailing_known_mask(km_a, xp) & trailing_known_mask(km_b, xp) & wm
+    km = tm | u256.bit_not(wm, xp)
+    kv = u256.sub(kv_a, kv_b, xp) & tm
+    return refine(lo, hi, km, kv, wm, xp)
+
+
+def f_mul(a: Word, b: Word, width: int, wm, xp=np):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    # x*y mod 2^t depends only on x, y mod 2^t: the common trailing
+    # known region of both operands is exactly known in the product
+    tm = trailing_known_mask(km_a, xp) & trailing_known_mask(km_b, xp) & wm
+    km = tm | u256.bit_not(wm, xp)
+    kv = u256.mul(kv_a, kv_b, xp) & tm
+    # interval only when the product provably fits the width
+    fits = (bit_length(hi_a, xp) + bit_length(hi_b, xp)) <= width
+    fits = fits[..., None]
+    lo = xp.where(fits, u256.mul(lo_a, lo_b, xp), xp.uint32(0))
+    hi = xp.where(fits, u256.mul(hi_a, hi_b, xp), wm)
+    return refine(lo, hi, km, kv, wm, xp)
+
+
+def f_and(a: Word, b: Word, wm, xp=np):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    not_a = u256.bit_not(kv_a, xp)
+    not_b = u256.bit_not(kv_b, xp)
+    k0 = (km_a & not_a) | (km_b & not_b)
+    k1 = (km_a & kv_a) & (km_b & kv_b)
+    hi = umin(hi_a, hi_b, xp)  # a & b <= min(a, b)
+    return refine(zeros_plane(lo_a.shape[:-1], xp), hi, k0 | k1, k1, wm, xp)
+
+
+def f_or(a: Word, b: Word, wm, xp=np):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    k1 = (km_a & kv_a) | (km_b & kv_b)
+    k0 = (km_a & u256.bit_not(kv_a, xp)) & (km_b & u256.bit_not(kv_b, xp))
+    lo = umax(lo_a, lo_b, xp)  # a | b >= max(a, b)
+    return refine(lo, wm, k0 | k1, k1, wm, xp)
+
+
+def f_xor(a: Word, b: Word, wm, xp=np):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    km = km_a & km_b
+    kv = (kv_a ^ kv_b) & km
+    return refine(zeros_plane(lo_a.shape[:-1], xp), wm, km, kv, wm, xp)
+
+
+def f_not(a: Word, width: int, wm, xp=np):
+    lo_a, hi_a, km_a, kv_a = a
+    # (~a) & wm == wm - a: exact and monotone-decreasing
+    lo = u256.sub(wm, hi_a, xp)
+    hi = u256.sub(wm, lo_a, xp)
+    km = (km_a & wm) | u256.bit_not(wm, xp)
+    kv = u256.bit_not(kv_a, xp) & km_a & wm
+    return refine(lo, hi, km, kv, wm, xp)
+
+
+def _known_amount(b: Word, xp):
+    """(amount_known[...], small_amount int32[...]) from the shift
+    operand's abstraction: a singleton interval pins the amount; any
+    nonzero high limb collapses to the 257 overflow representative."""
+    lo_b, hi_b, _km, _kv = b
+    known = u256.eq(lo_b, hi_b, xp)
+    high = xp.any(lo_b[..., 1:] != 0, axis=-1)
+    small = xp.where(
+        high, xp.uint32(257), xp.minimum(lo_b[..., 0], xp.uint32(257))
+    ).astype(xp.int32)
+    return known, small
+
+
+def f_shl(a: Word, b: Word, width: int, wm, xp=np):
+    lo_a, hi_a, km_a, kv_a = a
+    known, amt = _known_amount(b, xp)
+    shifted_ones = u256.shl(ones_plane(lo_a.shape[:-1], xp), amt, xp)
+    km_s = (u256.shl(km_a, amt, xp) | u256.bit_not(shifted_ones, xp))
+    kv_s = u256.shl(kv_a, amt, xp)
+    km = xp.where(known[..., None], km_s & wm, xp.uint32(0))
+    km = km | u256.bit_not(wm, xp)
+    kv = xp.where(known[..., None], kv_s, xp.uint32(0)) & km & wm
+    fits = known & ((bit_length(hi_a, xp) + amt) <= width)
+    lo = xp.where(fits[..., None], u256.shl(lo_a, amt, xp), xp.uint32(0))
+    hi = xp.where(fits[..., None], u256.shl(hi_a, amt, xp), wm)
+    return refine(lo, hi, km, kv, wm, xp)
+
+
+def f_lshr(a: Word, b: Word, width: int, wm, xp=np):
+    lo_a, hi_a, km_a, kv_a = a
+    known, amt = _known_amount(b, xp)
+    shifted_ones = u256.lshr(ones_plane(lo_a.shape[:-1], xp), amt, xp)
+    km_s = u256.lshr(km_a, amt, xp) | u256.bit_not(shifted_ones, xp)
+    kv_s = u256.lshr(kv_a, amt, xp)
+    km = xp.where(known[..., None], km_s, xp.uint32(0)) | u256.bit_not(wm, xp)
+    kv = xp.where(known[..., None], kv_s, xp.uint32(0)) & km & wm
+    # right shift never increases the value: [lshr(lo), lshr(hi)] holds
+    # for a known amount, and [0, hi_a] otherwise
+    lo = xp.where(known[..., None], u256.lshr(lo_a, amt, xp), xp.uint32(0))
+    hi = xp.where(known[..., None], u256.lshr(hi_a, amt, xp), hi_a)
+    return refine(lo, hi, km, kv, wm, xp)
+
+
+def f_ashr(a: Word, b: Word, width: int, wm, xp=np):
+    """terms.ashr: arithmetic shift with the amount clamped to
+    width - 1.  Decided exactly when the sign bit is known-zero (then
+    it IS lshr); other shapes fall to top — the EVM's SAR traffic is
+    overwhelmingly sign-known (sign-extended loads)."""
+    lo_a, hi_a, km_a, kv_a = a
+    sign_known0 = get_bit(km_a, width - 1, xp) & ~get_bit(kv_a, width - 1, xp)
+    shifted, empty = f_lshr(a, b, width, wm, xp)
+    t = top(width, lo_a.shape[:-1], xp)
+    word = select_word(sign_known0, shifted, t, xp)
+    return word, empty & sign_known0
+
+
+def f_extract(a: Word, high: int, low: int, wm_new, xp=np):
+    lo_a, hi_a, km_a, kv_a = a
+    km = (u256.lshr(km_a, low, xp) & wm_new) | u256.bit_not(wm_new, xp)
+    kv = u256.lshr(kv_a, low, xp) & wm_new & km
+    # the interval shifts down exactly when no feasible value has bits
+    # above `high` (truncation would fold the range otherwise)
+    batch = lo_a.shape[:-1]
+    keep = u256.ule(hi_a, width_mask(high + 1, batch, xp), xp)[..., None]
+    lo = xp.where(keep, u256.lshr(lo_a, low, xp), xp.uint32(0))
+    hi = xp.where(keep, u256.lshr(hi_a, low, xp), wm_new)
+    return refine(lo, hi, km, kv, wm_new, xp)
+
+
+def f_sext(a: Word, old_width: int, new_width: int, wm_new, xp=np):
+    lo_a, hi_a, km_a, kv_a = a
+    batch = lo_a.shape[:-1]
+    hmask = width_mask(new_width, batch, xp) & u256.bit_not(
+        width_mask(old_width, batch, xp), xp
+    )
+    sign_known = get_bit(km_a, old_width - 1, xp)
+    sign_val = get_bit(kv_a, old_width - 1, xp)
+    wm_old = width_mask(old_width, batch, xp)
+    # negative branch: v -> v | hmask (monotone on the all-negative set)
+    neg = ((km_a | hmask), ((kv_a & wm_old) | hmask),
+           (lo_a | hmask), (hi_a | hmask))
+    pos = (km_a | hmask, kv_a & wm_old, lo_a, hi_a)
+    unk = ((km_a & wm_old) | u256.bit_not(wm_new, xp), kv_a & wm_old,
+           zeros_plane(batch, xp), wm_new)
+    pick_neg = (sign_known & sign_val)[..., None]
+    pick_pos = (sign_known & ~sign_val)[..., None]
+    km = xp.where(pick_neg, neg[0], xp.where(pick_pos, pos[0], unk[0]))
+    kv = xp.where(pick_neg, neg[1], xp.where(pick_pos, pos[1], unk[1]))
+    lo = xp.where(pick_neg, neg[2], xp.where(pick_pos, pos[2], unk[2]))
+    hi = xp.where(pick_neg, neg[3], xp.where(pick_pos, pos[3], unk[3]))
+    return refine(lo, hi, km & wm_new | u256.bit_not(wm_new, xp),
+                  kv & wm_new, wm_new, xp)
+
+
+def f_concat(parts, offsets, widths, total_width: int, wm, xp=np):
+    """parts occupy disjoint bit ranges [off, off + w): ORs of shifted
+    planes are exact for the bits, and (since ranges are disjoint, no
+    carries) valid for the bounds too."""
+    batch = parts[0][0].shape[:-1]
+    lo = zeros_plane(batch, xp)
+    hi = zeros_plane(batch, xp)
+    km = u256.bit_not(wm, xp)
+    kv = zeros_plane(batch, xp)
+    for (p_lo, p_hi, p_km, p_kv), off, w in zip(parts, offsets, widths):
+        pwm = width_mask(w, batch, xp)
+        lo = lo | u256.shl(p_lo, off, xp)
+        hi = hi | u256.shl(p_hi, off, xp)
+        km = km | u256.shl(p_km & pwm, off, xp)
+        kv = kv | u256.shl(p_kv & pwm, off, xp)
+    return refine(lo, hi, km, kv, wm, xp)
+
+
+# ---------------------------------------------------------------------------
+# predicates -> tri-state int8[...] (+1 must-true, -1 must-false, 0 open)
+# ---------------------------------------------------------------------------
+
+
+def p_eq(a: Word, b: Word, xp=np):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    single = (u256.eq(lo_a, hi_a, xp) & u256.eq(lo_b, hi_b, xp)
+              & u256.eq(lo_a, lo_b, xp))
+    apart = (u256.ult(hi_a, lo_b, xp) | u256.ult(hi_b, lo_a, xp)
+             | any_bit(km_a & km_b & (kv_a ^ kv_b), xp))
+    return xp.where(single, 1, xp.where(apart, -1, 0)).astype(xp.int8)
+
+
+def p_ult(a: Word, b: Word, xp=np):
+    lo_a, hi_a, _, _ = a
+    lo_b, hi_b, _, _ = b
+    must = u256.ult(hi_a, lo_b, xp)
+    never = u256.ule(hi_b, lo_a, xp)
+    return xp.where(must, 1, xp.where(never, -1, 0)).astype(xp.int8)
+
+
+def p_ule(a: Word, b: Word, xp=np):
+    lo_a, hi_a, _, _ = a
+    lo_b, hi_b, _, _ = b
+    must = u256.ule(hi_a, lo_b, xp)
+    never = u256.ult(hi_b, lo_a, xp)
+    return xp.where(must, 1, xp.where(never, -1, 0)).astype(xp.int8)
+
+
+def _signs(a: Word, width: int, xp):
+    _, _, km, kv = a
+    return get_bit(km, width - 1, xp), get_bit(kv, width - 1, xp)
+
+
+def p_slt(a: Word, b: Word, width: int, xp=np):
+    ka, sa = _signs(a, width, xp)
+    kb, sb = _signs(b, width, xp)
+    both = ka & kb
+    unsigned = p_ult(a, b, xp)
+    # same sign: two's-complement order == unsigned order; mixed signs:
+    # the negative side is smaller
+    out = xp.where(
+        both & (sa & ~sb), 1,
+        xp.where(both & (~sa & sb), -1,
+                 xp.where(both, unsigned, 0)),
+    )
+    return out.astype(xp.int8)
+
+
+def p_sle(a: Word, b: Word, width: int, xp=np):
+    ka, sa = _signs(a, width, xp)
+    kb, sb = _signs(b, width, xp)
+    both = ka & kb
+    unsigned = p_ule(a, b, xp)
+    out = xp.where(
+        both & (sa & ~sb), 1,
+        xp.where(both & (~sa & sb), -1,
+                 xp.where(both, unsigned, 0)),
+    )
+    return out.astype(xp.int8)
+
+
+# ---------------------------------------------------------------------------
+# backward (assertion) refinements
+# ---------------------------------------------------------------------------
+
+
+def b_ult_true(a: Word, b: Word, wm, xp=np, strict: bool = True):
+    """Assert a < b (or a <= b with strict=False): shrink a's upper
+    bound to b's reach and raise b's floor past a's.  Returns
+    ``(a', b', empty)``."""
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    batch = lo_a.shape[:-1]
+    one = xp.asarray(u256.from_int(1, tuple(batch)), dtype=xp.uint32)
+    if strict:
+        # a < b needs b >= 1 and a <= wm - 1
+        dead = u256.is_zero(hi_b, xp) | u256.eq(lo_a, wm, xp)
+        new_hi_a = umin(hi_a, u256.sub(hi_b, one, xp), xp)
+        new_lo_b = umax(lo_b, u256.add(lo_a, one, xp), xp)
+    else:
+        dead = xp.zeros(tuple(batch), dtype=bool)
+        new_hi_a = umin(hi_a, hi_b, xp)
+        new_lo_b = umax(lo_b, lo_a, xp)
+    a2, empty_a = refine(lo_a, new_hi_a, km_a, kv_a, wm, xp)
+    b2, empty_b = refine(new_lo_b, hi_b, km_b, kv_b, wm, xp)
+    return a2, b2, dead | empty_a | empty_b
+
+
+# ---------------------------------------------------------------------------
+# scalar reference implementation (Python bigints, one lane at a time)
+#
+# The limb-plane kernels above are the batched device path; these are
+# the SAME transfer functions over plain integers.  Two consumers:
+#
+# - smt/word_tier.py's host executor: the CDCL tail issues one small
+#   query at a time, where a handful of int ops beat a few thousand
+#   tiny array dispatches by ~3 orders of magnitude (measured 68 ms ->
+#   sub-ms per fresh query batch);
+# - tests/test_word_tier.py's parity oracle: every batched kernel is
+#   differential-tested against its scalar twin, so the two executors
+#   cannot drift.
+#
+# Scalar words are (lo, hi, km, kv) Python ints; wm = 2^width - 1.
+# ---------------------------------------------------------------------------
+
+FULL = (1 << 256) - 1
+
+
+def s_top(wm: int):
+    return (0, wm, FULL ^ wm, 0)
+
+
+def s_const(value: int, wm: int):
+    v = value & wm
+    return (v, v, FULL, v)
+
+
+def s_trailing_known(km: int) -> int:
+    """Mask of the contiguous known bits from bit 0 (256-bit view)."""
+    return (((km + 1) & ~km) - 1) & FULL
+
+
+def s_refine(lo, hi, km, kv, wm):
+    """Scalar twin of :func:`refine`."""
+    kv &= km
+    lo = max(lo, kv)
+    hi = min(hi, kv | (~km & wm))
+    x = lo ^ hi
+    pm = FULL ^ ((1 << x.bit_length()) - 1)
+    conflict = bool(km & pm & (kv ^ (lo & pm)))
+    km |= pm
+    kv = (kv | (lo & pm)) & km
+    return (lo, hi, km, kv), hi < lo or conflict
+
+
+def s_meet(a, b, wm):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    conflict = bool(km_a & km_b & (kv_a ^ kv_b))
+    word, empty = s_refine(
+        max(lo_a, lo_b), min(hi_a, hi_b),
+        km_a | km_b, (kv_a | kv_b) & (km_a | km_b), wm,
+    )
+    return word, empty or conflict
+
+
+def s_join(a, b):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    km = km_a & km_b & ~(kv_a ^ kv_b) & FULL
+    return (min(lo_a, lo_b), max(hi_a, hi_b), km, kv_a & km)
+
+
+def s_add(a, b, width, wm):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    s_lo, s_hi = lo_a + lo_b, hi_a + hi_b
+    if (s_lo > wm) == (s_hi > wm):
+        lo, hi = s_lo & wm, s_hi & wm
+    else:
+        lo, hi = 0, wm
+    tm = s_trailing_known(km_a) & s_trailing_known(km_b) & wm
+    km = tm | (FULL ^ wm)
+    kv = (kv_a + kv_b) & tm
+    return s_refine(lo, hi, km, kv, wm)
+
+
+def s_sub(a, b, width, wm):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    if (lo_a < hi_b) == (hi_a < lo_b):
+        lo, hi = (lo_a - hi_b) & wm, (hi_a - lo_b) & wm
+    else:
+        lo, hi = 0, wm
+    tm = s_trailing_known(km_a) & s_trailing_known(km_b) & wm
+    km = tm | (FULL ^ wm)
+    kv = (kv_a - kv_b) & tm
+    return s_refine(lo, hi, km, kv, wm)
+
+
+def s_mul(a, b, width, wm):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    tm = s_trailing_known(km_a) & s_trailing_known(km_b) & wm
+    km = tm | (FULL ^ wm)
+    kv = (kv_a * kv_b) & tm
+    if hi_a.bit_length() + hi_b.bit_length() <= width:
+        lo, hi = lo_a * lo_b, hi_a * hi_b
+    else:
+        lo, hi = 0, wm
+    return s_refine(lo, hi, km, kv, wm)
+
+
+def s_and(a, b, wm):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    k0 = (km_a & ~kv_a) | (km_b & ~kv_b)
+    k1 = km_a & kv_a & km_b & kv_b
+    return s_refine(0, min(hi_a, hi_b), (k0 | k1) & FULL, k1, wm)
+
+
+def s_or(a, b, wm):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    k1 = (km_a & kv_a) | (km_b & kv_b)
+    k0 = km_a & ~kv_a & km_b & ~kv_b
+    return s_refine(max(lo_a, lo_b), wm, (k0 | k1) & FULL, k1, wm)
+
+
+def s_xor(a, b, wm):
+    _lo_a, _hi_a, km_a, kv_a = a
+    _lo_b, _hi_b, km_b, kv_b = b
+    km = km_a & km_b
+    return s_refine(0, wm, km, (kv_a ^ kv_b) & km, wm)
+
+
+def s_not(a, width, wm):
+    lo_a, hi_a, km_a, kv_a = a
+    km = (km_a & wm) | (FULL ^ wm)
+    kv = ~kv_a & km_a & wm
+    return s_refine(wm - hi_a, wm - lo_a, km, kv, wm)
+
+
+def s_shl(a, b, width, wm):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, _km_b, _kv_b = b
+    if lo_b != hi_b:
+        return s_refine(0, wm, FULL ^ wm, 0, wm)
+    amt = min(lo_b, 257)
+    km = ((km_a << amt) | ((1 << amt) - 1)) & wm | (FULL ^ wm)
+    kv = (kv_a << amt) & km & wm
+    if hi_a.bit_length() + amt <= width:
+        lo, hi = lo_a << amt, hi_a << amt
+    else:
+        lo, hi = 0, wm
+    return s_refine(lo, hi, km, kv, wm)
+
+
+def s_lshr(a, b, width, wm):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, _km_b, _kv_b = b
+    if lo_b != hi_b:
+        return s_refine(0, hi_a, FULL ^ wm, 0, wm)
+    amt = min(lo_b, 257)
+    shifted_in = FULL ^ (FULL >> amt)  # bits vacated by the shift
+    km = ((km_a >> amt) | shifted_in) & FULL | (FULL ^ wm)
+    kv = (kv_a >> amt) & km & wm
+    return s_refine(lo_a >> amt, hi_a >> amt, km, kv, wm)
+
+
+def s_ashr(a, b, width, wm):
+    lo_a, hi_a, km_a, kv_a = a
+    sign_bit = 1 << (width - 1)
+    if (km_a & sign_bit) and not (kv_a & sign_bit):
+        return s_lshr(a, b, width, wm)
+    return s_refine(0, wm, FULL ^ wm, 0, wm)
+
+
+def s_extract(a, high, low, wm_new):
+    lo_a, hi_a, km_a, kv_a = a
+    km = ((km_a >> low) & wm_new) | (FULL ^ wm_new)
+    kv = (kv_a >> low) & wm_new & km
+    if hi_a <= (1 << (high + 1)) - 1:
+        lo, hi = lo_a >> low, hi_a >> low
+    else:
+        lo, hi = 0, wm_new
+    return s_refine(lo, hi, km, kv, wm_new)
+
+
+def s_sext(a, old_width, new_width, wm_new):
+    lo_a, hi_a, km_a, kv_a = a
+    wm_old = (1 << old_width) - 1
+    hmask = wm_new ^ wm_old
+    sign_bit = 1 << (old_width - 1)
+    if km_a & sign_bit:
+        if kv_a & sign_bit:
+            return s_refine(lo_a | hmask, hi_a | hmask,
+                            km_a | hmask, (kv_a & wm_old) | hmask, wm_new)
+        return s_refine(lo_a, hi_a, km_a | hmask, kv_a & wm_old, wm_new)
+    return s_refine(0, wm_new, (km_a & wm_old) | (FULL ^ wm_new),
+                    kv_a & wm_old, wm_new)
+
+
+def s_concat(parts, offsets, widths, wm):
+    lo = hi = kv = 0
+    km = FULL ^ wm
+    for (p_lo, p_hi, p_km, p_kv), off, w in zip(parts, offsets, widths):
+        pwm = (1 << w) - 1
+        lo |= p_lo << off
+        hi |= p_hi << off
+        km |= (p_km & pwm) << off
+        kv |= (p_kv & pwm) << off
+    return s_refine(lo, hi, km, kv, wm)
+
+
+def s_ite(cond_tri, a, b):
+    if cond_tri == 1:
+        return a
+    if cond_tri == -1:
+        return b
+    return s_join(a, b)
+
+
+def s_p_eq(a, b):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    if lo_a == hi_a == lo_b == hi_b:
+        return 1
+    if hi_a < lo_b or hi_b < lo_a or (km_a & km_b & (kv_a ^ kv_b)):
+        return -1
+    return 0
+
+
+def s_p_ult(a, b):
+    if a[1] < b[0]:
+        return 1
+    if b[1] <= a[0]:
+        return -1
+    return 0
+
+
+def s_p_ule(a, b):
+    if a[1] <= b[0]:
+        return 1
+    if b[1] < a[0]:
+        return -1
+    return 0
+
+
+def _s_sign(a, width):
+    _lo, _hi, km, kv = a
+    sign_bit = 1 << (width - 1)
+    if km & sign_bit:
+        return bool(kv & sign_bit)
+    return None
+
+
+def s_p_slt(a, b, width):
+    sa, sb = _s_sign(a, width), _s_sign(b, width)
+    if sa is None or sb is None:
+        return 0
+    if sa != sb:
+        return 1 if sa else -1
+    return s_p_ult(a, b)
+
+
+def s_p_sle(a, b, width):
+    sa, sb = _s_sign(a, width), _s_sign(b, width)
+    if sa is None or sb is None:
+        return 0
+    if sa != sb:
+        return 1 if sa else -1
+    return s_p_ule(a, b)
+
+
+def s_b_ult_true(a, b, wm, strict=True):
+    """Scalar twin of :func:`b_ult_true`; returns (a', b', empty)."""
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, km_b, kv_b = b
+    if strict:
+        if hi_b == 0 or lo_a == wm:
+            return a, b, True
+        new_hi_a = min(hi_a, hi_b - 1)
+        new_lo_b = max(lo_b, lo_a + 1)
+    else:
+        new_hi_a = min(hi_a, hi_b)
+        new_lo_b = max(lo_b, lo_a)
+    a2, empty_a = s_refine(lo_a, new_hi_a, km_a, kv_a, wm)
+    b2, empty_b = s_refine(new_lo_b, hi_b, km_b, kv_b, wm)
+    return a2, b2, empty_a or empty_b

@@ -217,9 +217,19 @@ class BlastContext:
         CDCL database in the same native call that records them in the
         pool's CSR store."""
 
-    def cone(self, root_lits: Sequence[int], need_clauses: bool = True):
+    def cone(self, root_lits: Sequence[int], need_clauses: bool = True,
+             known_bits: Optional[Sequence[int]] = None):
         """(clause_indices, vars) of the defining cone of ``root_lits``,
         both sorted numpy int64 arrays.
+
+        ``known_bits`` is the word tier's per-variable tightening
+        lowered to unit literals (smt/word_tier.hint_literals): they
+        join the root set, so the walked cone covers the pinned
+        variables and the constant bits become unit assumptions in the
+        dispatched rows.  Callers that memoize cone results MUST key
+        on the tightening digest as well as the roots
+        (ops/incremental.ConeMemo does) — a cached untightened row
+        served to a tightened query would silently drop the units.
 
         Walks defining clauses backward from the roots (natively, with a
         per-root memo): every variable's semantics (the gates computing
@@ -232,6 +242,10 @@ class BlastContext:
         clause *subset* — still sound for UNSAT, at worst weaker at
         propagation.  Device-learned nogoods covered by the cone's var
         set are appended per call."""
+        if known_bits:
+            root_lits = list(dict.fromkeys(
+                list(root_lits) + list(known_bits)
+            ))
         return self.pool.cone(root_lits, need_clauses)
 
     def absorb_learnts(self, max_width: int = 8) -> int:
@@ -673,9 +687,36 @@ class BlastContext:
                 env = self.probe_with_memo(nodes)
             if env is not None:
                 return SatSolver.SAT, env
+        # word-level tier: interval + known-bits propagation decides
+        # interval-UNSAT / constant-fold queries without building CNF,
+        # and hands the blaster per-variable known bits for the rest
+        # (smt/word_tier.py; MYTHRIL_TPU_WORD_TIER=0 restores the
+        # probe->blast->cone->CDCL funnel exactly)
+        from mythril_tpu.smt.word_tier import (
+            get_word_tier, hint_literals, word_tier_enabled,
+        )
+
+        word_hints = None
+        if word_tier_enabled():
+            word_verdicts, hint_rows, word_envs = get_word_tier().decide(
+                self, [nodes]
+            )
+            if word_verdicts[0] is False:
+                return SatSolver.UNSAT, None  # tier already memoized it
+            if word_verdicts[0] is True:
+                env = word_envs[0] if word_envs[0] is not None else T.EvalEnv()
+                self._remember_model(env)
+                return SatSolver.SAT, env
+            word_hints = hint_rows[0]
         with obs.span("solver.blast", sink=(stats, "blast_s"),
                       cat="solver"):
             assumptions = [self.blast_lit(c) for c in nodes]
+            if word_hints:
+                # implied unit literals: pinned bits propagate for free
+                # in the CDCL instead of being rediscovered by search
+                assumptions = list(dict.fromkeys(
+                    assumptions + hint_literals(self, word_hints)
+                ))
         # restrict CDCL decisions to the query's cone: against a large
         # shared pool, VSIDS otherwise wanders into foreign gates and
         # pays full-pool propagation per irrelevant decision

@@ -32,9 +32,15 @@ import sys
 #: (cold-started lanes / full re-uploads creeping back in);
 #: trace_overhead_s gates the observability plane's self-cost (span
 #: bookkeeping creeping onto hot paths shows up here before it is
-#: visible in t3_wall_s)
+#: visible in t3_wall_s);
+#: blast_s / word_prop_s gate the word-level tier: blast_s regressing
+#: means feasibility queries are reaching the bit-blaster again
+#: (the tier stopped deciding/tightening), and word_prop_s regressing
+#: means the abstract-propagation pass itself got expensive — either
+#: failure mode shows up here before it moves t3_wall_s
 GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
-         "device_sweeps", "h2d_bytes", "trace_overhead_s")
+         "device_sweeps", "h2d_bytes", "trace_overhead_s",
+         "blast_s", "word_prop_s")
 #: floor below which a baseline is noise and ratios are meaningless
 MIN_BASE = 0.05
 

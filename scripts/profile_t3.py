@@ -79,6 +79,21 @@ def main() -> None:
             totals.items(), key=lambda kv: -kv[1]
         )[:12]
     }
+    # word-tier share: how much of this run's wall went to the
+    # abstract-propagation pass, and how many queries it retired
+    # before the blaster (row already carries word_decided_unsat/
+    # word_decided_sat/word_tightened_bits via DispatchStats)
+    word_s = sum(
+        seconds for name, seconds in totals.items()
+        if name.startswith("word.")
+    )
+    row["word_span_s"] = round(word_s, 3)
+    row["word_span_share"] = round(
+        word_s / row["total_wall_s"], 4
+    ) if row["total_wall_s"] else 0.0
+    row["word_decided_lanes"] = (
+        row.get("word_decided_unsat", 0) + row.get("word_decided_sat", 0)
+    )
 
     from mythril_tpu.smt.solver import get_blast_context
 

@@ -33,6 +33,9 @@ def fresh(monkeypatch):
     SolverStatistics().reset()
     # reach the device path on the CPU jax backend (tests only)
     monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
+    # these tests pin the prefetch/dispatch plane BELOW the word tier:
+    # hold the tier off so the synthetic lanes actually reach it
+    monkeypatch.setenv("MYTHRIL_TPU_WORD_TIER", "0")
     yield
     get_async_dispatcher().drop()
     reset_blast_context()

@@ -431,6 +431,9 @@ def test_non_lane_failure_still_escalates_to_demotion(monkeypatch):
 
     base_found_unused = None  # frontier-level: no findings oracle here
     monkeypatch.setenv("MYTHRIL_TPU_DISPATCH_BACKOFF_S", "0.01")
+    # the escalation needs every lane to reach the (faulted) device:
+    # hold the word tier off so none retire pre-dispatch
+    monkeypatch.setenv("MYTHRIL_TPU_WORD_TIER", "0")
     reset_blast_context()
     dispatch_stats.reset()
     faults.get_fault_plane().arm("dispatch_error", times=999)

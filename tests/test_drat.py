@@ -219,6 +219,9 @@ def test_device_dispatch_stays_on_under_proof_log(monkeypatch):
     from mythril_tpu.support.support_args import args
 
     monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
+    # device refutations are the subject: hold the word tier off so
+    # the UNSAT lanes are not decided before they reach the device
+    monkeypatch.setenv("MYTHRIL_TPU_WORD_TIER", "0")
     monkeypatch.setattr(args, "proof_log", True)
     monkeypatch.setattr(args, "device_min_lanes", 2)
     monkeypatch.setattr(args, "device_force_dispatch", True)
@@ -263,6 +266,9 @@ def test_async_harvest_confirms_refutations_under_proof_log(monkeypatch):
     from mythril_tpu.support.support_args import args
 
     monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
+    # harvested device refutations are the subject: hold the word tier
+    # off so the UNSAT lanes survive to the prefetch channel
+    monkeypatch.setenv("MYTHRIL_TPU_WORD_TIER", "0")
     monkeypatch.setattr(args, "proof_log", True)
     monkeypatch.setattr(args, "device_min_lanes", 2)
     monkeypatch.setattr(args, "device_force_dispatch", False)

@@ -206,6 +206,7 @@ def test_model_channel_tagging_and_warm_phase_vector(monkeypatch):
     from mythril_tpu.support.support_args import args
 
     monkeypatch.setattr(args, "word_probing", False)  # force CDCL
+    monkeypatch.setenv("MYTHRIL_TPU_WORD_TIER", "0")  # past the word tier
     ctx, lits = _ctx_with_clauses(2)
     x = T.var("x0", 8)
     status, env = ctx.check([T.eq(x, T.const(3, 8))])
@@ -222,6 +223,7 @@ def test_warm_pref_row_kill_switch_and_remap(monkeypatch):
     from mythril_tpu.support.support_args import args
 
     monkeypatch.setattr(args, "word_probing", False)  # force CDCL
+    monkeypatch.setenv("MYTHRIL_TPU_WORD_TIER", "0")  # past the word tier
     ctx, lits = _ctx_with_clauses(1)
     ctx.check([T.eq(T.var("x0", 8), T.const(3, 8))])
     row = warm_pref_row(ctx, ctx.solver.num_vars + 1, lanes=4)

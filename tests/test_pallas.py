@@ -23,6 +23,9 @@ from mythril_tpu.smt.solver import get_blast_context, reset_blast_context
 @pytest.fixture(autouse=True)
 def fresh_context(monkeypatch):
     monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "force")
+    # these tests pin the dense-kernel dispatch plane BELOW the word
+    # tier: hold the tier off so the synthetic lanes actually reach it
+    monkeypatch.setenv("MYTHRIL_TPU_WORD_TIER", "0")
     reset_blast_context()
     yield
     reset_blast_context()

@@ -126,6 +126,120 @@ def test_shifts(name, fn, ref):
         assert u256.to_int(out[k]) == ref(v, sh), (name, hex(v), sh)
 
 
+def _ref_shl(x, s):
+    return (x << s) & M256 if s < 256 else 0
+
+
+def _ref_lshr(x, s):
+    return x >> s if s < 256 else 0
+
+
+def _ref_sar(x, s):
+    signed = x - (1 << 256) if x >> 255 else x
+    return (signed >> min(s, 255)) & M256
+
+
+@pytest.mark.parametrize(
+    "fn,ref",
+    [(u256.shl, _ref_shl), (u256.lshr, _ref_lshr), (u256.sar, _ref_sar)],
+)
+def test_shift_property_random_amounts(fn, ref):
+    """Property sweep vs Python bigint semantics: random values against
+    every amount class — in-limb, cross-limb, non-multiple-of-32,
+    boundary (255/256/257), and far past the width."""
+    rng = random.Random(11)
+    values = [0, 1, M256, 1 << 255] + [
+        rng.getrandbits(256) for _ in range(12)
+    ]
+    amounts = sorted(
+        {rng.randrange(0, 600) for _ in range(40)}
+        | {0, 1, 31, 32, 33, 224, 255, 256, 257, 511}
+    )
+    cases = [(v, s) for v in values for s in amounts]
+    a = np.stack([u256.from_int(v) for v, _ in cases])
+    s = np.asarray([s for _, s in cases], dtype=np.uint32)
+    out = np.asarray(fn(a, s))
+    for k, (v, sh) in enumerate(cases):
+        assert u256.to_int(out[k]) == ref(v, sh), (fn.__name__, hex(v), sh)
+
+
+@pytest.mark.parametrize(
+    "fn,ref",
+    [
+        (u256.shl_wide, _ref_shl),
+        (u256.lshr_wide, _ref_lshr),
+        (u256.sar_wide, _ref_sar),
+    ],
+)
+def test_wide_amount_shifts(fn, ref):
+    """EVM semantics: the shift amount is itself a 256-bit word — any
+    nonzero high limb (>= 2^32) must shift everything out, which the
+    narrow entry points cannot even represent."""
+    rng = random.Random(5)
+    values = [1, M256, 1 << 255, rng.getrandbits(256)]
+    amounts = [
+        0, 7, 33, 255, 256, 300,
+        1 << 32,          # low limb reads 0 — the classic wraparound trap
+        (1 << 64) + 3,    # low limb reads 3 but the real amount is huge
+        1 << 200,
+        M256,
+    ]
+    cases = [(v, s) for v in values for s in amounts]
+    a = np.stack([u256.from_int(v) for v, _ in cases])
+    s = np.stack([u256.from_int(s) for _, s in cases])
+    out = np.asarray(fn(a, s))
+    for k, (v, sh) in enumerate(cases):
+        assert u256.to_int(out[k]) == ref(v, sh), (fn.__name__, hex(v), sh)
+
+
+def test_shift_accepts_plain_int_amounts():
+    """A bare Python int amount used to crash on ``.astype``; the word
+    tier shifts by static extract offsets constantly."""
+    a = u256.from_int(0xDEAD << 64, (2,))
+    for fn, ref in ((u256.shl, _ref_shl), (u256.lshr, _ref_lshr),
+                    (u256.sar, _ref_sar)):
+        out = np.asarray(fn(a, 36))
+        assert u256.to_int(out[0]) == ref(0xDEAD << 64, 36)
+        out = np.asarray(fn(a, 300))
+        assert u256.to_int(out[0]) == ref(0xDEAD << 64, 300)
+
+
+def test_shifts_numpy_namespace_parity():
+    """The xp-threaded kernels produce identical results under plain
+    numpy (the word tier's host executor) and jax.numpy."""
+    rng = random.Random(17)
+    values = [rng.getrandbits(256) for _ in range(6)] + [0, M256]
+    amounts = [0, 1, 33, 224, 255, 256, 257, 300]
+    cases = [(v, s) for v in values for s in amounts]
+    a = np.stack([u256.from_int(v) for v, _ in cases])
+    s = np.asarray([s for _, s in cases], dtype=np.uint32)
+    for fn in (u256.shl, u256.lshr, u256.sar):
+        via_np = np.asarray(fn(a, s, xp=np))
+        via_jnp = np.asarray(fn(a, s))
+        np.testing.assert_array_equal(via_np, via_jnp)
+    b = np.stack([u256.from_int(rng.getrandbits(256)) for _ in cases])
+    for fn in (u256.add, u256.sub, u256.mul):
+        np.testing.assert_array_equal(
+            np.asarray(fn(a, b, xp=np)), np.asarray(fn(a, b))
+        )
+    np.testing.assert_array_equal(
+        np.asarray(u256.ult(a, b, xp=np)), np.asarray(u256.ult(a, b))
+    )
+
+
+def test_add_carry():
+    cases = [
+        (0, 0, 0), (M256, 1, 1), (M256, M256, 1),
+        (1 << 255, 1 << 255, 1), ((1 << 255) - 1, 1 << 255, 0),
+    ]
+    a = np.stack([u256.from_int(x) for x, _, _ in cases])
+    b = np.stack([u256.from_int(y) for _, y, _ in cases])
+    total, carry = u256.add_carry(a, b, xp=np)
+    for k, (x, y, c) in enumerate(cases):
+        assert u256.to_int(np.asarray(total)[k]) == (x + y) & M256
+        assert int(np.asarray(carry)[k]) == c
+
+
 def test_neg_is_zero():
     import jax
 
