@@ -704,6 +704,68 @@ def _solver_microbench():
     }
 
 
+def _serve_microbench(cold_cli_wall_s=None):
+    """Warm-server latency/throughput headline: an in-process
+    ``AnalysisServer`` (ephemeral port) analyzes killbilly once to warm
+    the request path, then 8 timed requests give the p50 end-to-end
+    latency and sustained contracts/min.  The point of `myth serve` in
+    two numbers: ``warm_p50_s`` must sit far below the cold CLI wall
+    for the same contract (``speedup_vs_cold_cli``), and both are gated
+    by scripts/bench_compare.py."""
+    import json as _json
+    import statistics
+    import urllib.request
+
+    from mythril_tpu.serve import AnalysisServer, ServeConfig
+
+    name, code, tx_count, _expected = _corpus()[0]  # killbilly
+    server = AnalysisServer(ServeConfig.from_env(port=0))
+    server.start()
+    try:
+        payload = _json.dumps({
+            "code": code, "name": name, "tx_count": tx_count,
+            "deadline_s": 240, "source": "bench",
+        }).encode()
+
+        def post():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/analyze", data=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            began = time.monotonic()
+            body = _json.loads(
+                urllib.request.urlopen(req, timeout=240).read()
+            )
+            return time.monotonic() - began, body
+
+        cold_s, body = post()
+        if not body["findings_swc"]:
+            return {"error": "warm-up request found nothing"}
+        latencies = []
+        began = time.monotonic()
+        for _ in range(8):
+            elapsed, body = post()
+            latencies.append(elapsed)
+        total = time.monotonic() - began
+        warm_p50 = statistics.median(latencies)
+        out = {
+            "requests": len(latencies),
+            "serve_cold_s": round(cold_s, 3),
+            "warm_p50_s": round(warm_p50, 4),
+            "warm_max_s": round(max(latencies), 4),
+            "contracts_per_min": round(60.0 * len(latencies) / total, 1),
+            "found": body["findings_swc"],
+        }
+        if cold_cli_wall_s:
+            out["cold_cli_wall_s"] = round(cold_cli_wall_s, 3)
+            out["speedup_vs_cold_cli"] = round(
+                cold_cli_wall_s / warm_p50, 1
+            ) if warm_p50 else None
+        return out
+    finally:
+        server.drain_and_stop("bench done")
+
+
 def _scale_summary(row):
     keys = (
         "wall_s", "dispatches", "lanes", "unsat", "sat_verified",
@@ -792,11 +854,18 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
     if isinstance(microbench, dict) and "device_warm_s" in microbench:
         headline["microbench_device_warm_s"] = microbench["device_warm_s"]
         headline["microbench_speedup"] = microbench.get("speedup")
+    if isinstance(summary.get("serve_warm_p50_s"), (int, float)):
+        # warm-server p50 + sustained throughput (the `myth serve`
+        # headline pair, gated by scripts/bench_compare.py — p50
+        # regressing up or contracts/min regressing down trips it)
+        headline["serve_warm_p50_s"] = summary["serve_warm_p50_s"]
+        headline["serve_cpm"] = summary.get("serve_cpm")
     if "error" in summary:
         headline["error"] = str(summary["error"])[:160]
     line = json.dumps(headline)
     if len(line) > 500:  # hard cap so the tail capture can never lose it
         for key in ("microbench_speedup", "microbench_device_warm_s",
+                    "serve_cpm", "serve_warm_p50_s",
                     "mesh_row_ok", "trace_overhead_s", "word_prop_s",
                     "blast_s", "sweep_util",
                     "h2d_bytes", "device_sweeps",
@@ -937,6 +1006,21 @@ def main() -> None:
         mesh_scale = _mesh_scale_row()
 
     wall, rows, missed = results[mode]
+    # warm-server headline: p50 latency + sustained contracts/min over
+    # a live in-process daemon, against the cold CLI wall the corpus
+    # pass just measured for the same contract (runs LAST so its
+    # engine-side telemetry resets cannot disturb the timed passes)
+    if quick:
+        serve_bench = {"skipped": "--quick run"}
+    else:
+        try:
+            serve_bench = _serve_microbench(cold_cli_wall_s=next(
+                (r["wall_s"] for r in rows
+                 if r["contract"] == "killbilly"), None,
+            ))
+        except Exception as exc:  # noqa: BLE001 — bench must not die here
+            serve_bench = {"error": str(exc)[:200]}
+    print(json.dumps({"serve_microbench": serve_bench}), file=sys.stderr)
     summary = {
         "metric": "analyze_corpus_wall_s",
         "value": round(wall, 2),
@@ -1067,6 +1151,10 @@ def main() -> None:
     )
     summary["solver_batch_microbench"] = microbench
     summary["scale_mesh_virtual"] = mesh_scale
+    summary["serve_microbench"] = serve_bench
+    if isinstance(serve_bench.get("warm_p50_s"), (int, float)):
+        summary["serve_warm_p50_s"] = serve_bench["warm_p50_s"]
+        summary["serve_cpm"] = serve_bench["contracts_per_min"]
     # headline sweep utilization: over the corpus pass AND the scale
     # scenarios (the corpus's narrow frontiers rarely dispatch, so the
     # scale rows are where the ratio carries signal)

@@ -36,6 +36,7 @@ COMMAND_LIST = (
     + DISASSEMBLE_LIST
     + (
         "pro",
+        "serve",
         "list-detectors",
         "read-storage",
         "leveldb-search",
@@ -374,6 +375,33 @@ def create_analyzer_parser(analyzer_parser: argparse.ArgumentParser) -> None:
     )
 
 
+def create_serve_parser(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--host",
+        default="127.0.0.1",
+        help="interface to bind (default 127.0.0.1)",
+    )
+    parser.add_argument(
+        "-p",
+        "--port",
+        type=int,
+        default=8551,
+        help="TCP port to listen on (0 = ephemeral)",
+    )
+    parser.add_argument(
+        "--trace-out",
+        help="Write the server's Perfetto span timeline here on drain "
+        "(every request gets a serve.request span tree)",
+        metavar="FILE",
+    )
+    parser.add_argument(
+        "--metrics-out",
+        help="Also dump the metrics registry to FILE on drain (the "
+        "live view is GET /metrics)",
+        metavar="FILE",
+    )
+
+
 def create_disassemble_parser(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
         "solidity_files",
@@ -490,6 +518,15 @@ def main() -> None:
         help="Returns the address for a contract code hash (LevelDB)",
     )
     create_hash_to_addr_parser(hash_to_addr_parser)
+    serve_parser = subparsers.add_parser(
+        "serve",
+        help="Run the persistent analysis daemon: bounded admission, "
+        "per-request deadline budgets, request isolation, live "
+        "/healthz /readyz /metrics (docs/serving.md)",
+        parents=[utilities_parser],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    create_serve_parser(serve_parser)
     subparsers.add_parser("version", parents=[output_parser], help="Outputs the version")
     pro_parser = subparsers.add_parser(
         "pro",
@@ -819,11 +856,15 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
             print(f"bad fault spec: {e}", file=sys.stderr)
             sys.exit(2)
 
-    if args.command in ANALYZE_LIST or args.command == "truffle":
+    if args.command in ANALYZE_LIST or args.command in (
+        "truffle", "serve",
+    ):
         # graceful drain: SIGTERM/SIGINT walk the cooperative
         # cancellation checkpoints, land a final journal generation,
         # and ship a partial report (meta.resilience.partial) instead
-        # of dying mid-dispatch
+        # of dying mid-dispatch; in serve mode the same flag drains the
+        # daemon (admission closes, in-flight request finishes,
+        # artifacts flush)
         from mythril_tpu.resilience.checkpoint import install_signal_handlers
 
         install_signal_handlers()
@@ -836,6 +877,22 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
             getattr(args, "trace_out", None),
             getattr(args, "metrics_out", None),
         )
+
+    if args.command == "serve":
+        # serve-plane knobs are env-validated at startup: a typo'd
+        # MYTHRIL_TPU_SERVE_* value must die loudly here (exit 2, the
+        # FaultSpecError contract), never as an un-shed overload later
+        from mythril_tpu.serve import ServeConfigError, run_server
+
+        try:
+            sys.exit(run_server(host=args.host, port=args.port))
+        except ServeConfigError as e:
+            print(f"bad serve config: {e}", file=sys.stderr)
+            sys.exit(2)
+        except OSError as e:
+            print(f"cannot bind {args.host}:{args.port}: {e}",
+                  file=sys.stderr)
+            sys.exit(1)
 
     if args.command == "function-to-hash":
         print(MythrilDisassembler.hash_for_function_signature(args.func_name))

@@ -105,12 +105,23 @@ def _prune_infeasible(states: List) -> List:
         except Exception as e:  # tier must never lose states
             log.debug("word tier unavailable in prune: %s", e)
 
+    from mythril_tpu.resilience.budget import budget_expired
+
     for state, verdict in zip(undecided, verdicts):
         if verdict is True:
             feasible.append(state)
         elif verdict is False:
             continue
         else:  # undecided by the batch pass: authoritative CDCL check
+            if budget_expired():
+                # per-REQUEST deadline only (never the signal drain,
+                # whose resume-parity contract needs the memo-backed
+                # tail): the budget is spent, so fresh CDCL solves
+                # would burn wall-clock the caller no longer has.
+                # Dropping an undecided state can only narrow the
+                # partial report's prefix, never invent a finding —
+                # and the report is already flagged partial
+                continue
             if state.world_state.constraints.is_possible:
                 feasible.append(state)
     return feasible

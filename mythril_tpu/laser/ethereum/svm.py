@@ -81,6 +81,10 @@ class LaserEVM:
         self.edges: List[Edge] = []
 
         self.executed_transactions = False
+        # transaction index a drain (signal or expired request budget)
+        # stopped at — the serve plane reports it per request; None
+        # when the run completed (or never reached a boundary)
+        self.aborted_at_tx: Optional[int] = None
 
         # hook registries
         self._add_world_state_hooks: List[Callable] = []
@@ -195,6 +199,12 @@ class LaserEVM:
             if len(self.open_states) == 0:
                 break
             if drain_requested():
+                # a drain — SIGTERM or an expired per-request budget —
+                # lands at this transaction's START boundary: the
+                # frontier below is exactly what a resume (or the
+                # serve plane's partial report) continues from
+                self.aborted_at_tx = i
+                obs.instant("svm.drain_boundary", cat="svm", tx=i)
                 break
             # Frontier pruning across transactions: the reference issues
             # one solver call per open state (svm.py:201-204); here the

@@ -939,7 +939,13 @@ class BatchedSatBackend:
             if drain_requested():
                 # cooperative drain checkpoint: abandon the remaining
                 # rounds — survivors retire undecided (the CDCL tail or
-                # the resumed run finishes them, findings unchanged)
+                # the resumed run finishes them, findings unchanged).
+                # Fires for a SIGTERM drain AND an expired per-request
+                # budget (serve deadlines reach this exact seam); the
+                # instant event puts the abandonment on the request's
+                # span timeline / flight dump
+                obs.instant("dispatch.drain", cat="sweep",
+                            lanes=int(live.size), bucket=B)
                 break
             state["step"][:] = 0  # per-round active-sweep counters
             step_fn = self._cached_round(V1 - 1, budget)

@@ -35,8 +35,27 @@ models the deferral produced.  Deferral windows also tend to batch
 pool growth: the merged dispatch sees one pool version instead of
 several, which is what keeps its cones memo-servable.
 
+Cross-REQUEST coalescing (the serve plane, docs/serving.md): the
+persistent daemon keeps one blast context warm across requests, so the
+admission window naturally spans them — the tail lanes of one small
+contract's last underfilled dispatch wait in the queue and merge into
+the *next request's* first dispatch.  Two serve-specific behaviors ride
+on :func:`set_serve_mode`:
+
+- per-request telemetry resets (``dispatch_stats.reset()``) keep the
+  queue and the dispatched count — clearing them per request would
+  re-arm the first-batch rule and silently disable the cross-request
+  window the daemon exists for (a hard reset still drops everything:
+  decontamination after a crashed request);
+- queued lanes are stamped with the admitting request's scope
+  (:func:`set_request_scope`), so an aborted request — deadline
+  expiry, executor crash — can be purged from the queue
+  (:func:`purge_scope`) instead of its dead lanes riding into a later
+  dispatch and wasting bucket slots.
+
 Env knobs: ``MYTHRIL_TPU_COALESCE`` (0 disables, overrides
-``args.device_coalesce``), ``MYTHRIL_TPU_COALESCE_WINDOW``,
+``args.device_coalesce``), ``MYTHRIL_TPU_COALESCE_WINDOW`` (default 2,
+or 4 in serve mode — a warm daemon can afford a longer window),
 ``MYTHRIL_TPU_COALESCE_FILL``.
 """
 
@@ -49,14 +68,54 @@ from typing import Dict, List, Optional
 log = logging.getLogger(__name__)
 
 COALESCE_WINDOW = 2       # max consecutive deferred admissions
+SERVE_WINDOW = 4          # serve mode: cross-request windows run longer
 COALESCE_MIN_FILL = 0.75  # dispatch once the merged bucket is this full
 COALESCE_QUEUE_CAP = 256  # queued lanes beyond this are not admitted
 COALESCE_MAX_AGE_S = 5.0  # a queue older than this stops deferring
 
 #: one deferred lane: dedupe key (sorted assumption lits), the literal
-#: set, the constraint nodes (for the UNSAT memo) and the original
-#: constraint objects (for model verification at merge time)
-QueuedLane = namedtuple("QueuedLane", "key lits nodes constraints")
+#: set, the constraint nodes (for the UNSAT memo), the original
+#: constraint objects (for model verification at merge time), and the
+#: admitting request's scope (serve mode; None for CLI runs)
+QueuedLane = namedtuple(
+    "QueuedLane", "key lits nodes constraints scope", defaults=(None,)
+)
+
+_serve_mode = False
+_request_scope = None
+
+
+def set_serve_mode(enabled: bool) -> None:
+    """Cross-request coalescing (the persistent daemon): per-request
+    stat resets preserve the admission queue, and the deferral window
+    defaults longer."""
+    global _serve_mode
+    _serve_mode = bool(enabled)
+
+
+def serve_mode() -> bool:
+    return _serve_mode
+
+
+def set_request_scope(scope) -> None:
+    """Stamp lanes queued from here on with ``scope`` (the serve
+    engine's request id) so :func:`purge_scope` can drop an aborted
+    request's lanes."""
+    global _request_scope
+    _request_scope = scope
+
+
+def purge_scope(scope) -> int:
+    """Drop every queued lane admitted under ``scope``; returns the
+    count (an aborted request's lanes must not ride into a later
+    request's dispatch)."""
+    if _coalescer is None or scope is None:
+        return 0
+    queue = _coalescer.queue
+    stale = [k for k, q in queue.items() if q.scope == scope]
+    for key in stale:
+        del queue[key]
+    return len(stale)
 
 
 def _enabled() -> bool:
@@ -71,12 +130,13 @@ def _enabled() -> bool:
 
 
 def _window() -> int:
+    default = SERVE_WINDOW if _serve_mode else COALESCE_WINDOW
     try:
         return max(0, int(os.environ.get(
-            "MYTHRIL_TPU_COALESCE_WINDOW", COALESCE_WINDOW
+            "MYTHRIL_TPU_COALESCE_WINDOW", default
         )))
     except ValueError:
-        return COALESCE_WINDOW
+        return default
 
 
 def _min_fill() -> float:
@@ -96,12 +156,18 @@ class LaneCoalescer:
     def __init__(self):
         self.reset()
 
-    def reset(self):
-        self.generation = -1
-        self.queue: Dict[tuple, QueuedLane] = {}
+    def reset(self, keep_queue: bool = False):
+        """Full reset, or — ``keep_queue`` (serve mode's per-request
+        telemetry reset) — one that preserves the admission queue and
+        the dispatched count so the cross-request window stays armed.
+        A generation move (``_sync``) always resets fully: queued
+        lanes reference nodes of the dead context."""
+        if not keep_queue:
+            self.generation = -1
+            self.queue: Dict[tuple, QueuedLane] = {}
+            self.dispatched = 0  # dispatches admitted this generation
+            self.oldest_s = 0.0  # when the oldest queued lane arrived
         self.deferrals = 0   # consecutive deferred admissions
-        self.dispatched = 0  # dispatches admitted this generation
-        self.oldest_s = 0.0  # when the oldest queued lane arrived
 
     def _sync(self, ctx):
         if self.generation != ctx.generation:
@@ -151,7 +217,9 @@ class LaneCoalescer:
                 keys, rep_sets, rep_nodes, rep_constraints
             ):
                 self.queue.setdefault(
-                    key, QueuedLane(key, list(lits), nodes, cons)
+                    key,
+                    QueuedLane(key, list(lits), nodes, cons,
+                               _request_scope),
                 )
             self.deferrals += 1
             dispatch_stats.coalesce_deferred += len(rep_sets)
@@ -191,6 +259,10 @@ def get_coalescer() -> LaneCoalescer:
     return _coalescer
 
 
-def reset_coalescer() -> None:
+def reset_coalescer(hard: bool = False) -> None:
+    """Reset the admission window.  In serve mode the default reset is
+    soft (queue + dispatched count survive — the cross-request window
+    is the daemon's point); ``hard`` forces the full drop either way
+    (decontamination after a crashed request, tests)."""
     if _coalescer is not None:
-        _coalescer.reset()
+        _coalescer.reset(keep_queue=_serve_mode and not hard)

@@ -1090,6 +1090,10 @@ def _run_dense_ladder(
         # undecided so a final checkpoint can be written
         raise_if_cancelled()
         if drain_requested():
+            # SIGTERM drain or an expired per-request budget (serve
+            # deadline) — stamp the abandonment on the span timeline
+            obs.instant("pallas.drain", cat="sweep",
+                        lanes=int(live.size), bucket=B)
             break
         faults.maybe_fault_dispatch()
         # int(out[-1]) blocks until the round finished, so the span

@@ -18,6 +18,10 @@ closes that gap:
   CRC-checked journal under ``--checkpoint-dir``, transaction-boundary
   frontier snapshots, periodic channel refresh, ``--resume``) plus the
   graceful-drain flag SIGTERM/SIGINT set and every long loop polls;
+- :mod:`budget` — per-request wall-clock deadline budgets (the serve
+  plane): an expired budget reads as a drain through the same
+  cooperative seam, so one request winds down at a transaction
+  boundary with a partial report while the process stays healthy;
 - :mod:`telemetry` — the counters (``watchdog_trips``,
   ``dispatch_retries``, ``demotions``, ``quarantined_lanes``,
   ``bisect_dispatches``, ``checkpoints_written``, ``resumes``,

@@ -357,7 +357,18 @@ _handlers_installed = False
 
 
 def drain_requested() -> bool:
-    return _drain_event.is_set()
+    """True when the run should wind down at the next cooperative
+    boundary: either the process-wide drain flag is set (SIGTERM /
+    SIGINT) or the current request's wall-clock budget has expired
+    (``resilience/budget.py`` — the serve plane's per-request deadline,
+    which clears between requests).  Both causes walk the exact same
+    boundaries: the svm loops, the dispatch gate, and the device round
+    ladders."""
+    if _drain_event.is_set():
+        return True
+    from mythril_tpu.resilience.budget import budget_expired
+
+    return budget_expired()
 
 
 def request_drain(reason: str = "signal") -> None:
@@ -371,6 +382,19 @@ def request_drain(reason: str = "signal") -> None:
 
         obs.instant("drain.requested", cat="resilience", reason=reason)
         obs_flight.get_flight_recorder().dump("drain")
+        # flush the --trace-out / --metrics-out artifacts NOW, not only
+        # at process exit: a drain that wedges (and eats the second,
+        # force-kill signal) or a consumer that never reaches the
+        # normal finalize path used to lose the whole timeline — the
+        # one artifact that explains the drain.  finalize_outputs is
+        # idempotent and never raises; the end-of-run flush simply
+        # rewrites the files with the complete timeline.
+        try:
+            from mythril_tpu.observability import finalize_outputs
+
+            finalize_outputs()
+        except Exception:  # noqa: BLE001 — flushing must not stall drain
+            log.debug("drain-time artifact flush failed", exc_info=True)
     _drain_event.set()
 
 
@@ -385,7 +409,11 @@ def install_signal_handlers() -> None:
         return
 
     def _on_signal(signum, frame):
-        if drain_requested():
+        # second-signal detection keys on the signal-driven flag ONLY:
+        # an expired per-request budget also makes drain_requested()
+        # true, and the first SIGTERM of a budget-expired run must
+        # still drain gracefully, not force-exit
+        if _drain_event.is_set():
             signal.signal(signum, signal.SIG_DFL)
             os.kill(os.getpid(), signum)
             return

@@ -33,6 +33,12 @@ Injection points:
                      lane-dependent kernel abort: one query's data
                      wedges the kernel while its siblings are fine) —
                      the poisoned-lane bisection's territory
+``serve_crash``      the analysis of a served request raises unhandled
+                     mid-execution (models a poisoned contract whose
+                     exploration crashes the executor) — the serve
+                     engine's request-isolation territory: that request
+                     fails with a flight dump, the pool is
+                     decontaminated, the server stays ready
 ==================  =====================================================
 
 Faults are armed either through the API (:meth:`FaultPlane.arm`) or the
@@ -84,6 +90,7 @@ FAULT_POINTS = (
     "rpc_error",
     "rpc_http_500",
     "lane_poison",
+    "serve_crash",
 )
 
 DEFAULT_HANG_S = 30.0
@@ -310,6 +317,16 @@ def maybe_fault_prefetch() -> None:
     """Async-prefetch seam (ops/async_dispatch.py worker)."""
     if get_fault_plane().fire("prefetch_error") is not None:
         raise FaultInjected("injected prefetch worker failure")
+
+
+def maybe_fault_request() -> None:
+    """Served-request seam (serve/engine.py, fired from inside the
+    analysis execution scope): raises when ``serve_crash`` is armed, so
+    chaos tests can crash exactly one request and assert the isolation
+    contract — flight dump attached, breaker decremented, resident pool
+    decontaminated, the NEXT request's findings untouched."""
+    if get_fault_plane().fire("serve_crash") is not None:
+        raise FaultInjected("injected served-request crash")
 
 
 def maybe_fault_rpc() -> None:

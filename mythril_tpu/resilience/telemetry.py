@@ -43,6 +43,10 @@ _FIELDS = {
     "checkpoints_written": "journal generations persisted",
     "resumes": "analyses rebuilt from a journal",
     "checkpoint_s": "wall-clock spent writing journals",
+    # per-request deadline budgets (resilience/budget.py, serve plane):
+    # a budget expiry drains ONE request at its next boundary — the
+    # partial report carries meta.resilience.partial plus this counter
+    "deadline_expiries": "request wall-clock budgets that expired",
 }
 
 

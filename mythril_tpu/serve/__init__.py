@@ -1,0 +1,31 @@
+"""``myth serve`` — the overload-safe persistent analysis daemon
+(docs/serving.md).
+
+Everything the single-shot CLI amortizes within one run and throws
+away at exit — the JAX compile cache warmup, the resident clause pool,
+warm-start models, the cone memo, the solver memo channels — survives
+here across requests.  The headline is the failure story, not the
+routing:
+
+- bounded two-class admission with load shedding
+  (:mod:`.admission`),
+- per-request wall-clock deadline budgets that reach the device round
+  ladders through the cooperative drain seam
+  (``resilience/budget.py``),
+- request isolation with flight-dump attachment, per-source circuit
+  breakers, and shared-state decontamination (:mod:`.engine`),
+- liveness/readiness/metrics surfaces (:mod:`.http`).
+"""
+
+from mythril_tpu.serve.admission import AdmissionQueue, CircuitBreaker  # noqa: F401
+from mythril_tpu.serve.config import (  # noqa: F401
+    ServeConfig,
+    ServeConfigError,
+)
+from mythril_tpu.serve.engine import AnalysisEngine  # noqa: F401
+from mythril_tpu.serve.http import AnalysisServer, run_server  # noqa: F401
+from mythril_tpu.serve.protocol import (  # noqa: F401
+    AnalyzeRequest,
+    RequestError,
+    parse_analyze_request,
+)

@@ -1,0 +1,271 @@
+"""The HTTP surface of the analysis daemon (stdlib-only).
+
+Endpoints::
+
+    POST /analyze    submit one contract (serve/protocol.py body);
+                     blocks until the engine answers, 503+Retry-After
+                     on any shed (queue full, RSS watermark, breaker
+                     open, draining), structured 4xx on malformed input
+    GET  /healthz    liveness: 200 while the process is up
+    GET  /readyz     readiness: 200 only while admitting AND the engine
+                     thread is alive; body carries mode
+                     ("device" | "host-cdcl"), queue depths, breaker
+                     states — a demoted device DEGRADES the body, it
+                     does not fail readiness (the host CDCL still
+                     answers everything)
+    GET  /metrics    the unified metrics registry, live, in Prometheus
+                     text format (the same registry ``--metrics-out``
+                     dumps at CLI exit)
+
+Shutdown: SIGTERM/SIGINT ride the resilience plane's cooperative drain
+(``install_signal_handlers``).  The serve loop notices, closes
+admission (readyz flips 503, new POSTs shed with ``draining``), lets
+the in-flight request finish — an expired-budget drain bounds how long
+that takes — fails every still-queued ticket, flushes the
+``--trace-out`` / ``--metrics-out`` artifacts, and exits 0.  A second
+signal force-exits, as in the CLI.
+"""
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from mythril_tpu.serve.admission import AdmissionQueue
+from mythril_tpu.serve.config import ServeConfig, current_rss_mb
+from mythril_tpu.serve.engine import AnalysisEngine
+from mythril_tpu.serve.protocol import RequestError, parse_analyze_request
+
+log = logging.getLogger(__name__)
+
+#: extra seconds a handler waits on the engine past the request budget
+#: before answering 504 (the engine is wedged — which the watchdog
+#: ladder should already be escalating)
+_RESPONSE_MARGIN_S = 60.0
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "mythril-tpu-serve"
+
+    # -- plumbing -------------------------------------------------------
+
+    def log_message(self, format, *args):  # noqa: A002 — stdlib name
+        log.debug("http: %s", format % args)
+
+    def _send_json(self, status: int, body: dict,
+                   retry_after=None) -> None:
+        payload = json.dumps(body).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        if retry_after is not None:
+            self.send_header("Retry-After", str(int(retry_after)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _send_error_obj(self, exc: RequestError) -> None:
+        self._send_json(
+            exc.status, exc.payload(),
+            retry_after=exc.extra.get("retry_after_s"),
+        )
+
+    @property
+    def _srv(self) -> "AnalysisServer":
+        return self.server.analysis_server
+
+    # -- GET ------------------------------------------------------------
+
+    def do_GET(self) -> None:
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            self._send_json(200, self._srv.health_body())
+        elif path == "/readyz":
+            ready, body = self._srv.ready_body()
+            self._send_json(
+                200 if ready else 503, body,
+                retry_after=None if ready
+                else self._srv.config.retry_after_s,
+            )
+        elif path == "/metrics":
+            from mythril_tpu.observability.metrics import get_registry
+
+            payload = get_registry().render().encode("utf-8")
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4"
+            )
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        else:
+            self._send_json(404, {"error": {
+                "code": "not_found", "message": f"no route {path!r}",
+            }})
+
+    # -- POST -----------------------------------------------------------
+
+    def do_POST(self) -> None:
+        if self.path.split("?", 1)[0] != "/analyze":
+            self._send_json(404, {"error": {
+                "code": "not_found",
+                "message": f"no route {self.path!r}",
+            }})
+            return
+        try:
+            raw = self._read_body()
+            request = parse_analyze_request(raw, self._srv.config)
+            ticket = self._srv.queue.submit(request)
+        except RequestError as exc:
+            self._send_error_obj(exc)
+            return
+        deadline_s = (
+            request.deadline_s or self._srv.config.default_deadline_s
+        )
+        if not ticket.done.wait(deadline_s + _RESPONSE_MARGIN_S):
+            self._send_json(504, {"error": {
+                "code": "engine_timeout",
+                "message": "the analysis engine did not answer within "
+                           "the budget plus margin",
+            }})
+            return
+        self._send_json(ticket.status, ticket.response)
+
+    def _read_body(self) -> bytes:
+        length = self.headers.get("Content-Length")
+        if length is None:
+            raise RequestError(
+                "length_required", "Content-Length is required",
+                status=411,
+            )
+        try:
+            length = int(length)
+        except ValueError as exc:
+            raise RequestError(
+                "bad_length", "Content-Length is not an integer"
+            ) from exc
+        max_body = self._srv.config.max_body_bytes
+        if length > max_body:
+            # reject from the header alone — never buffer an oversized
+            # body just to refuse it
+            raise RequestError(
+                "body_too_large",
+                f"request body exceeds MYTHRIL_TPU_SERVE_MAX_BODY "
+                f"({max_body} bytes)",
+                status=413, limit_bytes=max_body,
+            )
+        return self.rfile.read(length)
+
+
+class AnalysisServer:
+    """One daemon: admission queue + engine thread + HTTP listener."""
+
+    def __init__(self, config: ServeConfig):
+        self.config = config
+        self.queue = AdmissionQueue(config)
+        self.engine = AnalysisEngine(self.queue, config)
+        self.started_at = time.time()
+        self._httpd = ThreadingHTTPServer(
+            (config.host, config.port), _Handler
+        )
+        self._httpd.daemon_threads = True
+        self._httpd.analysis_server = self
+        self.port = self._httpd.server_address[1]  # resolved (port 0)
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="mythril-serve-http", daemon=True,
+        )
+
+    # -- status bodies --------------------------------------------------
+
+    def health_body(self) -> dict:
+        return {
+            "ok": True,
+            "uptime_s": round(time.time() - self.started_at, 1),
+            "rss_mb": round(current_rss_mb(), 1),
+            "requests_done": self.engine.requests_done,
+        }
+
+    def ready_body(self):
+        draining = self.queue.closed
+        engine_ok = self.engine.alive
+        ready = engine_ok and not draining
+        body = {
+            "ready": ready,
+            "draining": draining,
+            "engine_alive": engine_ok,
+            # a demoted device degrades, it does not unready: the host
+            # CDCL answers every query with identical findings
+            "degraded": self.engine.degraded(),
+            "mode": self.engine.mode(),
+            "queue_depths": self.queue.depths(),
+            "breakers": self.queue.breaker_states(),
+            "in_flight": self.engine.in_flight,
+            "requests": {
+                "done": self.engine.requests_done,
+                "failed": self.engine.requests_failed,
+                "partial": self.engine.requests_partial,
+            },
+        }
+        return ready, body
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        self.engine.start()
+        self._http_thread.start()
+        log.info(
+            "myth serve: listening on %s:%d (interactive queue %d, "
+            "batch queue %d, default deadline %.0fs)",
+            self.config.host, self.port,
+            self.config.queue_cap_interactive,
+            self.config.queue_cap_batch,
+            self.config.default_deadline_s,
+        )
+
+    def drain_and_stop(self, reason: str = "shutdown") -> None:
+        """Graceful shutdown: close admission, fail queued tickets,
+        wait for the in-flight request, flush artifacts, stop HTTP."""
+        log.info("myth serve: draining (%s)", reason)
+        pending = self.queue.close()
+        for ticket in pending:
+            ticket.resolve(503, {"error": {
+                "code": "draining",
+                "message": "server is draining for shutdown",
+            }})
+        self.engine.join(timeout=self.config.max_deadline_s)
+        from mythril_tpu.observability import finalize_outputs
+
+        finalize_outputs()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def serve_until_drained(self) -> None:
+        """Foreground loop for ``myth serve``: run until the resilience
+        plane's drain flag fires (SIGTERM/SIGINT), then shut down
+        gracefully."""
+        from mythril_tpu.resilience.checkpoint import _drain_event
+
+        self.start()
+        try:
+            while not _drain_event.wait(0.2):
+                if not self.engine.alive:
+                    log.error("engine thread died; shutting down")
+                    break
+        finally:
+            self.drain_and_stop(
+                "signal" if _drain_event.is_set() else "engine-dead"
+            )
+
+
+def run_server(host: str, port: int) -> int:
+    """CLI entry (``myth serve``): validate config, start, block until
+    drained.  Returns the process exit code."""
+    from mythril_tpu.resilience.checkpoint import install_signal_handlers
+
+    config = ServeConfig.from_env(host=host, port=port)
+    install_signal_handlers()
+    server = AnalysisServer(config)
+    server.serve_until_drained()
+    return 0

@@ -38,9 +38,15 @@ import sys
 #: (the tier stopped deciding/tightening), and word_prop_s regressing
 #: means the abstract-propagation pass itself got expensive — either
 #: failure mode shows up here before it moves t3_wall_s
+#: serve_warm_p50_s gates the persistent daemon's warm-request latency
+#: (the amortization story regressing — cold per-request state creeping
+#: back — shows up here long before a corpus wall moves)
 GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
          "device_sweeps", "h2d_bytes", "trace_overhead_s",
-         "blast_s", "word_prop_s")
+         "blast_s", "word_prop_s", "serve_warm_p50_s")
+#: gated metrics where LARGER is better (delta sign inverted):
+#: sustained warm-server throughput must not fall
+GATED_HIGHER_BETTER = ("serve_cpm",)
 #: floor below which a baseline is noise and ratios are meaningless
 MIN_BASE = 0.05
 
@@ -110,7 +116,7 @@ def main() -> int:
     print(f"bench_compare: {os.path.basename(old_path)} -> "
           f"{os.path.basename(new_path)}")
     failed = False
-    for key in GATED:
+    for key in GATED + GATED_HIGHER_BETTER:
         base, cur = old.get(key), new.get(key)
         if not isinstance(base, (int, float)) or not isinstance(
             cur, (int, float)
@@ -122,13 +128,17 @@ def main() -> int:
                   "floor; not gated)")
             continue
         delta = (cur - base) / base
+        if key in GATED_HIGHER_BETTER:
+            delta = -delta  # throughput falling is the regression
         verdict = "REGRESSION" if delta > opts.threshold else "ok"
         print(f"  {key}: {base} -> {cur} ({delta:+.1%}) {verdict}")
         failed = failed or delta > opts.threshold
 
     # informational: everything both headlines carry beyond the gate
     for key in sorted(set(old) | set(new)):
-        if key in GATED or key in ("metric", "unit", "cmd"):
+        if key in GATED or key in GATED_HIGHER_BETTER or key in (
+            "metric", "unit", "cmd",
+        ):
             continue
         a, b = old.get(key), new.get(key)
         if a != b:

@@ -22,6 +22,18 @@ uninterrupted reference run.  A final round arms a lane-dependent
 quarantined alone: ``quarantined_lanes`` >= 1 with ``demotions``
 unchanged at 0, the context still on device.
 
+``--serve`` soaks the persistent daemon instead: a real ``myth serve``
+subprocess is driven over HTTP through five scenarios — (1) findings
+parity vs in-process CLI runs while ``MYTHRIL_TPU_FAULT`` injection is
+armed in the server, (2) SIGKILL + restart with readiness and parity
+re-asserted, (3) per-source circuit breaker trip (via injected
+``serve_crash`` request failures) and post-cooldown recovery, (4) a
+tiny per-request deadline yielding a partial report with the next
+request unaffected, and (5) queue-overflow shedding (depth cap 1) with
+``Retry-After`` and no server death.  Each scenario runs a fresh server
+subprocess with scenario-specific env; exit status is nonzero when any
+scenario failed.
+
 Exit status is nonzero when any round broke findings parity, so the
 script doubles as a soak gate before hardware rounds.
 """
@@ -246,6 +258,275 @@ def kill_resume_main() -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --serve: soak the persistent daemon
+# ---------------------------------------------------------------------------
+
+SERVE_READY_TIMEOUT_S = 120.0
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def _http(method, url, payload=None, timeout=240):
+    """(status, parsed-json-or-None, headers) without raising on 4xx/5xx."""
+    import urllib.error
+    import urllib.request
+
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {},
+    )
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp.status, json.loads(resp.read() or b"null"), resp.headers
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read() or b"null")
+        except ValueError:
+            body = None
+        return e.code, body, e.headers
+    except Exception as e:  # noqa: BLE001 — connection refused etc.
+        return 0, {"transport_error": str(e)}, {}
+
+
+class _ServeChild:
+    """One ``myth serve`` subprocess on an ephemeral port."""
+
+    def __init__(self, extra_env=None):
+        self.port = _free_port()
+        self.base = f"http://127.0.0.1:{self.port}"
+        env = dict(os.environ)
+        env.pop("MYTHRIL_TPU_FAULT", None)
+        env.pop("MYTHRIL_TPU_KILL_AT", None)
+        env.update(extra_env or {})
+        myth = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "myth",
+        )
+        self.proc = subprocess.Popen(
+            [sys.executable, myth, "serve", "--port", str(self.port)],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    def wait_ready(self, timeout_s=SERVE_READY_TIMEOUT_S) -> bool:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if self.proc.poll() is not None:
+                return False
+            status, body, _ = _http("GET", self.base + "/readyz",
+                                    timeout=5)
+            if status == 200 and body and body.get("ready"):
+                return True
+            time.sleep(0.5)
+        return False
+
+    def analyze(self, payload, timeout=240):
+        return _http("POST", self.base + "/analyze", payload,
+                     timeout=timeout)
+
+    def sigkill(self):
+        self.proc.kill()
+        self.proc.wait(timeout=30)
+
+    def stop(self):
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+
+
+def _serve_reference():
+    """CLI-parity oracle: the embedded corpus analyzed in-process with
+    the canonical per-contract reset sequence (what `myth analyze`
+    does), keyed by contract name."""
+    import bench
+
+    reference = {}
+    for name, code, tx_count, _expected in bench._corpus():
+        found, _row = bench._analyze_one(
+            name, code, tx_count, execution_timeout=120, max_depth=128
+        )
+        reference[name] = sorted(found)
+    return reference
+
+
+def serve_soak_main() -> int:
+    """The --serve driver: overload, deadline, poison, kill — the
+    daemon must shed, degrade, and recover; never die or change
+    findings."""
+    import bench
+
+    failures = []
+
+    def check(scenario, ok, **detail):
+        row = {"scenario": scenario, "ok": bool(ok), **detail}
+        print(json.dumps(row))
+        if not ok:
+            failures.append(row)
+
+    print("serve soak: computing in-process CLI reference ...",
+          file=sys.stderr)
+    reference = _serve_reference()
+    print(json.dumps({"reference": reference}), file=sys.stderr)
+    corpus = {name: (code, tx) for name, code, tx, _ in bench._corpus()}
+
+    # -- scenario 1: findings parity under armed fault injection
+    # (cdcl_error:1, matching SCHEDULE above — the retry rung absorbs
+    # one abort; more consecutive shots than retries would LEGITIMATELY
+    # degrade verdicts to UNKNOWN)
+    child = _ServeChild(extra_env={"MYTHRIL_TPU_FAULT": "cdcl_error:1"})
+    try:
+        check("faulted_server_ready", child.wait_ready())
+        parity = {}
+        for name, (code, tx_count) in corpus.items():
+            status, body, _ = child.analyze({
+                "code": code, "name": name, "tx_count": tx_count,
+                "deadline_s": 240, "source": "soak",
+            })
+            parity[name] = (
+                status == 200
+                and body.get("findings_swc") == reference[name]
+            )
+        check("fault_injection_findings_parity", all(parity.values()),
+              per_contract=parity)
+
+        # -- scenario 2: SIGKILL the server, restart, stay ready -------
+        child.sigkill()
+        check("sigkill_delivered", True)
+    finally:
+        child.stop()
+    child = _ServeChild()
+    try:
+        check("restart_after_sigkill_ready", child.wait_ready())
+        name = "killbilly"
+        code, tx_count = corpus[name]
+        status, body, _ = child.analyze({
+            "code": code, "name": name, "tx_count": tx_count,
+            "deadline_s": 240, "source": "soak",
+        })
+        check(
+            "restart_findings_parity",
+            status == 200 and body.get("findings_swc") == reference[name],
+            found=body.get("findings_swc") if body else None,
+        )
+    finally:
+        child.stop()
+
+    # -- scenario 3: breaker trips on poisoned requests, then recovers
+    child = _ServeChild(extra_env={
+        "MYTHRIL_TPU_FAULT": "serve_crash:2",
+        "MYTHRIL_TPU_SERVE_BREAKER": "2",
+        "MYTHRIL_TPU_SERVE_BREAKER_COOLDOWN": "1.0",
+    })
+    try:
+        check("breaker_server_ready", child.wait_ready())
+        code, tx_count = corpus["killbilly"]
+        payload = {"code": code, "name": "killbilly",
+                   "tx_count": tx_count, "source": "toxic"}
+        crashes = [child.analyze(payload)[0] for _ in range(2)]
+        status, body, headers = child.analyze(payload)
+        tripped = (
+            crashes == [500, 500]
+            and status == 503
+            and body and body["error"]["code"] == "breaker_open"
+            and int(headers.get("Retry-After", 0)) >= 1
+        )
+        check("breaker_tripped", tripped, crashes=crashes,
+              shed_status=status)
+        time.sleep(1.5)  # past the cooldown; injected shots exhausted
+        status, body, _ = child.analyze(payload)
+        recovered = (
+            status == 200
+            and body.get("findings_swc") == reference["killbilly"]
+        )
+        status, ready, _ = _http("GET", child.base + "/readyz")
+        check("breaker_recovered", recovered
+              and ready.get("breakers", {}).get("toxic") == "closed",
+              breakers=ready.get("breakers"))
+    finally:
+        child.stop()
+
+    # -- scenario 4: per-request deadline -> partial, next unaffected
+    child = _ServeChild()
+    try:
+        check("deadline_server_ready", child.wait_ready())
+        tree = bench.chaos_tree_contract()
+        status, body, _ = child.analyze({
+            "code": tree, "name": "chaos_tree", "tx_count": 2,
+            "deadline_s": 0.05, "source": "soak",
+        })
+        check("deadline_partial_report",
+              status == 200 and body.get("partial") is True,
+              status=status, partial=body.get("partial") if body else None)
+        code, tx_count = corpus["killbilly"]
+        status, body, _ = child.analyze({
+            "code": code, "name": "killbilly", "tx_count": tx_count,
+            "deadline_s": 240, "source": "soak",
+        })
+        check(
+            "post_deadline_request_unaffected",
+            status == 200 and body.get("partial") is False
+            and body.get("findings_swc") == reference["killbilly"],
+        )
+    finally:
+        child.stop()
+
+    # -- scenario 5: queue overflow sheds with Retry-After -------------
+    child = _ServeChild(extra_env={
+        "MYTHRIL_TPU_SERVE_QUEUE_INTERACTIVE": "1",
+    })
+    try:
+        check("overflow_server_ready", child.wait_ready())
+        import threading
+
+        tree = bench.chaos_tree_contract()
+        slow = {"code": tree, "name": "chaos_tree", "tx_count": 2,
+                "deadline_s": 60, "source": "soak"}
+        background = [
+            threading.Thread(target=child.analyze, args=(slow,))
+            for _ in range(2)
+        ]
+        for thread in background:
+            thread.start()
+        time.sleep(0.5)  # one executing, one queued (cap 1)
+        sheds = [child.analyze(slow, timeout=30) for _ in range(3)]
+        shed_hit = [
+            (status, body["error"]["code"], headers.get("Retry-After"))
+            for status, body, headers in sheds
+            if status == 503 and body and "error" in body
+        ]
+        check(
+            "queue_overflow_sheds_with_retry_after",
+            any(code == "queue_full" and retry for _, code, retry
+                in shed_hit),
+            sheds=[s[0] for s in sheds],
+        )
+        for thread in background:
+            thread.join(timeout=240)
+        status, ready, _ = _http("GET", child.base + "/readyz")
+        check("overflow_server_survives",
+              status == 200 and ready.get("ready") is True,
+              rss_alive=True)
+    finally:
+        child.stop()
+
+    if failures:
+        print(json.dumps({"serve_soak_failures": failures}))
+        return 1
+    print(json.dumps({"serve_soak_ok": True, "scenarios": 5}))
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--rounds", type=int, default=6)
@@ -254,6 +535,11 @@ def main() -> int:
                         help="checkpoint/resume chaos: SIGKILL at every "
                         "injection point, resume, demand identical "
                         "findings")
+    parser.add_argument("--serve", action="store_true",
+                        help="soak a live `myth serve` daemon: fault "
+                        "injection parity, SIGKILL-restart, breaker "
+                        "trip/recover, deadline partials, queue-"
+                        "overflow shedding")
     parser.add_argument("--kr-child", action="store_true",
                         help=argparse.SUPPRESS)
     parser.add_argument("--kr-dir", default=None, help=argparse.SUPPRESS)
@@ -264,6 +550,8 @@ def main() -> int:
         return _kr_child(args_ns.kr_dir, args_ns.kr_resume)
     if args_ns.kill_resume:
         return kill_resume_main()
+    if args_ns.serve:
+        return serve_soak_main()
     rng = random.Random(args_ns.seed)
 
     import logging

@@ -150,3 +150,37 @@ def test_headline_carries_degradation_counters():
     payload = json.loads(bench.build_headline_line(summary, None, None))
     assert payload["watchdog_trips"] == 4
     assert payload["demotions"] == 2
+
+
+def test_headline_carries_serve_fields_and_gate():
+    """The `myth serve` round is judged on the warm-server p50 and the
+    sustained contracts/min: both ride the headline when the serve
+    microbench ran, stay droppable under the 500-char cap, and are
+    gated by scripts/bench_compare.py (p50 up = regression, cpm down =
+    regression)."""
+    import importlib.util
+
+    payload = json.loads(
+        bench.build_headline_line(dict(BASE_SUMMARY), None, None)
+    )
+    assert "serve_warm_p50_s" not in payload  # microbench skipped
+
+    summary = dict(BASE_SUMMARY, serve_warm_p50_s=0.071, serve_cpm=742.5)
+    payload = json.loads(bench.build_headline_line(summary, None, None))
+    assert payload["serve_warm_p50_s"] == 0.071
+    assert payload["serve_cpm"] == 742.5
+
+    summary = dict(BASE_SUMMARY, serve_warm_p50_s=0.071, serve_cpm=742.5,
+                   error="missed findings: " + "x" * 1000)
+    line = bench.build_headline_line(summary, None, None)
+    assert len(line) <= 500
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare_serve",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "bench_compare.py"),
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    assert "serve_warm_p50_s" in module.GATED
+    assert "serve_cpm" in module.GATED_HIGHER_BETTER
