@@ -58,8 +58,9 @@ PHASE_PREFIXES = (
     ("pallas.round", "sweep"),
     ("cdcl.solve", "tail"),
     ("word.", "word"),
+    ("frontier.round", "frontier"),
 )
-PHASE_KEYS = ("cone", "upload", "sweep", "tail", "word")
+PHASE_KEYS = ("cone", "upload", "sweep", "tail", "word", "frontier")
 
 
 def _kill_switched() -> bool:

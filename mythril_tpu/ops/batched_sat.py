@@ -210,6 +210,15 @@ class DispatchStats:
         self.word_decided_sat = 0
         self.word_tightened_bits = 0
         self.word_prop_s = 0.0
+        # device-native propagation (ops/frontier.py; this PR):
+        # adjacency-gather BCP iterations that replaced full-pool
+        # sweeps (device_sweeps keeps counting FULL sweeps, so the
+        # sweeps-per-lane headline stays comparable across rounds),
+        # and first-UIP clauses learned in-kernel and accepted into
+        # the pool's nogood channel (they reach the resident pool as
+        # delta uploads on the next dispatch)
+        self.frontier_steps = 0
+        self.learned_clauses = 0
 
     def as_dict(self):
         from mythril_tpu.resilience.telemetry import resilience_stats
@@ -243,6 +252,11 @@ class DevicePool:
         self.dropped = 0
         self.consumed = 0       # ctx.clauses_py rows reflected on device
         self.filled = 0         # non-pad rows used in the bucket
+        # literal→clause-row adjacency for the frontier tier
+        # (ops/frontier.py), host + device copies; invalidated with
+        # the rows they index (refresh and delta appends)
+        self._adj_np = None
+        self._adj_dev = None
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -299,6 +313,8 @@ class DevicePool:
                 pass
         dispatch_stats.pool_uploads += 1
         dispatch_stats.h2d_bytes += int(mat.nbytes)
+        self._adj_np = None
+        self._adj_dev = None
         self.num_vars = self._bucket(num_vars)
         self.num_clauses = target_c
         self.dropped = dropped
@@ -339,8 +355,32 @@ class DevicePool:
             # the dispatch ships only the appended rows, not the pool
             dispatch_stats.delta_uploads += 1
             dispatch_stats.h2d_bytes += int(rows.nbytes)
+            # appended rows (CDCL learnts, device-learned nogoods)
+            # need adjacency entries too — rebuilt lazily on the next
+            # frontier dispatch
+            self._adj_np = None
+            self._adj_dev = None
         self.consumed = total
         return True
+
+    def adjacency_dev(self):
+        """Device copy of the literal→clause-row adjacency over the
+        resident rows (ops/frontier.py), built and uploaded at most
+        once per pool refresh/append."""
+        if self._adj_dev is not None:
+            return self._adj_dev
+        from mythril_tpu.ops.frontier import build_adjacency
+
+        _, jnp = _require_jax()
+        if self._adj_np is None:
+            self._adj_np = build_adjacency(
+                self.lits_np[: self.filled], self.num_vars + 1
+            )
+        with obs.span("upload.adjacency", cat="h2d",
+                      bytes=int(self._adj_np.nbytes)):
+            self._adj_dev = jnp.asarray(self._adj_np)
+        dispatch_stats.h2d_bytes += int(self._adj_np.nbytes)
+        return self._adj_dev
 
 
 def build_round_lane(
@@ -783,12 +823,24 @@ class BatchedSatBackend:
         else:
             # round-laddered lockstep solve: budgeted rounds, lane
             # retirement, bucket re-packing (supervision + fault
-            # injection happen per round inside the ladder)
+            # injection happen per round inside the ladder).  With the
+            # frontier tier on, rounds gather only clause rows adjacent
+            # to recently-assigned literals (the resident pool's
+            # adjacency index) and learn first-UIP clauses on device;
+            # column space here is the pool's own variable space, so
+            # learned literals harvest with no remap
+            from mythril_tpu.ops.frontier import frontier_enabled
+
+            frontier = None
+            if frontier_enabled():
+                frontier = {"adj": self.pool.adjacency_dev(),
+                            "ctx": ctx, "col_to_var": None}
             try:
                 status, final_assign = self._solve_gather_ladder(
                     "gather", self.pool.lits, assign,
                     pref=warm_pref_row(ctx, assign.shape[1],
                                        lanes=batch),
+                    frontier=frontier,
                 )
             except DispatchAbandoned as exc:
                 return self._abandon(ctx, exc, batch)
@@ -842,6 +894,43 @@ class BatchedSatBackend:
         return self._cached(("round", bucket, budget),
                             lambda: make_round_step(bucket, budget))
 
+    def _cached_frontier_round(self, bucket: int, budget: int):
+        """Jitted frontier round (ops/frontier.py) — the cache key
+        carries the fan/period knobs so tests re-pinning the env never
+        get a stale trace."""
+        from mythril_tpu.ops.frontier import (
+            frontier_fan, frontier_period, make_frontier_round_step,
+        )
+
+        key = ("frontier", bucket, budget, frontier_fan(),
+               frontier_period())
+        return self._cached(
+            key,
+            lambda: make_frontier_round_step(bucket, budget,
+                                             GATHER_DECISIONS),
+        )
+
+    def _harvest_round_learnts(self, state, live, frontier) -> None:
+        """Pull the round's first-UIP clauses off the lane buffers and
+        feed them to the blast context's nogood channel
+        (ops/frontier.harvest_learned).  Accepted clauses reach the
+        native CDCL immediately and the device-resident pool as an
+        append-only delta upload on the next dispatch — the
+        learned-clause lifecycle the resident-pool telemetry tracks
+        (``learned_clauses`` / ``delta_uploads``)."""
+        from mythril_tpu.ops.frontier import harvest_learned
+
+        counts = state["nlearn"][: live.size]
+        if not counts.any():
+            return
+        rows = []
+        for lane in np.nonzero(counts)[0]:
+            rows.extend(state["learned"][lane, : int(counts[lane])])
+        accepted = harvest_learned(
+            frontier["ctx"], rows, frontier.get("col_to_var")
+        )
+        dispatch_stats.learned_clauses += accepted
+
     def _cached(self, key, build):
         with self._step_lock:
             step = self._step_cache.get(key)
@@ -857,13 +946,28 @@ class BatchedSatBackend:
         return step
 
     def _solve_gather_ladder(self, key_base: str, lits, assign,
-                             pref=None):
+                             pref=None, frontier=None):
         """Round-laddered lockstep solve over assumption-seeded
         assignment vectors ``assign [batch, V1]`` (int8).
 
         ``pref`` (optional ``[V1]`` int8 row) is the warm-start
         decision-phase preference broadcast to every lane — see
         build_round_lane; it rides the lane state so re-packs carry it.
+
+        ``frontier`` (optional dict with ``adj`` — the device
+        adjacency index, ``ctx`` — the blast context for the
+        learned-clause harvest, and ``col_to_var`` — the column→pool
+        variable remap or None) switches the rounds to the
+        event-driven frontier kernel (ops/frontier.py): per-lane
+        recently-assigned queues carried across rounds and re-packs,
+        adjacency-gather BCP between full sweeps, and in-kernel
+        first-UIP clause learning harvested between rounds into the
+        pool's nogood channel.  Watchdog/span keys become
+        ``frontier:{budget}`` / ``frontier.round`` so the EWMA
+        deadline model and the bench phase breakdown budget the new
+        round shape separately from dense/gather rounds.  ``None``
+        (or the ``MYTHRIL_TPU_FRONTIER=0`` kill switch upstream)
+        runs the exact prior dense round kernels.
 
         Replaces the monolithic while_loop dispatch: budgeted rounds
         (GATHER_ROUND_BUDGETS), decided lanes retired between rounds,
@@ -890,6 +994,7 @@ class BatchedSatBackend:
         Returns (status[batch] int32 with bails mapped to undecided,
         final assign[batch, V1] int8).
         """
+        from mythril_tpu.ops import frontier as FR
         from mythril_tpu.resilience.checkpoint import drain_requested
 
         _, jnp = _require_jax()
@@ -900,26 +1005,43 @@ class BatchedSatBackend:
         dispatch_stats.lane_slots_filled += batch
         dispatch_stats.lane_slots_total += B
 
-        state = {
-            "assign": np.ones((B, V1), np.int8),
-            "lvl": np.zeros((B, V1), np.int32),
-            "dvar": np.zeros((B, D), np.int32),
-            "dphase": np.zeros((B, D), np.int8),
-            "dflip": np.zeros((B, D), bool),
-            "depth": np.zeros(B, np.int32),
-            "status": np.zeros(B, np.int32),
-            "step": np.zeros(B, np.int32),
-            "pref": np.zeros((B, V1), np.int8),
-        }
-        order = ("assign", "lvl", "dvar", "dphase", "dflip", "depth",
-                 "status", "step", "pref")
-        state["assign"][:batch] = assign
+        pref_row = None
         if pref is not None:
-            row = np.zeros(V1, np.int8)
+            pref_row = np.zeros(V1, np.int8)
             n = min(V1, len(pref))
-            row[:n] = np.asarray(pref[:n], np.int8)
-            state["pref"][:] = row
-        state["status"][batch:] = 3  # bucket pads: retired from step 0
+            pref_row[:n] = np.asarray(pref[:n], np.int8)
+        if frontier is not None:
+            seed = np.ones((B, V1), np.int8)
+            seed[:batch] = assign
+            state = FR.frontier_state0(
+                seed, batch, GATHER_DECISIONS, width=MAX_CLAUSE_WIDTH,
+                pref_row=pref_row,
+            )
+            order = FR.FRONTIER_STATE_FIELDS
+            round_keys = ("fullsw", "fsteps", "nlearn")
+            key_base = "frontier"
+            span_name = "frontier.round"
+            adj_dev = frontier["adj"]
+        else:
+            state = {
+                "assign": np.ones((B, V1), np.int8),
+                "lvl": np.zeros((B, V1), np.int32),
+                "dvar": np.zeros((B, D), np.int32),
+                "dphase": np.zeros((B, D), np.int8),
+                "dflip": np.zeros((B, D), bool),
+                "depth": np.zeros(B, np.int32),
+                "status": np.zeros(B, np.int32),
+                "step": np.zeros(B, np.int32),
+                "pref": np.zeros((B, V1), np.int8),
+            }
+            order = ("assign", "lvl", "dvar", "dphase", "dflip", "depth",
+                     "status", "step", "pref")
+            round_keys = ("step",)
+            span_name = "dispatch.round"
+            state["assign"][:batch] = assign
+            if pref_row is not None:
+                state["pref"][:] = pref_row
+            state["status"][batch:] = 3  # bucket pads: retired at step 0
 
         statuses_out = np.zeros(batch, np.int32)
         assign_out = np.array(assign, copy=True)
@@ -947,23 +1069,45 @@ class BatchedSatBackend:
                 obs.instant("dispatch.drain", cat="sweep",
                             lanes=int(live.size), bucket=B)
                 break
-            state["step"][:] = 0  # per-round active-sweep counters
-            step_fn = self._cached_round(V1 - 1, budget)
-            with obs.span("dispatch.round", cat="sweep",
+            for k in round_keys:  # per-round active/learn counters
+                state[k][:] = 0
+            if frontier is not None:
+                raw = self._cached_frontier_round(V1 - 1, budget)
+                step_fn = (
+                    lambda lits_, *vals: raw(lits_, adj_dev, *vals)
+                )
+            else:
+                step_fn = self._cached_round(V1 - 1, budget)
+            with obs.span(span_name, cat="sweep",
                           key=f"{key_base}:{budget}",
                           lanes=int(live.size), bucket=B):
                 state, quarantined = self._dispatch_round(
                     f"{key_base}:{budget}", step_fn, lits, state, order,
-                    live,
+                    live, frontier=frontier is not None,
                 )
             for local in quarantined:
                 state["status"][local] = 3  # undecided -> CDCL tail
             dispatch_stats.rounds += 1
-            steps_live = state["step"][: live.size]
-            steps_used = int(steps_live.max()) if live.size else 0
-            dispatch_stats.device_sweeps += steps_used
-            dispatch_stats.lane_sweeps_total += steps_used * B
-            dispatch_stats.lane_sweeps_active += int(steps_live.sum())
+            if frontier is not None:
+                # device_sweeps counts FULL sweeps only, so the
+                # sweeps-per-lane headline stays comparable with the
+                # dense ladder; the cheap adjacency-gather iterations
+                # land in their own counter
+                full_live = state["fullsw"][: live.size]
+                steps_used = int(full_live.max()) if live.size else 0
+                dispatch_stats.device_sweeps += steps_used
+                dispatch_stats.lane_sweeps_total += steps_used * B
+                dispatch_stats.lane_sweeps_active += int(full_live.sum())
+                dispatch_stats.frontier_steps += int(
+                    state["fsteps"][: live.size].sum()
+                )
+                self._harvest_round_learnts(state, live, frontier)
+            else:
+                steps_live = state["step"][: live.size]
+                steps_used = int(steps_live.max()) if live.size else 0
+                dispatch_stats.device_sweeps += steps_used
+                dispatch_stats.lane_sweeps_total += steps_used * B
+                dispatch_stats.lane_sweeps_active += int(steps_live.sum())
             st = state["status"][: live.size]
             done = st != 0
             if not done.any():
@@ -992,7 +1136,8 @@ class BatchedSatBackend:
             assign_out[live[local]] = state["assign"][local]
         return np.where(statuses_out == 3, 0, statuses_out), assign_out
 
-    def _dispatch_round(self, key, step_fn, lits, state, order, live):
+    def _dispatch_round(self, key, step_fn, lits, state, order, live,
+                        frontier: bool = False):
         """One supervised ladder round over ``state`` (bucket-sized
         arrays, rows < live.size live) with poisoned-lane bisection.
 
@@ -1024,6 +1169,11 @@ class BatchedSatBackend:
 
             def _thunk():
                 faults.maybe_fault_dispatch(lane_ids=sub_ids)
+                if frontier:
+                    # the event-driven tier has its own injection point
+                    # so the chaos suite covers the new dispatch shape
+                    # (retry/bisect/demote rungs all reachable from it)
+                    faults.maybe_fault_frontier()
                 out = step_fn(lits, *vals)
                 # the host copy blocks until the round finished — the
                 # wedge point, so it belongs inside the supervision
@@ -1258,6 +1408,31 @@ class BatchedSatBackend:
             rows_dev = get_cone_memo().get_or_build(
                 ctx, ("cone_dev", roots), _upload_rows
             )
+            # frontier tier over the cone rows: the adjacency index is
+            # memoized beside the rows (same (generation, pool_version,
+            # learned-generation) scope), and learned-clause literals
+            # remap from compact cone columns back to pool variable
+            # ids before the harvest (column i+1 = cone_vars[i])
+            from mythril_tpu.ops.frontier import (
+                build_adjacency, frontier_enabled,
+            )
+
+            frontier = None
+            if frontier_enabled():
+                def _upload_adj():
+                    adj = build_adjacency(rows, assign.shape[1])
+                    dispatch_stats.h2d_bytes += int(adj.nbytes)
+                    with obs.span("upload.adjacency", cat="h2d",
+                                  bytes=int(adj.nbytes)):
+                        return jnp.asarray(adj)
+
+                adj_dev = get_cone_memo().get_or_build(
+                    ctx, ("cone_adj", roots), _upload_adj
+                )
+                col_to_var = np.zeros(n + 1, np.int64)
+                col_to_var[1:] = cone_vars
+                frontier = {"adj": adj_dev, "ctx": ctx,
+                            "col_to_var": col_to_var}
             try:
                 status, final_assign = self._solve_gather_ladder(
                     "cone", rows_dev, assign,
@@ -1265,6 +1440,7 @@ class BatchedSatBackend:
                         ctx, assign.shape[1], cone_vars=cone_vars,
                         offset=1, lanes=len(assumption_sets),
                     ),
+                    frontier=frontier,
                 )
             except DispatchAbandoned as exc:
                 return self._abandon(ctx, exc, len(assumption_sets))

@@ -78,11 +78,18 @@ class ConeMemo:
     """
 
     def __init__(self):
-        self._scope: Tuple[int, int] = (-1, -1)
+        self._scope: Tuple[int, int, int] = (-1, -1, -1)
         self._table: Dict[tuple, object] = {}
 
     def _sync(self, ctx) -> None:
-        scope = (ctx.generation, ctx.pool_version)
+        # the learned-clause generation (device first-UIP harvests,
+        # ops/frontier.py) rides the scope explicitly: a harvest bumps
+        # pool_version too, but the contract that memoized cone rows /
+        # adjacency indexes must never straddle a learned append is
+        # load-bearing for soundness-of-freshness, so it is pinned
+        # here rather than inherited incidentally
+        scope = (ctx.generation, ctx.pool_version,
+                 getattr(ctx, "device_learned_generation", 0))
         if scope != self._scope:
             self._scope = scope
             self._table.clear()
@@ -125,7 +132,7 @@ class ConeMemo:
         )
 
     def reset(self) -> None:
-        self._scope = (-1, -1)
+        self._scope = (-1, -1, -1)
         self._table.clear()
 
     def __len__(self) -> int:

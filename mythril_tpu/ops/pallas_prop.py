@@ -1393,8 +1393,26 @@ class PallasSatBackend:
                 A0[lane, np.abs(cols)] = np.where(cols > 0, 1.0, -1.0)
             seeded = np.any(A0[:n] != 0.0, axis=0)
             # layout state the trail growth mutates (carried across
-            # chunks so a grown tier serves the rest of the batch)
-            layout = {"urow": urow, "width": width_arr, "hot": hot_mask}
+            # chunks so a grown tier serves the rest of the batch).
+            # ``rowmap`` tracks original→current row ids so the shared
+            # literal→row adjacency index (built ONCE per union
+            # layout, ops/frontier.py) keeps serving after hot-first
+            # permutations; ``seen`` is the cross-round frontier — only
+            # columns newly assigned since the last round pay an
+            # adjacency lookup, instead of an O(nnz) isin scan of the
+            # whole coordinate list every round
+            from mythril_tpu.ops.frontier import (
+                LitAdjacency, frontier_enabled,
+            )
+
+            layout = {"urow": urow, "width": width_arr, "hot": hot_mask,
+                      "rowmap": np.arange(len(width_arr), dtype=np.int64),
+                      "seen": seeded.copy()}
+            adj_index = (
+                LitAdjacency(urow, ulit, len(width_arr))
+                if (tier_on and frontier_enabled() and len(ulit))
+                else None
+            )
 
             def grow_hot(live_A, hot_cur):
                 """Fold the round trail (columns newly assigned by any
@@ -1405,14 +1423,32 @@ class PallasSatBackend:
                 if not len(ulit):
                     return None
                 mask = layout["hot"]
-                trail = np.nonzero(
-                    np.any(np.abs(live_A) > 0.5, axis=0) & ~seeded
-                )[0]
-                if trail.size:
-                    hit = np.isin(np.abs(ulit.astype(np.int64)), trail)
-                    mask = mask.copy()
-                    mask[np.unique(layout["urow"][hit])] = True
-                    layout["hot"] = mask
+                if adj_index is not None:
+                    # adjacency-gather frontier: rows adjacent to the
+                    # columns assigned since the LAST round only
+                    fresh = np.nonzero(
+                        np.any(np.abs(live_A) > 0.5, axis=0)
+                        & ~layout["seen"]
+                    )[0]
+                    if fresh.size:
+                        layout["seen"] = layout["seen"].copy()
+                        layout["seen"][fresh] = True
+                        touched = adj_index.rows_for_vars(fresh)
+                        if touched.size:
+                            mask = mask.copy()
+                            mask[layout["rowmap"][touched]] = True
+                            layout["hot"] = mask
+                else:
+                    trail = np.nonzero(
+                        np.any(np.abs(live_A) > 0.5, axis=0) & ~seeded
+                    )[0]
+                    if trail.size:
+                        hit = np.isin(
+                            np.abs(ulit.astype(np.int64)), trail
+                        )
+                        mask = mask.copy()
+                        mask[np.unique(layout["urow"][hit])] = True
+                        layout["hot"] = mask
                 new_hot_c = _bucket(max(1, int(mask.sum())), floor=TC)
                 if new_hot_c <= hot_cur or new_hot_c * 2 > C:
                     return None
@@ -1420,6 +1456,7 @@ class PallasSatBackend:
                 layout["urow"] = new_pos2[layout["urow"]]
                 layout["width"] = layout["width"][order2]
                 layout["hot"] = mask[order2]
+                layout["rowmap"] = new_pos2[layout["rowmap"]]
                 pool.refresh_coords(
                     layout["urow"], ulit, layout["width"], n_rows,
                     num_cone_vars,

@@ -33,6 +33,12 @@ Injection points:
                      lane-dependent kernel abort: one query's data
                      wedges the kernel while its siblings are fine) —
                      the poisoned-lane bisection's territory
+``frontier_stall``   a frontier-tier round (adjacency-gather BCP +
+                     first-UIP learning, ops/frontier.py) raises
+                     before launching — the event-driven dispatch
+                     shape walks the same retry/bisect/demote ladder
+                     as dense rounds, and the chaos suite pins that
+                     findings survive it
 ``serve_crash``      the analysis of a served request raises unhandled
                      mid-execution (models a poisoned contract whose
                      exploration crashes the executor) — the serve
@@ -90,6 +96,7 @@ FAULT_POINTS = (
     "rpc_error",
     "rpc_http_500",
     "lane_poison",
+    "frontier_stall",
     "serve_crash",
 )
 
@@ -285,6 +292,16 @@ def maybe_fault_dispatch(lane_ids=None) -> None:
         raise FaultInjected(
             "injected lane-dependent kernel abort (poisoned lane aboard)"
         )
+
+
+def maybe_fault_frontier() -> None:
+    """Frontier-round seam (ops/batched_sat._dispatch_round, frontier
+    mode): fires inside the watchdog-supervised thunk, so an injected
+    stall walks the retry → bisect → demote ladder exactly like a
+    dense-round failure — the chaos coverage for the event-driven
+    dispatch path."""
+    if get_fault_plane().fire("frontier_stall") is not None:
+        raise FaultInjected("injected frontier-round stall")
 
 
 def maybe_corrupt_lanes(status: np.ndarray, assign: np.ndarray):

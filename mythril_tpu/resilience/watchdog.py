@@ -69,7 +69,9 @@ DEADLINE_FLOOR_S = 5.0   # warm deadlines never drop below this
 DEADLINE_MULT = 8.0      # deadline = EWMA x this (dispatch latency has
 #                          heavy tails: pool refresh, cache miss)
 EWMA_ALPHA = 0.3
-# latency-table entry cap: round-ladder keys ("gather:64", "cone:512")
+# latency-table entry cap: round-ladder keys ("gather:64", "cone:512",
+# "frontier:64" — the event-driven frontier rounds budget their own
+# deadline model instead of inheriting stale dense-round EWMAs)
 # multiply the key space per bucket, and a long soak over many pool
 # shapes would otherwise grow the table without bound.  LRU like
 # PROBE_MEMO_CAP: hits refresh recency, the stale quarter is evicted.

@@ -188,6 +188,12 @@ class BlastContext:
 
         self.cone_histo_enabled = bool(_os.environ.get("MYTHRIL_CONE_HISTO"))
         self.cone_histogram: Dict[str, int] = {}
+        # device-learned first-UIP clauses (ops/frontier.py): total
+        # accepted this context, and a generation counter that
+        # learned-aware caches (the cone memo) fold into their scope
+        # key so a pre-harvest layout is never served post-harvest
+        self.device_learned = 0
+        self.device_learned_generation = 0
 
     # ------------------------------------------------------------------
     # pool facade (the store itself is native; see csrc/pool.cpp)
@@ -309,6 +315,42 @@ class BlastContext:
             # the nogood's content independently checkable.
             return
         self.pool.nogood(list(assumption_lits))
+
+    def harvest_device_clauses(
+        self, clauses: Sequence[Sequence[int]]
+    ) -> int:
+        """Feed device-learned first-UIP clauses (ops/frontier.py)
+        into the nogood channel.  Each clause is derived purely by
+        resolution over pool rows on the device, so it is implied by
+        the pool and globally valid for every lane — the same
+        soundness argument as :meth:`learn_nogood`, reached from the
+        other direction (the clause arrives directly instead of as a
+        refuted assumption cube).  The native side dedupes, drops
+        tautologies and enforces the width cap; accepted clauses reach
+        the CDCL immediately and the device-resident pool as an
+        append-only delta upload on the next dispatch.  Under
+        ``--proof-log`` nothing is harvested (an in-kernel resolution
+        is not independently replayable by the proof checker — same
+        rule as uncertified nogoods).  Returns the accepted count and
+        bumps ``device_learned_generation`` so learned-aware caches
+        (ops/incremental.ConeMemo) re-scope."""
+        from mythril_tpu.support.support_args import args as _args
+
+        if getattr(_args, "proof_log", False):
+            return 0
+        added = 0
+        for clause in clauses:
+            lits = [int(lit) for lit in clause if lit]
+            if not lits:
+                continue
+            # pool.nogood() takes a refuted assumption cube and adds
+            # the clause of its negations — hand it the negated clause
+            if self.pool.nogood([-lit for lit in lits]):
+                added += 1
+        if added:
+            self.device_learned += added
+            self.device_learned_generation += 1
+        return added
 
     def confirm_unsat(
         self, assumption_lits: Sequence[int], conflict_budget: int = 4000

@@ -40,13 +40,20 @@ import sys
 #: failure mode shows up here before it moves t3_wall_s
 #: serve_warm_p50_s gates the persistent daemon's warm-request latency
 #: (the amortization story regressing — cold per-request state creeping
-#: back — shows up here long before a corpus wall moves)
+#: back — shows up here long before a corpus wall moves);
+#: sweeps_per_lane gates the device-native propagation tier (full
+#: sweeps per decided lane — dense sweeping creeping back past the
+#: event-driven frontier rounds trips this fence first)
 GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
          "device_sweeps", "h2d_bytes", "trace_overhead_s",
-         "blast_s", "word_prop_s", "serve_warm_p50_s")
+         "blast_s", "word_prop_s", "serve_warm_p50_s",
+         "sweeps_per_lane")
 #: gated metrics where LARGER is better (delta sign inverted):
-#: sustained warm-server throughput must not fall
-GATED_HIGHER_BETTER = ("serve_cpm",)
+#: sustained warm-server throughput must not fall, and the microbench
+#: device-vs-host ratio (both sides measured in the same run since the
+#: frontier round replaced the stale-denominator `microbench_speedup`)
+#: must not collapse
+GATED_HIGHER_BETTER = ("serve_cpm", "microbench_device_vs_host")
 #: floor below which a baseline is noise and ratios are meaningless
 MIN_BASE = 0.05
 

@@ -58,6 +58,11 @@ SCHEDULE = {
     ),
     "dispatch_error": ({"times": 99}, {}, {}),
     "dispatch_garbage": ({"times": 99}, {}, {}),
+    # the event-driven frontier rounds (ops/frontier.py) are their own
+    # dispatch shape with their own watchdog keys — stall them
+    # repeatedly and the retry/bisect/demote ladder must still land
+    # identical findings
+    "frontier_stall": ({"times": 99}, {}, {}),
     "probe_flap": ({"times": 1, "skip": 1}, {}, {}),
     "cdcl_error": ({"times": 1}, {}, {}),
     # prefetch only launches when the profit gate declines a frontier,

@@ -94,6 +94,19 @@ def main() -> None:
     row["word_decided_lanes"] = (
         row.get("word_decided_unsat", 0) + row.get("word_decided_sat", 0)
     )
+    # frontier-tier share: wall spent in event-driven frontier rounds
+    # (adjacency-gather BCP + in-kernel first-UIP learning) and the
+    # learned clauses harvested — the row already carries
+    # frontier_steps / learned_clauses via DispatchStats
+    frontier_s = sum(
+        seconds for name, seconds in totals.items()
+        if name.startswith("frontier.")
+    )
+    row["span_frontier_s"] = round(frontier_s, 3)
+    row["frontier_span_share"] = round(
+        frontier_s / row["total_wall_s"], 4
+    ) if row["total_wall_s"] else 0.0
+    row["frontier_learned_clauses"] = row.get("learned_clauses", 0)
 
     from mythril_tpu.smt.solver import get_blast_context
 

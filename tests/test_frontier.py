@@ -1,0 +1,502 @@
+"""Device-native propagation tests: the literal→clause adjacency
+index, event-driven frontier rounds (queue carry across rounds and
+bucket re-packs), in-kernel first-UIP clause learning against a
+brute-force oracle, the ``MYTHRIL_TPU_FRONTIER=0`` kill switch, and
+the bench/bench_compare surface of the tier.
+
+Marked ``frontier``: tier-1, CPU-only — the frontier kernel runs on
+the jax CPU backend exactly like the gather round kernels it extends.
+"""
+
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+from mythril_tpu.ops import batched_sat as BS
+from mythril_tpu.ops import frontier as FR
+from mythril_tpu.ops.batched_sat import BatchedSatBackend, dispatch_stats
+from mythril_tpu.ops.frontier import (
+    FRONTIER_STATE_FIELDS,
+    LitAdjacency,
+    build_adjacency,
+    frontier_enabled,
+    harvest_learned,
+)
+
+pytestmark = pytest.mark.frontier
+
+K = BS.MAX_CLAUSE_WIDTH
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Fresh stats per test; pin the tier's env knobs so ambient
+    MYTHRIL_TPU_* settings can't skew kernel shapes or assertions."""
+    for var in ("MYTHRIL_TPU_FRONTIER", "MYTHRIL_TPU_FRONTIER_PERIOD",
+                "MYTHRIL_TPU_FRONTIER_FAN", "MYTHRIL_TPU_FRONTIER_DEG"):
+        monkeypatch.delenv(var, raising=False)
+    dispatch_stats.reset()
+    yield
+    dispatch_stats.reset()
+
+
+class _HarvestCtx:
+    """Minimal blast-context stand-in for kernel-level tests: collects
+    harvested clauses instead of owning a native pool."""
+
+    device_learned = 0
+    device_learned_generation = 0
+
+    def __init__(self):
+        self.harvested = []
+
+    def harvest_device_clauses(self, clauses):
+        self.harvested.extend(tuple(sorted(int(x) for x in c))
+                              for c in clauses)
+        return len(clauses)
+
+
+def _rows(clauses):
+    rows = np.zeros((len(clauses), K), np.int32)
+    for i, cl in enumerate(clauses):
+        rows[i, : len(cl)] = cl
+    return rows
+
+
+def _brute_sat(clauses, nv, fixed=()):
+    """Brute-force SAT over vars 2..nv with var 1 pinned true."""
+    for bits in itertools.product([1, -1], repeat=nv - 1):
+        asg = {1: 1}
+        for i, b in enumerate(bits):
+            asg[i + 2] = b
+        if not all(asg[abs(l)] * (1 if l > 0 else -1) > 0 for l in fixed):
+            continue
+        if all(
+            any(asg[abs(l)] * (1 if l > 0 else -1) > 0 for l in cl)
+            for cl in clauses
+        ):
+            return True
+    return False
+
+
+def _brute_implied(clauses, nv, clause):
+    """formula ⊨ clause iff no model of the formula falsifies it."""
+    for bits in itertools.product([1, -1], repeat=nv - 1):
+        asg = {1: 1}
+        for i, b in enumerate(bits):
+            asg[i + 2] = b
+        if not all(
+            any(asg[abs(l)] * (1 if l > 0 else -1) > 0 for l in cl)
+            for cl in clauses
+        ):
+            continue
+        if not any(
+            asg[abs(l)] * (1 if l > 0 else -1) > 0 for l in clause
+        ):
+            return False
+    return True
+
+
+def _solve(backend, rows, assign, ctx=None, pref=None):
+    """Run the frontier ladder over dense rows; returns (status,
+    assignment, harvest ctx)."""
+    import jax.numpy as jnp
+
+    ctx = ctx or _HarvestCtx()
+    adj = build_adjacency(rows, assign.shape[1])
+    frontier = {"adj": jnp.asarray(adj), "ctx": ctx, "col_to_var": None}
+    st, fa = backend._solve_gather_ladder(
+        "gather", jnp.asarray(rows), assign, pref=pref, frontier=frontier
+    )
+    return st, fa, ctx
+
+
+# ------------------------------------------------------- adjacency
+
+
+def test_build_adjacency_rows_per_var():
+    rows = _rows([[1], [-2, 3], [-3, 4], [2, -4]])
+    adj = build_adjacency(rows, 5, deg=4)
+    assert sorted(adj[2][adj[2] >= 0].tolist()) == [1, 3]
+    assert sorted(adj[3][adj[3] >= 0].tolist()) == [1, 2]
+    assert sorted(adj[4][adj[4] >= 0].tolist()) == [2, 3]
+    assert adj[0].tolist() == [-1] * 4  # var 0 never occurs
+
+
+def test_build_adjacency_degree_cap_truncates():
+    clauses = [[2, 3]] * 10
+    adj = build_adjacency(_rows(clauses), 4, deg=4)
+    kept = adj[2][adj[2] >= 0]
+    assert len(kept) == 4  # truncated, not grown
+    assert set(kept.tolist()) <= set(range(10))
+
+
+def test_lit_adjacency_rows_for_vars():
+    urow = np.asarray([0, 0, 1, 1, 2], np.int64)
+    ulit = np.asarray([2, -3, 3, 4, -4], np.int32)
+    idx = LitAdjacency(urow, ulit, 3)
+    assert idx.rows_for_vars(np.asarray([3])).tolist() == [0, 1]
+    assert idx.rows_for_vars(np.asarray([4])).tolist() == [1, 2]
+    assert idx.rows_for_vars(np.asarray([2, 4])).tolist() == [0, 1, 2]
+    assert idx.rows_for_vars(np.asarray([99])).size == 0
+
+
+# ---------------------------------------------- kernel verdict parity
+
+
+def test_frontier_matches_dense_kernel_on_random_cnfs():
+    """On small random CNFs (fully decidable within one ladder) the
+    frontier rounds reach the same per-lane verdicts as the prior
+    dense round kernel, SAT models actually satisfy the clause set,
+    and UNSAT verdicts agree with the brute-force oracle."""
+    rng = np.random.default_rng(11)
+    backend = BatchedSatBackend()
+    import jax.numpy as jnp
+
+    for trial in range(8):
+        nv = int(rng.integers(5, 10))
+        clauses = [[1]]
+        for _ in range(int(rng.integers(8, 22))):
+            w = int(rng.integers(1, 4))
+            vs = rng.choice(np.arange(2, nv + 1), size=min(w, nv - 1),
+                            replace=False)
+            clauses.append(
+                [int(v) * int(rng.choice([1, -1])) for v in vs]
+            )
+        rows = _rows(clauses)
+        V1 = nv + 1
+        assign = np.zeros((3, V1), np.int8)
+        assign[:, 1] = 1
+        assign[1, 2] = 1
+        assign[2, 2] = -1
+        st_f, fa_f, ctx = _solve(backend, rows, assign)
+        st_d, _ = backend._solve_gather_ladder(
+            "gather", jnp.asarray(rows), assign
+        )
+        np.testing.assert_array_equal(st_f, st_d)
+        for lane, fixed in enumerate(([1], [1, 2], [1, -2])):
+            sat = _brute_sat(clauses, nv, fixed)
+            if st_f[lane] == 2:
+                assert not sat
+            if st_f[lane] == 1:
+                asg = fa_f[lane]
+                assert all(
+                    any(asg[abs(l)] * (1 if l > 0 else -1) > 0
+                        for l in cl)
+                    for cl in clauses
+                )
+        for cl in ctx.harvested:
+            assert _brute_implied(clauses, nv, list(cl)), (trial, cl)
+
+
+def test_frontier_steps_replace_full_sweeps():
+    """A BCP-ripple-heavy lane (a long implication chain) must burn
+    far fewer FULL sweeps under the frontier tier than the dense
+    kernel — the ≥10x sweeps-per-lane acceptance direction at unit
+    scale — with the ripple carried by cheap adjacency-gather steps."""
+    import jax.numpy as jnp
+
+    n = 40
+    clauses = [[1], [2]]  # unit var 2 starts the chain
+    clauses += [[-(v), v + 1] for v in range(2, n + 2)]
+    rows = _rows(clauses)
+    V1 = n + 3
+    assign = np.zeros((1, V1), np.int8)
+    assign[:, 1] = 1
+    backend = BatchedSatBackend()
+    st, fa, _ = _solve(backend, rows, assign)
+    assert st[0] == 1
+    assert all(fa[0, 2:n + 3] == 1)  # the whole chain propagated
+    frontier_full = dispatch_stats.device_sweeps
+    frontier_gather = dispatch_stats.frontier_steps
+    dispatch_stats.reset()
+    st_d, _ = backend._solve_gather_ladder(
+        "gather", jnp.asarray(rows), assign
+    )
+    assert st_d[0] == 1
+    dense_sweeps = dispatch_stats.device_sweeps
+    assert frontier_gather > 0
+    # the ripple (≈ one dense sweep per chain link) moved off the
+    # full-sweep counter
+    assert frontier_full * 5 <= dense_sweeps
+
+
+# -------------------------------------------------- first-UIP learning
+
+
+def test_first_uip_textbook_clause_equality():
+    """The classic implication-graph fixture: decision b=+1 (phase
+    pinned via warm start) forces x, then contradictory units on y —
+    the first UIP is x and the learned clause must be exactly (¬x).
+    This pins clause CONTENT, not just implication."""
+    clauses = [[1], [-2, 3], [-3, 4], [-3, -4], [2, 5], [2, 6]]
+    rows = _rows(clauses)
+    V1 = 7
+    assign = np.zeros((1, V1), np.int8)
+    assign[:, 1] = 1
+    pref = np.zeros(V1, np.int8)
+    pref[2] = 1  # decide b=+1 first: the conflict branch
+    backend = BatchedSatBackend()
+    st, fa, ctx = _solve(backend, rows, assign, pref=pref)
+    assert st[0] == 1  # backtracked to b=-1 and completed
+    assert (-3,) in ctx.harvested
+    assert dispatch_stats.learned_clauses == len(set(ctx.harvested))
+
+
+def test_learned_clauses_sound_under_assumptions():
+    """Learned clauses are derived by resolution over pool rows only,
+    so they are implied by the FORMULA — never weakened to one lane's
+    assumption cube (the property that makes the shared-pool append
+    sound for every lane)."""
+    rng = np.random.default_rng(23)
+    backend = BatchedSatBackend()
+    for _ in range(4):
+        nv = int(rng.integers(6, 10))
+        clauses = [[1]]
+        for _ in range(int(rng.integers(12, 24))):
+            vs = rng.choice(np.arange(2, nv + 1),
+                            size=min(3, nv - 1), replace=False)
+            clauses.append(
+                [int(v) * int(rng.choice([1, -1])) for v in vs]
+            )
+        rows = _rows(clauses)
+        V1 = nv + 1
+        assign = np.zeros((4, V1), np.int8)
+        assign[:, 1] = 1
+        for lane in range(1, 4):  # conflicting assumption spreads
+            assign[lane, 2 + (lane - 1) % (nv - 1)] = (
+                1 if lane % 2 else -1
+            )
+        _, _, ctx = _solve(backend, rows, assign)
+        for cl in ctx.harvested:
+            assert _brute_implied(clauses, nv, list(cl)), cl
+
+
+def test_harvest_learned_remaps_and_dedupes():
+    """Cone-tier harvest: compact column ids map back to pool vars via
+    col_to_var, duplicates collapse, and rows referencing columns
+    outside the map are dropped."""
+    ctx = _HarvestCtx()
+    col_to_var = np.asarray([0, 1, 17, 23], np.int64)
+    rows = [
+        np.asarray([-2, 3, 0, 0], np.int32),
+        np.asarray([3, -2, 0, 0], np.int32),   # same clause, reordered
+        np.asarray([-9, 0, 0, 0], np.int32),   # column 9 unmapped
+    ]
+    accepted = harvest_learned(ctx, rows, col_to_var)
+    assert accepted == 1
+    assert ctx.harvested == [(-17, 23)]
+
+
+# -------------------------------------- ladder integration / repacks
+
+
+def test_frontier_queue_carries_across_repacks():
+    """Lanes retiring at different rounds force survivor re-packs; the
+    frontier state (queues, trail, learned buffers) must compact with
+    the lanes and the straggler must still finish correctly."""
+    # the chain is strictly sequential (one forced var per frontier
+    # step), so a length past round 1's iteration budget (64 sweeps x
+    # FRONTIER_BUDGET_MULT) guarantees the straggler survives into a
+    # re-packed round 2
+    n = 64 * FR.FRONTIER_BUDGET_MULT + 60
+    clauses = [[1]]
+    # easy block: vars 2..5 pinned SAT by units
+    clauses += [[v] for v in range(2, 6)]
+    # straggler chain over vars 6..: only engaged under assumption
+    clauses += [[-(v), v + 1] for v in range(6, 6 + n)]
+    rows = _rows(clauses)
+    V1 = 6 + n + 1
+    assign = np.zeros((6, V1), np.int8)
+    assign[:, 1] = 1
+    # five easy lanes: direct contradiction with a unit -> retire in
+    # round 1; one straggler starts the chain
+    for lane in range(5):
+        assign[lane, 2 + lane % 4] = -1
+    assign[5, 6] = 1
+    backend = BatchedSatBackend()
+    st, fa, _ = _solve(backend, rows, assign)
+    assert (st[:5] == 2).all()          # contradicted lanes: sound UNSAT
+    assert st[5] == 1                   # straggler completed
+    assert all(fa[5, 6:6 + n + 1] == 1)  # chain fully propagated
+    assert dispatch_stats.repacks >= 1  # survivors were re-packed
+
+
+def test_kill_switch_restores_dense_rounds(monkeypatch):
+    """MYTHRIL_TPU_FRONTIER=0: callers stop building frontier inputs
+    and the ladder runs the exact prior dense round kernel (the A/B
+    pin bench_compare's parity claim rests on)."""
+    monkeypatch.setenv("MYTHRIL_TPU_FRONTIER", "0")
+    assert not frontier_enabled()
+    monkeypatch.delenv("MYTHRIL_TPU_FRONTIER")
+    assert frontier_enabled()
+
+    import jax.numpy as jnp
+
+    rows = _rows([[1], [2, 3], [-2, 3]])
+    assign = np.zeros((2, 4), np.int8)
+    assign[:, 1] = 1
+    backend = BatchedSatBackend()
+    calls = {"dense": 0, "frontier": 0}
+    orig_dense = backend._cached_round
+    orig_frontier = backend._cached_frontier_round
+
+    def count_dense(bucket, budget):
+        calls["dense"] += 1
+        return orig_dense(bucket, budget)
+
+    def count_frontier(bucket, budget):
+        calls["frontier"] += 1
+        return orig_frontier(bucket, budget)
+
+    monkeypatch.setattr(backend, "_cached_round", count_dense)
+    monkeypatch.setattr(backend, "_cached_frontier_round", count_frontier)
+    backend._solve_gather_ladder("gather", jnp.asarray(rows), assign)
+    assert calls == {"dense": 1, "frontier": 0}
+    adj = build_adjacency(rows, 4)
+    backend._solve_gather_ladder(
+        "gather", jnp.asarray(rows), assign,
+        frontier={"adj": jnp.asarray(adj), "ctx": _HarvestCtx(),
+                  "col_to_var": None},
+    )
+    assert calls["frontier"] >= 1
+
+
+def test_frontier_stall_fault_walks_retry_ladder():
+    """An injected frontier_stall raises inside the supervised round
+    thunk: the retry rung absorbs it and the verdicts are identical to
+    the fault-free run (the chaos invariant on the new dispatch
+    shape)."""
+    from mythril_tpu.resilience import faults, watchdog
+    from mythril_tpu.resilience.telemetry import resilience_stats
+
+    faults.reset_for_tests()
+    watchdog.reset_for_tests()
+    rows = _rows([[1], [-2, 3], [2, 3]])
+    assign = np.zeros((2, 4), np.int8)
+    assign[:, 1] = 1
+    backend = BatchedSatBackend()
+    st_clean, _, _ = _solve(backend, rows, assign)
+    faults.get_fault_plane().arm("frontier_stall", times=1)
+    retries_before = resilience_stats.dispatch_retries
+    st_fault, _, _ = _solve(backend, rows, assign)
+    np.testing.assert_array_equal(st_clean, st_fault)
+    assert resilience_stats.dispatch_retries > retries_before
+    assert faults.get_fault_plane().fired.get("frontier_stall") == 1
+    faults.reset_for_tests()
+    watchdog.reset_for_tests()
+
+
+def test_frontier_state_fields_cover_ladder_contract():
+    """The ladder re-packs every field along axis 0 and resets the
+    per-round counters by name — the order tuple must carry them."""
+    for key in ("status", "fullsw", "fsteps", "nlearn", "learned",
+                "recent", "pref"):
+        assert key in FRONTIER_STATE_FIELDS
+
+
+def test_frontier_findings_parity_end_to_end(monkeypatch):
+    """Corpus-style analysis over the chaos-tree contract with the
+    tier on vs MYTHRIL_TPU_FRONTIER=0: identical SWC findings (the
+    acceptance invariant at tier-1 size) and the tier's telemetry
+    footprint — frontier steps on, zeroed by the kill switch."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_faults import _analyze
+
+    import jax
+
+    real_devices = jax.devices()
+    monkeypatch.setattr(jax, "devices",
+                        lambda backend=None: list(real_devices[:1]))
+    monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setattr(args, "device_min_lanes", 2)
+    monkeypatch.setattr(args, "device_force_dispatch", True)
+    monkeypatch.setattr(args, "async_dispatch", False)
+    monkeypatch.setattr(args, "word_probing", False)
+    monkeypatch.setattr(args, "batch_width", 32)
+    monkeypatch.setattr(args, "device_coalesce", False)
+
+    from mythril_tpu.smt.solver import reset_blast_context
+
+    try:
+        found_on, row_on = _analyze()
+        monkeypatch.setenv("MYTHRIL_TPU_FRONTIER", "0")
+        reset_blast_context()
+        found_off, row_off = _analyze()
+    finally:
+        reset_blast_context()
+    assert found_on == found_off
+    assert "106" in found_on
+    assert row_on["dispatches"] > 0 and row_off["dispatches"] > 0
+    assert row_on["frontier_steps"] > 0   # the tier actually engaged
+    assert row_off["frontier_steps"] == 0  # and the switch kills it
+
+
+# ----------------------------------------- bench / gate surface
+
+
+def test_headline_carries_sweeps_per_lane_and_learned():
+    import bench
+
+    summary = {
+        "metric": "analyze_corpus_wall_s", "value": 8.2, "unit": "s",
+        "vs_baseline": 80.2, "mode": "full",
+        "device_status": "healthy", "device_dispatches": 13,
+        "mesh_dispatches": 0, "solver_split": {"device_s": 5.08},
+        "sweeps_per_lane": 5.4, "learned_clauses": 37,
+    }
+    import json
+
+    payload = json.loads(bench.build_headline_line(summary, None, None))
+    assert payload["sweeps_per_lane"] == 5.4
+    assert payload["learned_clauses"] == 37
+    # adversarial cap pressure: the new keys stay droppable
+    summary["error"] = "missed findings: " + "x" * 1000
+    line = bench.build_headline_line(summary, None, None)
+    assert len(line) <= 500
+
+    micro = {"device_warm_s": 0.226, "device_vs_host": 3.1}
+    summary.pop("error")
+    payload = json.loads(bench.build_headline_line(summary, None, micro))
+    assert payload["microbench_device_vs_host"] == 3.1
+    assert "microbench_speedup" not in payload
+
+
+def test_scale_summary_derives_sweeps_per_lane():
+    import bench
+
+    row = {
+        "wall_s": 1.0, "device_sweeps": 120, "unsat": 10,
+        "sat_verified": 14, "frontier_steps": 900,
+        "learned_clauses": 6, "found": ["106"],
+    }
+    out = bench._scale_summary(row)
+    assert out["sweeps_per_lane"] == 5.0
+    assert out["frontier_steps"] == 900
+    assert out["learned_clauses"] == 6
+
+
+def test_bench_compare_gates_frontier_metrics():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare_frontier",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "bench_compare.py"),
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    assert "sweeps_per_lane" in module.GATED
+    assert "microbench_device_vs_host" in module.GATED_HIGHER_BETTER
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -329,6 +329,109 @@ def test_warm_start_findings_parity_end_to_end(monkeypatch):
     assert row_off["delta_uploads"] == 0
 
 
+# ------------------------------------- learned-clause append path
+
+
+def test_learned_clauses_append_as_delta_uploads():
+    """Device-learned first-UIP clauses (ops/frontier.py harvest)
+    bump the pool version and reach the resident device pool as an
+    append-only DELTA upload on the next dispatch — never a full
+    rebuild — and the appended rows mirror the learned literals."""
+    ctx, lits = _ctx_with_clauses(2)
+    backend = BatchedSatBackend()
+    nv = ctx.solver.num_vars
+    backend._sync_pool_and_assign(ctx, [lits], nv)
+    assert dispatch_stats.pool_uploads == 1
+    filled = backend.pool.filled
+
+    clause = [-lits[0], -lits[1]]
+    assert ctx.harvest_device_clauses([clause]) == 1
+    assert ctx.device_learned == 1
+    backend._sync_pool_and_assign(ctx, [lits], ctx.solver.num_vars)
+    assert dispatch_stats.pool_uploads == 1   # no rebuild
+    assert dispatch_stats.delta_uploads == 1  # the learned row shipped
+    assert backend.pool.filled == filled + 1
+    appended = backend.pool.lits_np[filled]
+    assert sorted(appended[appended != 0].tolist()) == sorted(clause)
+
+
+def test_learned_clauses_survive_warm_start_dispatches():
+    """A warm-start (unchanged-pool) dispatch after a learned append
+    must keep the learned rows resident: repeat syncs ship assumption
+    columns only, and the rows stay in both mirrors."""
+    ctx, lits = _ctx_with_clauses(2)
+    backend = BatchedSatBackend()
+    ctx.harvest_device_clauses([[-lits[0], -lits[1]]])
+    backend._sync_pool_and_assign(ctx, [lits], ctx.solver.num_vars)
+    filled = backend.pool.filled
+    dispatch_stats.h2d_bytes = 0
+    assign = backend._sync_pool_and_assign(ctx, [lits],
+                                           ctx.solver.num_vars)
+    assert dispatch_stats.h2d_bytes == assign.nbytes  # assumptions only
+    assert backend.pool.filled == filled
+    np.testing.assert_array_equal(
+        np.asarray(backend.pool.lits)[:filled],
+        backend.pool.lits_np[:filled],
+    )
+
+
+def test_learned_rows_survive_reset_resident_pools():
+    """Checkpoint-resume invalidation (reset_resident_pools) drops the
+    device mirror but NOT the learned clauses: they live in the native
+    pool, so the forced full rebuild re-ships them."""
+    ctx, lits = _ctx_with_clauses(2)
+    backend = BS.get_backend()
+    ctx.harvest_device_clauses([[-lits[0], -lits[1]]])
+    backend._sync_pool_and_assign(ctx, [lits], ctx.solver.num_vars)
+    rows_before = backend.pool.filled
+    BS.reset_resident_pools()
+    assert backend.pool_generation == -1
+    backend._sync_pool_and_assign(ctx, [lits], ctx.solver.num_vars)
+    assert backend.pool.filled == rows_before  # learned row still aboard
+    assert dispatch_stats.pool_uploads >= 2    # via a full rebuild
+
+
+def test_frontier_kill_switch_preserves_learned_rows(monkeypatch):
+    """MYTHRIL_TPU_FRONTIER=0 switches the round kernel, not the
+    clause store: already-harvested clauses stay in the pool and keep
+    shipping with rebuilds."""
+    monkeypatch.setenv("MYTHRIL_TPU_FRONTIER", "0")
+    ctx, lits = _ctx_with_clauses(2)
+    backend = BatchedSatBackend()
+    ctx.harvest_device_clauses([[-lits[0], -lits[1]]])
+    backend._sync_pool_and_assign(ctx, [lits], ctx.solver.num_vars)
+    mat = backend.pool.lits_np[: backend.pool.filled]
+    assert any(
+        sorted(row[row != 0].tolist()) == sorted([-lits[0], -lits[1]])
+        for row in mat
+    )
+
+
+def test_cone_memo_scopes_on_learned_generation():
+    """A device-learned harvest must invalidate memoized cone layouts:
+    the scope key carries the learned-clause generation explicitly."""
+    ctx, lits = _ctx_with_clauses(2)
+    memo = ConeMemo()
+    memo.cone(ctx, lits[:1])
+    memo.cone(ctx, lits[:1])
+    assert dispatch_stats.cone_memo_hits == 1
+    assert ctx.harvest_device_clauses([[-lits[0], -lits[1]]]) == 1
+    memo.cone(ctx, lits[:1])  # scope moved: a miss, fresh walk
+    assert dispatch_stats.cone_memo_hits == 1
+
+
+def test_harvest_rejected_under_proof_log(monkeypatch):
+    """An in-kernel resolution is not replayable by the proof checker:
+    --proof-log runs harvest nothing (same rule as uncertified
+    nogoods)."""
+    from mythril_tpu.support.support_args import args
+
+    ctx, lits = _ctx_with_clauses(1)
+    monkeypatch.setattr(args, "proof_log", True)
+    assert ctx.harvest_device_clauses([[-lits[0]]]) == 0
+    assert ctx.device_learned == 0
+
+
 # ------------------------------------------- checkpoint interplay
 
 
