@@ -793,6 +793,71 @@ def _serve_microbench(cold_cli_wall_s=None):
         server.drain_and_stop("bench done")
 
 
+def _fleet_microbench():
+    """Fleet headline pair: the shardable chaos-tree workload at
+    ``--workers 2`` vs ``--workers 1`` (both sides pay worker spawn +
+    IPC, so the ratio isolates the subtree-sharding win, reported as
+    ``fleet_speedup`` and gated higher-is-better in
+    scripts/bench_compare.py), plus a preemption round with
+    ``worker_kill`` armed in the worker environment — every worker is
+    SIGKILLed at its first transaction boundary and the run must still
+    land the expected finding, reporting the deaths it absorbed as
+    ``worker_deaths_recovered``."""
+    from mythril_tpu.parallel import fleet as fleet_mod
+    from mythril_tpu.resilience import faults
+    from mythril_tpu.support.support_args import args
+
+    code = chaos_tree_contract()
+    saved_workers = args.fleet_workers
+    saved_fault = os.environ.get("MYTHRIL_TPU_FAULT")
+    out = {}
+    try:
+        walls = {}
+        for workers in (1, 2):
+            args.fleet_workers = workers
+            fleet_mod.reset_fleet_for_tests()
+            began = time.monotonic()
+            found, row = _analyze_one(
+                f"fleet_w{workers}", code, 2,
+                execution_timeout=300, max_depth=128,
+            )
+            walls[workers] = time.monotonic() - began
+            if "106" not in found:
+                return {"error": f"--workers {workers} missed SWC-106 "
+                                 f"(found {sorted(found)})"}
+            out[f"wall_w{workers}_s"] = round(walls[workers], 2)
+            out[f"leases_w{workers}"] = row.get("fleet_leases", 0)
+        out["fleet_speedup"] = round(walls[1] / walls[2], 2)
+        # preemption round: worker_kill rides the env so the WORKERS
+        # arm it (the point never fires coordinator-side); respawned
+        # replacements shed the spec and finish the leases
+        os.environ["MYTHRIL_TPU_FAULT"] = "worker_kill:1"
+        faults.reset_for_tests()
+        args.fleet_workers = 2
+        fleet_mod.reset_fleet_for_tests()
+        found, row = _analyze_one(
+            "fleet_kill", code, 2, execution_timeout=300,
+            max_depth=128,
+        )
+        deaths = row.get("fleet_worker_deaths", 0)
+        out["worker_deaths_recovered"] = (
+            deaths if "106" in found and deaths else 0
+        )
+        if "106" not in found:
+            out["error"] = (
+                f"preemption round missed SWC-106 (found "
+                f"{sorted(found)})"
+            )
+        return out
+    finally:
+        args.fleet_workers = saved_workers
+        if saved_fault is None:
+            os.environ.pop("MYTHRIL_TPU_FAULT", None)
+        else:
+            os.environ["MYTHRIL_TPU_FAULT"] = saved_fault
+        faults.reset_for_tests()
+
+
 def _scale_summary(row):
     keys = (
         "wall_s", "dispatches", "lanes", "unsat", "sat_verified",
@@ -912,11 +977,20 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
         # regressing up or contracts/min regressing down trips it)
         headline["serve_warm_p50_s"] = summary["serve_warm_p50_s"]
         headline["serve_cpm"] = summary.get("serve_cpm")
+    if isinstance(summary.get("fleet_speedup"), (int, float)):
+        # frontier-fleet pair: sharded-vs-one-worker corpus wall
+        # (gated higher-is-better in bench_compare) and the worker
+        # SIGKILLs the preemption round absorbed at unchanged findings
+        headline["fleet_speedup"] = summary["fleet_speedup"]
+        headline["worker_deaths_recovered"] = summary.get(
+            "worker_deaths_recovered", 0
+        )
     if "error" in summary:
         headline["error"] = str(summary["error"])[:160]
     line = json.dumps(headline)
     if len(line) > 500:  # hard cap so the tail capture can never lose it
-        for key in ("microbench_device_vs_host",
+        for key in ("worker_deaths_recovered", "fleet_speedup",
+                    "microbench_device_vs_host",
                     "microbench_device_warm_s",
                     "serve_cpm", "serve_warm_p50_s",
                     "mesh_row_ok", "trace_overhead_s", "word_prop_s",
@@ -1075,6 +1149,17 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001 — bench must not die here
             serve_bench = {"error": str(exc)[:200]}
     print(json.dumps({"serve_microbench": serve_bench}), file=sys.stderr)
+    # frontier-fleet microbench (parallel/fleet.py): sharded corpus
+    # wall at --workers 2 vs 1 + a preemption-recovery round; runs
+    # after the timed passes for the same isolation reason as serve
+    if quick:
+        fleet_bench = {"skipped": "--quick run"}
+    else:
+        try:
+            fleet_bench = _fleet_microbench()
+        except Exception as exc:  # noqa: BLE001 — bench must not die here
+            fleet_bench = {"error": str(exc)[:200]}
+    print(json.dumps({"fleet_microbench": fleet_bench}), file=sys.stderr)
     summary = {
         "metric": "analyze_corpus_wall_s",
         "value": round(wall, 2),
@@ -1209,6 +1294,12 @@ def main() -> None:
     if isinstance(serve_bench.get("warm_p50_s"), (int, float)):
         summary["serve_warm_p50_s"] = serve_bench["warm_p50_s"]
         summary["serve_cpm"] = serve_bench["contracts_per_min"]
+    summary["fleet_microbench"] = fleet_bench
+    if isinstance(fleet_bench.get("fleet_speedup"), (int, float)):
+        summary["fleet_speedup"] = fleet_bench["fleet_speedup"]
+        summary["worker_deaths_recovered"] = fleet_bench.get(
+            "worker_deaths_recovered", 0
+        )
     # headline sweep utilization: over the corpus pass AND the scale
     # scenarios (the corpus's narrow frontiers rarely dispatch, so the
     # scale rows are where the ratio carries signal)

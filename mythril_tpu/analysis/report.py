@@ -258,6 +258,17 @@ class Report:
             degraded = {
                 k: v for k, v in resilience_stats.as_dict().items() if v
             }
+            # fleet counters (parallel/fleet.py): a report produced by
+            # a sharded run says so in-band — findings are identical to
+            # single-process by construction, but worker deaths /
+            # rebalances explain recovered wall-clock, and a nonzero
+            # stale-gossip drop count records the epoch fence firing
+            from mythril_tpu.parallel.fleet import fleet_stats
+
+            degraded.update({
+                f"fleet_{k}": v
+                for k, v in fleet_stats.as_dict().items() if v
+            })
             if drain_requested() or get_checkpoint_plane().partial:
                 # a drained run reports what it had at the last
                 # cooperative checkpoint — consumers must not read the

@@ -272,6 +272,18 @@ def create_analyzer_parser(analyzer_parser: argparse.ArgumentParser) -> None:
         "blocking; see ops/async_dispatch.py)",
     )
     options.add_argument(
+        "--workers",
+        type=int,
+        default=None,
+        metavar="N",
+        help="Shard the transaction-boundary frontier across N fleet "
+        "worker processes (coordinator/worker leases with heartbeat "
+        "failure detection, journal re-lease, and epoch-fenced "
+        "knowledge gossip — docs/scaling.md).  0 forces the exact "
+        "single-process path; default defers to "
+        "MYTHRIL_TPU_FLEET_WORKERS (kill switch MYTHRIL_TPU_FLEET=0)",
+    )
+    options.add_argument(
         "--checkpoint-dir",
         help="Journal the analysis (frontier, findings, solver memo "
         "channels) into this directory so a preempted run can be "
@@ -605,6 +617,7 @@ def _build_analyzer(
         async_dispatch=not args.no_async_dispatch,
         checkpoint_dir=getattr(args, "checkpoint_dir", None),
         resume_from=getattr(args, "resume_dir", None),
+        fleet_workers=getattr(args, "workers", None),
         strategy=args.strategy,
         disassembler=disassembler,
         address=address,

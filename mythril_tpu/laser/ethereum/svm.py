@@ -206,6 +206,20 @@ class LaserEVM:
                 self.aborted_at_tx = i
                 obs.instant("svm.drain_boundary", cat="svm", tx=i)
                 break
+            # fleet seam (parallel/fleet.py): in a fleet worker this is
+            # the gossip/heartbeat boundary; in the coordinating
+            # process a wide-enough frontier is sharded into subtree
+            # leases here and the workers run the remaining
+            # transactions (True = they, plus any in-process fallback,
+            # completed them).  With the fleet off (--workers 0 /
+            # MYTHRIL_TPU_FLEET=0) seam_enabled() is False and this is
+            # the exact single-process path.
+            from mythril_tpu.parallel import fleet
+
+            if fleet.seam_enabled() and fleet.svm_boundary(
+                self, address, i
+            ):
+                break
             # Frontier pruning across transactions: the reference issues
             # one solver call per open state (svm.py:201-204); here the
             # whole frontier goes through one batched pass.

@@ -86,6 +86,7 @@ class MythrilAnalyzer:
         async_dispatch: bool = True,
         checkpoint_dir: Optional[str] = None,
         resume_from: Optional[str] = None,
+        fleet_workers: Optional[int] = None,
     ):
         self.eth = disassembler.eth
         self.contracts: List[EVMContract] = disassembler.contracts or []
@@ -121,6 +122,10 @@ class MythrilAnalyzer:
         # boundary); --resume implies journaling into the same dir
         args.checkpoint_dir = checkpoint_dir or resume_from
         args.resume_from = resume_from
+        # frontier fleet: --workers N shards the transaction-boundary
+        # frontier across N worker processes (parallel/fleet.py); None
+        # defers to MYTHRIL_TPU_FLEET_WORKERS, 0 forces single-process
+        args.fleet_workers = fleet_workers
 
     # ------------------------------------------------------------------
     # symbolic-executor factory — single assembly point for every mode

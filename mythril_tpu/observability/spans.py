@@ -264,6 +264,40 @@ class Tracer:
 
         get_flight_recorder().record(event)
 
+    def absorb_events(self, events: list) -> int:
+        """Merge pre-built trace events from another process (a fleet
+        worker's span stream) into the buffer so ``--trace-out``
+        renders one timeline — worker events keep their own ``pid``,
+        so Perfetto shows them as separate process tracks.  Per-name
+        *totals* are deliberately NOT updated: the phase buckets
+        (cone/sweep/tail...) describe THIS process's wall, and folding
+        a worker's spans in would double-count time the coordinator
+        spent waiting on it.  Returns the number absorbed."""
+        absorbed = 0
+        with self._lock:
+            for event in events:
+                if not isinstance(event, dict) or "ph" not in event:
+                    continue
+                if len(self._events) < self._cap:
+                    if self.record_events:
+                        self._events.append(event)
+                    self.span_count += int(event.get("ph") == "X")
+                    self.instant_count += int(event.get("ph") == "i")
+                    absorbed += 1
+                else:
+                    self.dropped += 1
+        return absorbed
+
+    def add_external_total(self, name: str, seconds: float) -> None:
+        """Account wall-clock measured outside this process (a fleet
+        worker's lease wall) under a span name, totals/counts only —
+        feeds per-worker share reporting in scripts/profile_t3.py and
+        the bench fleet microbench without fabricating timeline
+        events."""
+        with self._lock:
+            self._totals[name] = self._totals.get(name, 0.0) + seconds
+            self._counts[name] = self._counts.get(name, 0) + 1
+
     # -- export / aggregation ------------------------------------------
 
     def events(self) -> list:

@@ -121,6 +121,11 @@ class DispatchStats:
         from mythril_tpu.ops.incremental import reset_cone_memo
 
         reset_cone_memo()
+        # fleet counters (parallel/fleet.py) are per-contract in bench
+        # rows / meta.resilience, same as the resilience counters
+        from mythril_tpu.parallel.fleet import fleet_stats
+
+        fleet_stats.reset()
 
     def _reset_own(self):
         self.dispatches = 0        # device solve invocations
@@ -221,10 +226,15 @@ class DispatchStats:
         self.learned_clauses = 0
 
     def as_dict(self):
+        from mythril_tpu.parallel.fleet import fleet_stats
         from mythril_tpu.resilience.telemetry import resilience_stats
 
         d = dict(self.__dict__)
         d.update(resilience_stats.as_dict())
+        d.update({
+            f"fleet_{key}": value
+            for key, value in fleet_stats.as_dict().items()
+        })
         return d
 
 
@@ -1638,6 +1648,14 @@ def reset_resident_pools() -> None:
         _backend.pool_generation = -1
     reset_cone_memo()
     reset_word_tier()
+    # the sharded-mesh caches hold a Mesh over a device topology and
+    # jitted shard_map solves keyed by id(mesh): a checkpoint resume or
+    # serve decontamination that kept them could serve a solve compiled
+    # for a dead topology (or collide on a recycled mesh id) — drop
+    # them with everything else device-resident
+    from mythril_tpu.parallel.mesh import reset_mesh_caches
+
+    reset_mesh_caches()
 
 
 def batch_check_states(constraint_sets) -> List[Optional[bool]]:

@@ -1,0 +1,195 @@
+"""Epoch-fenced knowledge gossip for the frontier fleet.
+
+Three concerns live here, all transport-level and coordinator/worker
+agnostic:
+
+1. **Framing** — one socket message is a length-prefixed JSON header
+   plus a length-prefixed binary body (pickle or empty).  The header
+   carries routing/typing (``type``, ``lease_id``, the epoch stamp);
+   the body carries whatever must survive a process boundary through
+   the checkpoint plane's reducers (world-states, solver channels,
+   detection issues).  The same fail-at-the-edge posture as
+   ``serve/protocol.py``: an oversized or malformed frame raises
+   :class:`FrameError` at the boundary it arrived on, never a
+   traceback three layers deep.
+
+2. **Stamps** — every knowledge message carries the sending worker's
+   ``(generation, pool_version, lease_epoch)``.  ``generation`` and
+   ``pool_version`` scope the payload to the solver state that
+   produced it (the same scoping the cone memo uses);
+   ``lease_epoch`` is the fleet's fencing token: the coordinator bumps
+   it on every re-lease, so a zombie worker resuming after a partition
+   carries a stale epoch and its payloads are dropped before they can
+   touch the shared channels.
+
+3. **Knowledge freeze/apply** — the globally-valid solver channels
+   (permanent UNSAT memos, the SAT half of the probe memo, recent
+   warm-start models) cross processes in the checkpoint plane's
+   journal form (``freeze_channels``/node re-interning reducers), and
+   are applied MONOTONICALLY: apply only ever adds memo entries and
+   models, so a gossip message can widen what a worker already knows
+   but never invalidate it.  Literal-level state (CNF pool rows,
+   device nogoods) deliberately never gossips — literal numbering is
+   an artifact of each process's blast order (the PR-3 journal rule);
+   device-learned clauses reach the fleet as the UNSAT memos they
+   refute into, which are node-level and sound everywhere.
+"""
+
+import json
+import pickle
+import struct
+from dataclasses import dataclass
+
+#: frame caps: a header is routing metadata (tiny); a body is at most a
+#: frontier snapshot or a channel freeze.  Past these the peer is
+#: garbage or hostile — fail loudly, don't allocate.
+MAX_HEADER_BYTES = 1 << 20
+MAX_BODY_BYTES = 1 << 30
+
+_HEADER_LEN = struct.Struct("!I")
+_BODY_LEN = struct.Struct("!Q")
+
+
+class FrameError(RuntimeError):
+    """A malformed or oversized frame (or a peer that hung up
+    mid-frame).  The connection is unusable after this."""
+
+
+@dataclass(frozen=True)
+class Stamp:
+    """The epoch fence every gossip/result message carries."""
+
+    generation: int = 0
+    pool_version: int = 0
+    lease_epoch: int = 0
+
+    def as_dict(self) -> dict:
+        return {
+            "generation": int(self.generation),
+            "pool_version": int(self.pool_version),
+            "lease_epoch": int(self.lease_epoch),
+        }
+
+    @classmethod
+    def from_header(cls, header: dict) -> "Stamp":
+        stamp = header.get("stamp") or {}
+        return cls(
+            generation=int(stamp.get("generation", 0)),
+            pool_version=int(stamp.get("pool_version", 0)),
+            lease_epoch=int(stamp.get("lease_epoch", 0)),
+        )
+
+
+def stamp_for(ctx, lease_epoch: int) -> Stamp:
+    """The current stamp of a blast context under a lease."""
+    return Stamp(
+        generation=getattr(ctx, "generation", 0),
+        pool_version=getattr(ctx, "pool_version", 0),
+        lease_epoch=lease_epoch,
+    )
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+def send_frame(sock, header: dict, body: bytes = b"") -> None:
+    """Write one frame.  The caller serializes concurrent senders (the
+    worker's heartbeat thread and its analysis thread share one socket
+    under a lock)."""
+    head = json.dumps(header).encode("utf-8")
+    if len(head) > MAX_HEADER_BYTES:
+        raise FrameError(f"header too large ({len(head)} bytes)")
+    if len(body) > MAX_BODY_BYTES:
+        raise FrameError(f"body too large ({len(body)} bytes)")
+    sock.sendall(
+        _HEADER_LEN.pack(len(head)) + head + _BODY_LEN.pack(len(body))
+        + body
+    )
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    chunks = []
+    remaining = n
+    while remaining:
+        chunk = sock.recv(min(remaining, 1 << 20))
+        if not chunk:
+            raise FrameError("peer closed mid-frame")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock):
+    """Read one frame; returns ``(header_dict, body_bytes)``.  Raises
+    :class:`FrameError` on truncation, caps, or a header that is not a
+    JSON object."""
+    (head_len,) = _HEADER_LEN.unpack(_recv_exact(sock, _HEADER_LEN.size))
+    if head_len > MAX_HEADER_BYTES:
+        raise FrameError(f"header length {head_len} exceeds cap")
+    head = _recv_exact(sock, head_len)
+    try:
+        header = json.loads(head.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+        raise FrameError(f"bad frame header: {exc}") from exc
+    if not isinstance(header, dict) or "type" not in header:
+        raise FrameError("frame header must be an object with a 'type'")
+    (body_len,) = _BODY_LEN.unpack(_recv_exact(sock, _BODY_LEN.size))
+    if body_len > MAX_BODY_BYTES:
+        raise FrameError(f"body length {body_len} exceeds cap")
+    body = _recv_exact(sock, body_len) if body_len else b""
+    return header, body
+
+
+# ---------------------------------------------------------------------------
+# knowledge freeze / monotone apply
+# ---------------------------------------------------------------------------
+
+
+def freeze_knowledge(ctx) -> bytes:
+    """Snapshot the globally-valid channels of ``ctx`` in journal form
+    (node-object keys, re-interned on load — the PR-3 serialization)."""
+    from mythril_tpu.resilience.checkpoint import (
+        _install_reducers, freeze_channels,
+    )
+
+    _install_reducers()
+    return pickle.dumps(freeze_channels(ctx), protocol=4)
+
+
+def apply_knowledge(ctx, body: bytes) -> dict:
+    """Monotonically merge a frozen channel snapshot into ``ctx``:
+    UNSAT memo entries and SAT probe memos are added if absent, models
+    extend the recent set (newest-first insertion, existing cap kept).
+    Never removes or overwrites — a gossip application can only widen
+    what the receiver knows, so findings are unaffected by message
+    order, duplication, or loss.  Returns counts for telemetry."""
+    from mythril_tpu.resilience.checkpoint import (
+        _install_reducers, _thaw_env,
+    )
+
+    _install_reducers()
+    channels = pickle.loads(body)
+    added_unsat = added_probe = added_models = 0
+    for nodes in channels.get("unsat_sets", ()):
+        key = tuple(sorted(n.id for n in nodes))
+        if key not in ctx.unsat_memo:
+            ctx.note_unsat(nodes)
+            added_unsat += 1
+    for nodes, frozen in channels.get("probe_sat", ()):
+        key = tuple(sorted(n.id for n in nodes))
+        if key not in ctx.probe_memo:
+            ctx.probe_memo[key] = _thaw_env(frozen)
+            added_probe += 1
+    for frozen in channels.get("models", ()):
+        env = _thaw_env(frozen)
+        before = len(ctx.recent_models)
+        ctx._remember_model(env)
+        if len(ctx.recent_models) >= before:
+            added_models += 1
+    return {
+        "unsat": added_unsat,
+        "probe_sat": added_probe,
+        "models": added_models,
+    }

@@ -40,6 +40,20 @@ _mesh_cache = None
 _solve_cache = {}
 
 
+def reset_mesh_caches() -> None:
+    """Drop the process-wide mesh and its jitted shard_map solves.
+
+    Called from ``ops.batched_sat.reset_resident_pools`` (checkpoint
+    resume, serve decontamination, tests): the mesh captures a device
+    topology and ``_solve_cache`` keys on ``id(mesh)``, so keeping
+    either across a resume could serve a solve compiled for a dead
+    topology — or, worse, collide on a garbage-collected mesh whose id
+    was recycled by a new one."""
+    global _mesh_cache
+    _mesh_cache = None
+    _solve_cache.clear()
+
+
 def get_mesh():
     """Process-wide default mesh over all visible devices (cached)."""
     global _mesh_cache

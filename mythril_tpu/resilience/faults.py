@@ -45,6 +45,20 @@ Injection points:
                      engine's request-isolation territory: that request
                      fails with a flight dump, the pool is
                      decontaminated, the server stays ready
+``worker_kill``      a fleet worker SIGKILLs itself at a transaction
+                     boundary (models spot-instance preemption mid-
+                     lease) — the coordinator must detect the death by
+                     heartbeat expiry and re-lease the subtree from the
+                     worker's last journal boundary
+``gossip_drop``      the coordinator silently drops one knowledge
+                     gossip message (models a lossy channel) — findings
+                     must be unaffected: gossip is an accelerant, never
+                     load-bearing
+``lease_partition``  the coordinator ignores one worker heartbeat
+                     (models a network partition): enough shots expire
+                     the lease, the subtree is re-leased under a bumped
+                     epoch, and the original worker becomes the zombie
+                     whose stale-epoch messages the fence must drop
 ==================  =====================================================
 
 Faults are armed either through the API (:meth:`FaultPlane.arm`) or the
@@ -98,6 +112,9 @@ FAULT_POINTS = (
     "lane_poison",
     "frontier_stall",
     "serve_crash",
+    "worker_kill",
+    "gossip_drop",
+    "lease_partition",
 )
 
 DEFAULT_HANG_S = 30.0
@@ -344,6 +361,19 @@ def maybe_fault_request() -> None:
     decontaminated, the NEXT request's findings untouched."""
     if get_fault_plane().fire("serve_crash") is not None:
         raise FaultInjected("injected served-request crash")
+
+
+def maybe_fault_worker_kill() -> None:
+    """Fleet-worker seam (parallel/fleet.py, fired at each transaction
+    boundary of a lease): SIGKILL this process when armed — the
+    preemption the coordinator's heartbeat detector and journal
+    re-lease exist to absorb.  Same no-cleanup semantics as the
+    MYTHRIL_TPU_KILL_AT hook: a preempted worker gets no goodbyes."""
+    if get_fault_plane().fire("worker_kill") is not None:
+        log.warning("fault plane: fleet worker self-SIGKILL "
+                    "(worker_kill)")
+        logging.shutdown()
+        os.kill(os.getpid(), 9)
 
 
 def maybe_fault_rpc() -> None:

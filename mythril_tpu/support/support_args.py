@@ -63,6 +63,12 @@ class Args(object, metaclass=Singleton):
         # dump destinations (--trace-out / --metrics-out; None = off)
         self.trace_out = None
         self.metrics_out = None
+        # frontier fleet (mythril_tpu/parallel/fleet.py): shard the
+        # transaction-boundary frontier into subtree leases across N
+        # worker processes (--workers N).  None = defer to the
+        # MYTHRIL_TPU_FLEET_WORKERS env default; 0 = fleet off (the
+        # exact single-process path, also forced by MYTHRIL_TPU_FLEET=0)
+        self.fleet_workers = None
         # concrete-prefix dispatcher pre-split (SoA-validated): replace
         # each transaction seed with per-selector states at the
         # function entries (laser/ethereum/lockstep_dispatch.py).

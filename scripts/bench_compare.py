@@ -49,11 +49,15 @@ GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
          "blast_s", "word_prop_s", "serve_warm_p50_s",
          "sweeps_per_lane")
 #: gated metrics where LARGER is better (delta sign inverted):
-#: sustained warm-server throughput must not fall, and the microbench
+#: sustained warm-server throughput must not fall, the microbench
 #: device-vs-host ratio (both sides measured in the same run since the
 #: frontier round replaced the stale-denominator `microbench_speedup`)
-#: must not collapse
-GATED_HIGHER_BETTER = ("serve_cpm", "microbench_device_vs_host")
+#: must not collapse, and the fleet's sharding win (--workers 2 vs 1
+#: on the shardable workload, parallel/fleet.py) must not erode —
+#: coordinator overhead, gossip cost, or lease churn creeping into the
+#: hot path shows up here first
+GATED_HIGHER_BETTER = ("serve_cpm", "microbench_device_vs_host",
+                       "fleet_speedup")
 #: floor below which a baseline is noise and ratios are meaningless
 MIN_BASE = 0.05
 

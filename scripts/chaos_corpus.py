@@ -22,6 +22,18 @@ uninterrupted reference run.  A final round arms a lane-dependent
 quarantined alone: ``quarantined_lanes`` >= 1 with ``demotions``
 unchanged at 0, the context still on device.
 
+``--fleet`` soaks the frontier fleet (mythril_tpu/parallel/fleet.py):
+the chaos-tree workload runs under ``--workers 2`` while workers are
+SIGKILLed at their transaction-boundary fault point (``worker_kill``,
+first boundary and mid-corpus), heartbeats are partitioned away
+(``lease_partition`` — the re-lease + zombie + stale-epoch-gossip
+path, asserting ``gossip_dropped_stale`` >= 1 with verdicts
+unchanged), gossip messages are dropped (``gossip_drop``), and the
+kill switch (``MYTHRIL_TPU_FLEET=0``) is pinned to reproduce the exact
+single-process pipeline — every round asserts findings identical to
+the ``--workers 0`` reference, and the preemption rounds assert
+``worker_deaths`` >= 1 (a round that kills nobody proved nothing).
+
 ``--serve`` soaks the persistent daemon instead: a real ``myth serve``
 subprocess is driven over HTTP through five scenarios — (1) findings
 parity vs in-process CLI runs while ``MYTHRIL_TPU_FAULT`` injection is
@@ -532,6 +544,141 @@ def serve_soak_main() -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --fleet: soak the frontier fleet
+# ---------------------------------------------------------------------------
+
+FLEET_TX_COUNT = 3  # >= 2 worker-side boundaries => mid-corpus kills
+
+
+def _fleet_round(workers, env=None, arm=None):
+    """One chaos-tree analysis with the given fleet width, env
+    overrides for the round (workers inherit them), and an optional
+    coordinator-side armed fault.  Returns (found, row)."""
+    from mythril_tpu.parallel import fleet as fleet_mod
+    from mythril_tpu.resilience import faults
+    from mythril_tpu.support.support_args import args
+
+    import bench
+
+    saved_env = {k: os.environ.get(k) for k in (env or {})}
+    os.environ.update(env or {})
+    saved_workers = args.fleet_workers
+    args.fleet_workers = workers
+    faults.reset_for_tests()
+    fleet_mod.reset_fleet_for_tests()
+    if arm:
+        point, kwargs = arm
+        faults.get_fault_plane().arm(point, **kwargs)
+    try:
+        found, row = bench._analyze_one(
+            "chaos_tree", bench.chaos_tree_contract(), FLEET_TX_COUNT,
+            execution_timeout=300, max_depth=128,
+        )
+    finally:
+        args.fleet_workers = saved_workers
+        faults.reset_for_tests()
+        for key, value in saved_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+    return found, row
+
+
+def fleet_soak_main() -> int:
+    """The --fleet driver: preempt, partition, and drop — the fleet
+    must recover, fence, and degrade; findings never change."""
+    import logging
+
+    logging.basicConfig(level=logging.ERROR)
+    _kr_configure()  # same device-path knobs as the other soaks
+
+    failures = []
+
+    def check(scenario, ok, **detail):
+        row = {"scenario": scenario, "ok": bool(ok), **detail}
+        print(json.dumps(row))
+        if not ok:
+            failures.append(row)
+
+    print("fleet soak: --workers 0 reference pass ...", file=sys.stderr)
+    reference, _row = _fleet_round(workers=0)
+    reference = sorted(reference)
+    check("reference_found_swc106", "106" in reference,
+          found=reference)
+
+    rounds = [
+        # clean sharded run: parity both ways against the reference
+        ("fleet_clean_parity", {}, None, {}),
+        # SIGKILL every worker at its FIRST boundary (spot preemption
+        # at lease start); replacements re-lease from the journals
+        ("worker_kill_first_boundary",
+         {"MYTHRIL_TPU_FAULT": "worker_kill:1"}, None,
+         {"worker_deaths": 1}),
+        # SIGKILL mid-corpus: the second boundary the worker reaches,
+        # so at least one transaction's progress is already journaled
+        ("worker_kill_mid_corpus",
+         {"MYTHRIL_TPU_FAULT": "worker_kill:1:1"}, None,
+         {"worker_deaths": 1}),
+        # partition: heartbeats eaten => lease expiry => re-lease under
+        # a bumped epoch; the zombie's stale-epoch gossip/result replay
+        # MUST be fenced without changing any verdict
+        ("lease_partition_stale_gossip_fenced",
+         {"MYTHRIL_TPU_FLEET_HEARTBEAT_S": "0.1",
+          "MYTHRIL_TPU_FLEET_LEASE_TTL_S": "0.6"},
+         ("lease_partition", {"times": 99}),
+         {"worker_deaths": 1, "gossip_dropped_stale": 1}),
+        # lossy gossip channel: knowledge is an accelerant, never
+        # load-bearing
+        ("gossip_drop_harmless", {},
+         ("gossip_drop", {"times": 99}), {}),
+    ]
+    for scenario, env, arm, minimums in rounds:
+        began = time.time()
+        try:
+            found, row = _fleet_round(workers=2, env=env, arm=arm)
+        except Exception as exc:  # noqa: BLE001 — an uncaught scenario
+            #                       failure must force a nonzero exit
+            check(scenario, False, error=f"{type(exc).__name__}: {exc}")
+            continue
+        detail = {
+            "wall_s": round(time.time() - began, 1),
+            "found": sorted(found),
+            "fleet": {k: v for k, v in row.items()
+                      if k.startswith("fleet_") and v},
+        }
+        ok = sorted(found) == reference
+        for counter, floor in minimums.items():
+            ok = ok and row.get(f"fleet_{counter}", 0) >= floor
+        check(scenario, ok, **detail)
+
+    # kill switch: --workers 2 under MYTHRIL_TPU_FLEET=0 must be the
+    # exact single-process pipeline (no leases, identical findings)
+    began = time.time()
+    try:
+        found, row = _fleet_round(
+            workers=2, env={"MYTHRIL_TPU_FLEET": "0"}
+        )
+        check(
+            "kill_switch_exact_single_process",
+            sorted(found) == reference
+            and row.get("fleet_leases", 0) == 0,
+            wall_s=round(time.time() - began, 1),
+            found=sorted(found), leases=row.get("fleet_leases"),
+        )
+    except Exception as exc:  # noqa: BLE001
+        check("kill_switch_exact_single_process", False,
+              error=f"{type(exc).__name__}: {exc}")
+
+    if failures:
+        print(json.dumps({"fleet_soak_failures": failures}))
+        return 1
+    print(json.dumps({"fleet_soak_ok": True,
+                      "rounds": len(rounds) + 2}))
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--rounds", type=int, default=6)
@@ -545,6 +692,12 @@ def main() -> int:
                         "injection parity, SIGKILL-restart, breaker "
                         "trip/recover, deadline partials, queue-"
                         "overflow shedding")
+    parser.add_argument("--fleet", action="store_true",
+                        help="soak the frontier fleet: worker SIGKILLs "
+                        "at every reachable fleet fault point, "
+                        "partition => stale-epoch fencing, gossip "
+                        "loss, and the single-process kill switch — "
+                        "findings parity asserted every round")
     parser.add_argument("--kr-child", action="store_true",
                         help=argparse.SUPPRESS)
     parser.add_argument("--kr-dir", default=None, help=argparse.SUPPRESS)
@@ -557,6 +710,8 @@ def main() -> int:
         return kill_resume_main()
     if args_ns.serve:
         return serve_soak_main()
+    if args_ns.fleet:
+        return fleet_soak_main()
     rng = random.Random(args_ns.seed)
 
     import logging
@@ -591,8 +746,14 @@ def main() -> int:
         faults.reset_for_tests()
         faults.get_fault_plane().arm(fault, **arm_kwargs)
         began = time.time()
+        error = None
         try:
             found, counters = _analyze_corpus()
+        except Exception as exc:  # noqa: BLE001 — a scenario that
+            #   raises before recording is a FAILED round, not a pass:
+            #   it must land in `failures` and force the nonzero exit
+            error = f"{type(exc).__name__}: {exc}"
+            found, counters = None, {}
         finally:
             faults.reset_for_tests()
             for key, value in saved.items():
@@ -605,7 +766,7 @@ def main() -> int:
             from mythril_tpu.ops import device_health
 
             device_health.reset_for_tests()  # undo probe flaps
-        parity = found == reference
+        parity = error is None and found == reference
         row = {
             "round": round_no,
             "fault": fault,
@@ -613,10 +774,12 @@ def main() -> int:
             "findings_parity": parity,
             "counters": {k: v for k, v in counters.items() if v},
         }
+        if error is not None:
+            row["error"] = error
         print(json.dumps(row))
         if not parity:
             failures.append(
-                {"round": round_no, "fault": fault,
+                {"round": round_no, "fault": fault, "error": error,
                  "found": found, "reference": reference}
             )
     if failures:
@@ -627,4 +790,16 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except BaseException:  # noqa: BLE001 — NOTHING that escapes a soak
+        #   may exit 0: a crashed driver is a failed soak, and the CI
+        #   gate keys on the exit status
+        import traceback
+
+        print(json.dumps({
+            "soak_uncaught": traceback.format_exc()[-2000:],
+        }))
+        sys.exit(1)

@@ -107,6 +107,22 @@ def main() -> None:
         frontier_s / row["total_wall_s"], 4
     ) if row["total_wall_s"] else 0.0
     row["frontier_learned_clauses"] = row.get("learned_clauses", 0)
+    # fleet-worker shares (populated when the run shards via
+    # MYTHRIL_TPU_FLEET_WORKERS / --workers: each lease's wall lands
+    # under fleet.worker:<id> via Tracer.add_external_total, so the
+    # per-worker split of a sharded profile is attributable here)
+    worker_spans = {
+        name.split(":", 1)[1]: round(seconds, 3)
+        for name, seconds in totals.items()
+        if name.startswith("fleet.worker:")
+    }
+    if worker_spans:
+        total_worker_s = sum(worker_spans.values())
+        row["fleet_worker_span_s"] = worker_spans
+        row["fleet_worker_span_share"] = {
+            worker: round(seconds / total_worker_s, 4)
+            for worker, seconds in worker_spans.items()
+        } if total_worker_s else {}
 
     from mythril_tpu.smt.solver import get_blast_context
 

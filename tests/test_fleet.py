@@ -1,0 +1,528 @@
+"""Frontier-fleet tests: the coordinator state machine (lease grant /
+expiry / re-lease from journal, straggler split, all-dead degradation,
+epoch fencing), the gossip transport, and end-to-end findings parity of
+``--workers 2`` against the single-process path on the chaos tree.
+
+Marker ``fleet`` (tier-1, CPU-only).  The state-machine tests drive
+:class:`Coordinator` directly with fake worker handles and a fake
+clock — no sockets, no subprocesses; the two end-to-end tests spawn
+real worker processes over localhost TCP.
+"""
+
+import os
+import socket
+
+import pytest
+
+from mythril_tpu.parallel import fleet
+from mythril_tpu.parallel.coordinator import (
+    DONE, FAILED, PENDING, RUNNING, Coordinator, FleetConfig,
+)
+from mythril_tpu.parallel.gossip import (
+    FrameError, Stamp, recv_frame, send_frame,
+)
+
+pytestmark = pytest.mark.fleet
+
+
+# ---------------------------------------------------------------------------
+# fixtures / fakes
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane_and_stats():
+    from mythril_tpu.resilience import faults
+
+    faults.reset_for_tests()
+    fleet.fleet_stats.reset()
+    yield
+    faults.reset_for_tests()
+    fleet.fleet_stats.reset()
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+class FakeHandle:
+    """Worker-handle double: records sends/drains/kills.  No ``conn``
+    attribute, so the coordinator counts it as connected."""
+
+    def __init__(self):
+        self.sent = []
+        self.drained = 0
+        self.killed = 0
+
+    def send(self, header, body=b""):
+        self.sent.append((header, body))
+        return True
+
+    def drain(self):
+        self.drained += 1
+
+    def kill(self):
+        self.killed += 1
+
+
+def make_coordinator(tmp_path, workers=2, **config_kw):
+    config = FleetConfig(workers=workers, **config_kw)
+    clock = FakeClock()
+    handles = []
+
+    def spawner(worker_id, respawn):
+        handle = FakeHandle()
+        handles.append(handle)
+        return handle
+
+    coordinator = Coordinator(
+        config, {"name": "test"}, spawner=spawner, clock=clock
+    )
+    coordinator._test_handles = handles
+    return coordinator, clock
+
+
+def real_states(n):
+    """n empty-but-real world states (journal-picklable)."""
+    from mythril_tpu.laser.ethereum.state.world_state import WorldState
+
+    return [WorldState() for _ in range(n)]
+
+
+def staged_lease(coordinator, tmp_path, n_states=2, tx_index=1,
+                 tag="l0"):
+    directory = str(tmp_path / tag)
+    fleet._write_lease_journal(directory, address=0xABC,
+                               tx_index=tx_index, transaction_count=2,
+                               states=real_states(n_states))
+    return coordinator.add_lease(directory, tx_index, n_states)
+
+
+def grant_all(coordinator):
+    for _ in range(coordinator.config.workers):
+        coordinator._new_seat()
+    coordinator.assign()
+
+
+# ---------------------------------------------------------------------------
+# transport
+# ---------------------------------------------------------------------------
+
+
+def test_frame_roundtrip():
+    a, b = socket.socketpair()
+    try:
+        send_frame(a, {"type": "lease", "lease_id": "x"}, b"payload")
+        header, body = recv_frame(b)
+        assert header["type"] == "lease"
+        assert body == b"payload"
+        send_frame(b, {"type": "heartbeat"})
+        header, body = recv_frame(a)
+        assert header["type"] == "heartbeat" and body == b""
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_rejects_garbage():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"\x00\x00\x00\x05notjs" + b"\x00" * 8)
+        with pytest.raises(FrameError):
+            recv_frame(b)
+        a.close()
+        with pytest.raises(FrameError):
+            recv_frame(b)  # peer gone mid-frame
+    finally:
+        b.close()
+
+
+def test_stamp_header_roundtrip():
+    stamp = Stamp(generation=3, pool_version=7, lease_epoch=2)
+    parsed = Stamp.from_header({"stamp": stamp.as_dict()})
+    assert parsed == stamp
+    assert Stamp.from_header({}) == Stamp()
+
+
+# ---------------------------------------------------------------------------
+# coordinator state machine
+# ---------------------------------------------------------------------------
+
+
+def test_lease_grant_and_result(tmp_path):
+    coordinator, clock = make_coordinator(tmp_path)
+    lease = staged_lease(coordinator, tmp_path)
+    grant_all(coordinator)
+    assert lease.state == RUNNING
+    assert fleet.fleet_stats.leases == 1
+    seat = coordinator.seats[lease.worker_id]
+    granted = [h for h, _ in seat.handle.sent if h["type"] == "lease"]
+    assert granted and granted[0]["lease_id"] == lease.lease_id
+    assert granted[0]["journal_dir"] == lease.journal_dir
+    coordinator.handle_message(
+        seat.worker_id,
+        {"type": "result", "lease_id": lease.lease_id,
+         "stamp": {"lease_epoch": lease.epoch}, "found_swcs": []},
+        b"",
+    )
+    assert lease.state == DONE
+    assert coordinator.finished() and not coordinator.unfinished()
+
+
+def test_heartbeat_expiry_releases_from_journal(tmp_path):
+    coordinator, clock = make_coordinator(tmp_path, lease_ttl_s=5.0)
+    lease = staged_lease(coordinator, tmp_path)
+    grant_all(coordinator)
+    first_worker = lease.worker_id
+    old_dir = lease.journal_dir
+    # heartbeats keep it alive ...
+    clock.advance(4.0)
+    coordinator.handle_message(
+        first_worker,
+        {"type": "heartbeat", "lease_id": lease.lease_id,
+         "stamp": {"lease_epoch": lease.epoch}}, b"",
+    )
+    coordinator.sweep()
+    assert lease.state == RUNNING
+    # ... then silence past the TTL kills the seat and re-leases
+    clock.advance(6.0)
+    coordinator.sweep()
+    assert coordinator.seats[first_worker].dead
+    assert fleet.fleet_stats.worker_deaths == 1
+    assert lease.state == PENDING
+    assert lease.epoch == 1
+    # the journal was re-staged into a fresh directory holding the last
+    # boundary generation (two writers must never share a journal dir)
+    assert lease.journal_dir != old_dir
+    from mythril_tpu.resilience.checkpoint import load_journal
+
+    payload = load_journal(lease.journal_dir)
+    assert payload is not None and payload["tx_index"] == 1
+    assert len(payload["open_states"]) == 2
+    # a replacement seat picks it up under the bumped epoch
+    coordinator.assign()
+    assert lease.state == RUNNING and lease.worker_id != first_worker
+
+
+def test_zombie_messages_are_fenced(tmp_path):
+    coordinator, clock = make_coordinator(tmp_path, lease_ttl_s=5.0)
+    lease = staged_lease(coordinator, tmp_path)
+    grant_all(coordinator)
+    zombie = lease.worker_id
+    clock.advance(10.0)
+    coordinator.sweep()          # zombie partitioned out
+    coordinator.assign()         # re-leased at epoch 1
+    replacement = lease.worker_id
+    assert replacement != zombie
+    # the zombie resumes talking with its stale epoch: dropped
+    coordinator.handle_message(
+        zombie,
+        {"type": "gossip", "lease_id": lease.lease_id,
+         "stamp": {"lease_epoch": 0}}, b"junk",
+    )
+    assert fleet.fleet_stats.gossip_dropped_stale == 1
+    coordinator.handle_message(
+        zombie,
+        {"type": "result", "lease_id": lease.lease_id,
+         "stamp": {"lease_epoch": 0}, "found_swcs": ["999"]}, b"",
+    )
+    assert lease.state == RUNNING  # the zombie's answer did not land
+    assert fleet.fleet_stats.gossip_dropped_stale == 2
+    # the replacement's result is the one that lands
+    coordinator.handle_message(
+        replacement,
+        {"type": "result", "lease_id": lease.lease_id,
+         "stamp": {"lease_epoch": 1}, "found_swcs": []}, b"",
+    )
+    assert lease.state == DONE
+
+
+def test_fresh_gossip_routes_to_other_workers(tmp_path):
+    from mythril_tpu.parallel.gossip import freeze_knowledge
+    from mythril_tpu.smt.solver import get_blast_context
+
+    coordinator, clock = make_coordinator(tmp_path)
+    lease_a = staged_lease(coordinator, tmp_path, tag="la")
+    lease_b = staged_lease(coordinator, tmp_path, tag="lb")
+    grant_all(coordinator)
+    assert lease_a.state == RUNNING and lease_b.state == RUNNING
+    body = freeze_knowledge(get_blast_context())
+    coordinator.handle_message(
+        lease_a.worker_id,
+        {"type": "gossip", "lease_id": lease_a.lease_id,
+         "stamp": {"lease_epoch": 0}}, body,
+    )
+    assert fleet.fleet_stats.gossip_sent == 1
+    peer = coordinator.seats[lease_b.worker_id].handle
+    forwarded = [h for h, _ in peer.sent if h["type"] == "gossip"]
+    assert forwarded, "gossip must fan out to the other leased worker"
+    # re-stamped with the RECIPIENT's lease epoch so fences compose
+    assert forwarded[0]["stamp"]["lease_epoch"] == lease_b.epoch
+    # origin worker must not receive its own knowledge back
+    origin = coordinator.seats[lease_a.worker_id].handle
+    assert not [h for h, _ in origin.sent if h["type"] == "gossip"]
+
+
+def test_gossip_drop_fault_point(tmp_path):
+    from mythril_tpu.resilience import faults
+
+    coordinator, clock = make_coordinator(tmp_path)
+    lease = staged_lease(coordinator, tmp_path)
+    grant_all(coordinator)
+    faults.get_fault_plane().arm("gossip_drop", times=1)
+    coordinator.handle_message(
+        lease.worker_id,
+        {"type": "gossip", "lease_id": lease.lease_id,
+         "stamp": {"lease_epoch": 0}}, b"x",
+    )
+    assert fleet.fleet_stats.gossip_sent == 0
+    assert fleet.fleet_stats.gossip_dropped_stale == 0
+
+
+def test_straggler_split(tmp_path):
+    coordinator, clock = make_coordinator(
+        tmp_path, split_after_s=10.0, lease_ttl_s=300.0
+    )
+    lease = staged_lease(coordinator, tmp_path, n_states=4)
+    grant_all(coordinator)
+    worker = lease.worker_id
+    seat = coordinator.seats[worker]
+    # a second, idle worker exists; the lease runs long
+    assert coordinator._idle_seats()
+    clock.advance(11.0)
+    coordinator.handle_message(
+        worker,
+        {"type": "heartbeat", "lease_id": lease.lease_id,
+         "stamp": {"lease_epoch": 0}}, b"",
+    )
+    coordinator.sweep()
+    assert lease.splitting and seat.handle.drained == 1
+    # the drained worker lands its boundary journal and reports partial
+    coordinator.handle_message(
+        worker,
+        {"type": "result", "lease_id": lease.lease_id,
+         "stamp": {"lease_epoch": 0}, "partial": True,
+         "found_swcs": []}, b"",
+    )
+    assert lease.state == DONE and lease.result.get("split")
+    assert fleet.fleet_stats.rebalances == 1
+    halves = [l for l in coordinator.leases.values()
+              if l.lease_id != lease.lease_id]
+    assert len(halves) == 2
+    assert sorted(h.n_states for h in halves) == [2, 2]
+    assert all(h.tx_index == lease.tx_index for h in halves)
+    from mythril_tpu.resilience.checkpoint import load_journal
+
+    for half in halves:
+        payload = load_journal(half.journal_dir)
+        assert len(payload["open_states"]) == half.n_states
+
+
+def test_all_workers_dead_degrades(tmp_path):
+    config = FleetConfig(workers=2, spawn_retries=0)
+    coordinator = Coordinator(
+        config, {"name": "test"},
+        spawner=lambda wid, respawn: None, clock=FakeClock(),
+    )
+    lease = staged_lease(coordinator, tmp_path)
+    coordinator.run()
+    assert lease.state == PENDING
+    assert coordinator.unfinished() and not coordinator.finished()
+
+
+def test_lease_retry_budget_fails_lease(tmp_path):
+    coordinator, clock = make_coordinator(
+        tmp_path, workers=1, lease_ttl_s=5.0, lease_retries=1
+    )
+    lease = staged_lease(coordinator, tmp_path)
+    for _ in range(2):
+        grant_all(coordinator)
+        assert lease.state == RUNNING
+        clock.advance(10.0)
+        coordinator.sweep()
+    assert lease.state == FAILED
+    assert lease.attempts == 2
+
+
+# ---------------------------------------------------------------------------
+# knowledge freeze / monotone apply
+# ---------------------------------------------------------------------------
+
+
+def test_gossip_knowledge_monotone():
+    from mythril_tpu.parallel.gossip import (
+        apply_knowledge, freeze_knowledge,
+    )
+    from mythril_tpu.smt import terms as T
+    from mythril_tpu.smt.bitblast import BlastContext
+
+    ctx_a = BlastContext()
+    x = T._I.get("var", (), ("x",), 256, "bv")
+    y = T._I.get("var", (), ("y",), 256, "bv")
+    ctx_a.note_unsat([x, y])
+    body = freeze_knowledge(ctx_a)
+    ctx_b = BlastContext()
+    added = apply_knowledge(ctx_b, body)
+    assert added["unsat"] == 1
+    assert len(ctx_b.unsat_memo) == 1
+    # idempotent: a replayed message adds nothing
+    added = apply_knowledge(ctx_b, body)
+    assert added["unsat"] == 0
+    assert len(ctx_b.unsat_memo) == 1
+
+
+def test_merge_findings_dedup_roundtrip():
+    """Worker findings cross the process boundary pickled and merge
+    under the modules' address-keyed dedup — replaying the same
+    snapshot (a re-explored subtree after a re-lease) adds nothing."""
+    import pickle
+
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+    from mythril_tpu.analysis.report import Issue
+
+    module = ModuleLoader().get_detection_modules()[0]
+    module.reset_module()
+    module.cache.clear()
+    name = type(module).__name__
+    issue = Issue(
+        contract="c", function_name="f", address=42, swc_id="106",
+        title="t", bytecode="00",
+    )
+    snapshot = pickle.loads(pickle.dumps(
+        {"issues": {name: [issue]}, "caches": {name: {42}}}
+    ))
+    try:
+        assert fleet._merge_findings(snapshot) == 1
+        assert len(module.issues) == 1 and 42 in module.cache
+        assert fleet._merge_findings(snapshot) == 0  # idempotent
+        assert len(module.issues) == 1
+    finally:
+        module.reset_module()
+        module.cache.clear()
+
+
+def test_split_lease_journal_roundtrip(tmp_path):
+    directory = str(tmp_path / "lease")
+    fleet._write_lease_journal(directory, address=1, tx_index=1,
+                               transaction_count=3,
+                               states=real_states(5))
+    halves = fleet.split_lease_journal(directory)
+    assert halves is not None and len(halves) == 2
+    assert sorted(n for _, _, n in halves) == [2, 3]
+    # a single-state journal is not splittable
+    solo = str(tmp_path / "solo")
+    fleet._write_lease_journal(solo, address=1, tx_index=0,
+                               transaction_count=2,
+                               states=real_states(1))
+    assert fleet.split_lease_journal(solo) is None
+
+
+# ---------------------------------------------------------------------------
+# knobs / kill switch
+# ---------------------------------------------------------------------------
+
+
+def test_kill_switch_and_roles(monkeypatch):
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setattr(args, "fleet_workers", 2)
+    assert fleet.seam_enabled()
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET", "0")
+    assert not fleet.seam_enabled()
+    assert fleet.effective_workers() == 0
+    monkeypatch.delenv("MYTHRIL_TPU_FLEET")
+    monkeypatch.setattr(args, "fleet_workers", 0)
+    assert not fleet.seam_enabled()
+    monkeypatch.setattr(args, "fleet_workers", None)
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET_WORKERS", "3")
+    assert fleet.effective_workers() == 3
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET_ROLE", "worker")
+    assert fleet.seam_enabled()       # boundary duties stay on
+    assert not fleet.should_delegate(object())  # but never re-shards
+
+
+def test_mesh_caches_reset_with_resident_pools():
+    """Satellite fix: the mesh + jitted shard_map caches must die with
+    the device-resident state on checkpoint resume / serve
+    decontamination — a solve compiled for a dead topology (or keyed on
+    a recycled mesh id) must never be served."""
+    from mythril_tpu.ops.batched_sat import reset_resident_pools
+    from mythril_tpu.parallel import mesh
+
+    mesh._mesh_cache = object()
+    mesh._solve_cache[(123, 64)] = lambda: None
+    reset_resident_pools()
+    assert mesh._mesh_cache is None
+    assert mesh._solve_cache == {}
+
+
+# ---------------------------------------------------------------------------
+# end to end: real workers over localhost TCP
+# ---------------------------------------------------------------------------
+
+
+def _analyze_chaos_tree(workers):
+    import bench
+    from mythril_tpu.support.support_args import args
+
+    saved = args.fleet_workers
+    args.fleet_workers = workers
+    try:
+        found, row = bench._analyze_one(
+            "chaos_tree", bench.chaos_tree_contract(), 2,
+            execution_timeout=300, max_depth=128,
+        )
+    finally:
+        args.fleet_workers = saved
+    return found, row
+
+
+def test_fleet_e2e_findings_parity(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
+    found_single, _ = _analyze_chaos_tree(workers=0)
+    found_fleet, row = _analyze_chaos_tree(workers=2)
+    assert found_fleet == found_single == {"106"}
+    assert row["fleet_leases"] >= 2
+    assert row["fleet_worker_deaths"] == 0
+
+
+def test_fleet_e2e_full_offload_merges_worker_findings(monkeypatch):
+    """MYTHRIL_TPU_FLEET_MIN_STATES=1 delegates the WHOLE analysis at
+    the first boundary: the coordinator explores nothing itself, so
+    the SWC-106 finding can only arrive through the worker-result
+    merge — the end-to-end proof that findings survive the process
+    boundary."""
+    import bench
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET_MIN_STATES", "1")
+    monkeypatch.setattr(args, "fleet_workers", 1)
+    found, row = bench._analyze_one(
+        "killbilly", bench._corpus()[0][1], 1,
+        execution_timeout=300, max_depth=128,
+    )
+    assert found == {"106"}
+    assert row["fleet_leases"] == 1
+
+
+def test_fleet_e2e_worker_kill_recovers(monkeypatch):
+    """SIGKILL both workers at their first transaction boundary
+    (spot preemption): the coordinator detects the deaths, re-leases
+    from the journals, and findings are identical."""
+    monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
+    monkeypatch.setenv("MYTHRIL_TPU_FAULT", "worker_kill:1")
+    from mythril_tpu.resilience import faults
+
+    faults.reset_for_tests()  # re-load env in this (coordinator) process
+    found, row = _analyze_chaos_tree(workers=2)
+    assert found == {"106"}
+    assert row["fleet_worker_deaths"] >= 1
+    assert row["fleet_leases"] > row["fleet_worker_deaths"]
