@@ -945,6 +945,12 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
         "word_prop_s": summary.get("word_prop_s", 0.0),
         "blast_s": summary["solver_split"].get("blast_s", 0.0),
     }
+    if isinstance(summary.get("tier_decided_pct"), dict):
+        # per-lane attribution split (word / frontier / full-sweep /
+        # tail percentages of all ledgered lanes) — absent, not null,
+        # when nothing was ledgered; the tail share is gated in
+        # scripts/bench_compare.py as tier_tail_pct
+        headline["tier_decided_pct"] = summary["tier_decided_pct"]
     if summary.get("sweeps_per_lane") is not None:
         # device-native propagation (frontier tier): full sweeps per
         # decided lane — THE success metric of the event-driven BCP
@@ -989,7 +995,8 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
         headline["error"] = str(summary["error"])[:160]
     line = json.dumps(headline)
     if len(line) > 500:  # hard cap so the tail capture can never lose it
-        for key in ("worker_deaths_recovered", "fleet_speedup",
+        for key in ("tier_decided_pct",
+                    "worker_deaths_recovered", "fleet_speedup",
                     "microbench_device_vs_host",
                     "microbench_device_warm_s",
                     "serve_cpm", "serve_warm_p50_s",
@@ -1338,6 +1345,14 @@ def main() -> None:
     summary["learned_clauses"] = sum(
         r.get("learned_clauses", 0) for r in rows
     ) + sum(r.get("learned_clauses", 0) for r in scale_rows.values())
+    # ledger-derived attribution: what share of all dispatched lanes
+    # each funnel tier decided across this whole bench process (the
+    # lane ledger accumulates run-wide; observability/ledger.py).
+    # bench_compare gates the tail share — the funnel losing lanes to
+    # the host CDCL shows up here before any wall-clock moves
+    from mythril_tpu.observability.ledger import get_ledger
+
+    summary["tier_decided_pct"] = get_ledger().tier_decided_pct()
     for (label, run_mode), row in scale_rows.items():
         key = label if run_mode == mode else f"{label}_{run_mode}"
         summary[key] = _scale_summary(row)

@@ -37,6 +37,7 @@ COMMAND_LIST = (
     + (
         "pro",
         "serve",
+        "top",
         "list-detectors",
         "read-storage",
         "leveldb-search",
@@ -317,6 +318,15 @@ def create_analyzer_parser(analyzer_parser: argparse.ArgumentParser) -> None:
         metavar="FILE",
     )
     options.add_argument(
+        "--lane-ledger-out",
+        help="Write the per-lane attribution ledger to FILE as JSON "
+        "(schema mythril-tpu-lane-ledger/1): every dispatch lane's "
+        "origin, tier transitions and verdict, plus per-tier/"
+        "per-contract aggregates; validate with scripts/trace_lint.py "
+        "(kill switch MYTHRIL_TPU_LEDGER=0)",
+        metavar="FILE",
+    )
+    options.add_argument(
         "--proof-log",
         action="store_true",
         help="Record a DRAT-style proof stream on the native solver and "
@@ -411,6 +421,32 @@ def create_serve_parser(parser: argparse.ArgumentParser) -> None:
         help="Also dump the metrics registry to FILE on drain (the "
         "live view is GET /metrics)",
         metavar="FILE",
+    )
+    parser.add_argument(
+        "--lane-ledger-out",
+        help="Also dump the per-lane attribution ledger to FILE on "
+        "drain (the live view is GET /debug/lanes)",
+        metavar="FILE",
+    )
+
+
+def create_top_parser(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--url",
+        default="http://127.0.0.1:8551",
+        help="base URL of a running `myth serve` daemon (or a fleet "
+        "coordinator's MYTHRIL_TPU_FLEET_DEBUG_PORT listener)",
+    )
+    parser.add_argument(
+        "--interval",
+        type=float,
+        default=2.0,
+        help="refresh period in seconds",
+    )
+    parser.add_argument(
+        "--once",
+        action="store_true",
+        help="render a single snapshot and exit (no screen clearing)",
     )
 
 
@@ -539,6 +575,14 @@ def main() -> None:
         formatter_class=argparse.ArgumentDefaultsHelpFormatter,
     )
     create_serve_parser(serve_parser)
+    top_parser = subparsers.add_parser(
+        "top",
+        help="Live one-screen status of a running serve daemon or "
+        "fleet coordinator (polls /debug/requests + /debug/lanes; "
+        "docs/observability.md)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    create_top_parser(top_parser)
     subparsers.add_parser("version", parents=[output_parser], help="Outputs the version")
     pro_parser = subparsers.add_parser(
         "pro",
@@ -889,6 +933,7 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
         configure_from_cli(
             getattr(args, "trace_out", None),
             getattr(args, "metrics_out", None),
+            getattr(args, "lane_ledger_out", None),
         )
 
     if args.command == "serve":
@@ -906,6 +951,12 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
             print(f"cannot bind {args.host}:{args.port}: {e}",
                   file=sys.stderr)
             sys.exit(1)
+
+    if args.command == "top":
+        from mythril_tpu.interfaces.top import run_top
+
+        sys.exit(run_top(args.url, interval_s=args.interval,
+                         once=args.once))
 
     if args.command == "function-to-hash":
         print(MythrilDisassembler.hash_for_function_signature(args.func_name))

@@ -1,0 +1,168 @@
+"""``myth top`` — one-screen live status of a running ``myth serve``
+daemon or fleet coordinator.
+
+Polls the live introspection endpoints the observability plane exposes
+(``/debug/requests``, ``/debug/lanes``, and — on a serve instance —
+``/readyz``) and renders a compact terminal dashboard: server health,
+the in-flight request (phase, deadline budget remaining, lane counts
+by tier), recent requests, and the lane-attribution funnel split.
+Stdlib-only, read-only, and safe against a half-up server (connection
+errors render as a status line, not a traceback).
+
+Usage::
+
+    myth top                          # http://127.0.0.1:8551
+    myth top --url http://host:port   # a serve daemon or a fleet
+                                      # coordinator's debug port
+    myth top --once                   # single snapshot (no clearing,
+                                      # scripting/tests)
+"""
+
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+POLL_TIMEOUT_S = 3.0
+
+
+def _get_json(url: str) -> Optional[dict]:
+    try:
+        with urllib.request.urlopen(url, timeout=POLL_TIMEOUT_S) as rsp:
+            return json.loads(rsp.read().decode("utf-8"))
+    except (urllib.error.URLError, urllib.error.HTTPError, OSError,
+            ValueError):
+        return None
+
+
+def _bar(fraction: float, width: int = 24) -> str:
+    fraction = max(0.0, min(1.0, fraction))
+    filled = int(round(fraction * width))
+    return "#" * filled + "." * (width - filled)
+
+
+def _render_lanes(lanes: Optional[dict], out) -> None:
+    if not lanes or not lanes.get("lanes_total"):
+        print("  lanes: none ledgered yet", file=out)
+        return
+    total = lanes["lanes_total"]
+    decided = lanes.get("decided", {})
+    print(f"  lanes: {total} total "
+          f"({lanes.get('batches', 0)} batches, "
+          f"{lanes.get('learned_clauses', 0)} learned clauses)",
+          file=out)
+    for tier in ("structural", "probe", "word", "frontier", "sweep",
+                 "tail"):
+        n = decided.get(tier, 0)
+        if not n:
+            continue
+        print(f"    {tier:<10} {n:>7}  "
+              f"[{_bar(n / total)}] {100.0 * n / total:5.1f}%",
+              file=out)
+    transitions = lanes.get("transitions") or {}
+    if transitions:
+        print("    transitions: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(transitions.items())
+        ), file=out)
+
+
+def _render_serve(ready: Optional[dict], requests: Optional[dict],
+                  out) -> None:
+    if ready is not None:
+        state = "READY" if ready.get("ready") else (
+            "DRAINING" if ready.get("draining") else "NOT-READY"
+        )
+        print(f"  server: {state}  mode={ready.get('mode', '?')}  "
+              f"queues={ready.get('queue_depths')}", file=out)
+    if requests is None:
+        return
+    flight = requests.get("in_flight")
+    if flight:
+        remaining = flight.get("budget_remaining_s")
+        budget = flight.get("budget_s") or 0
+        gauge = ""
+        if remaining is not None and budget:
+            gauge = f" [{_bar(remaining / budget, 16)}]"
+        print(f"  in-flight: {flight.get('contract')} "
+              f"({flight.get('request_id')}, "
+              f"trace {flight.get('trace_id')})", file=out)
+        print(f"    phase={flight.get('phase') or '-'}  "
+              f"elapsed={flight.get('elapsed_s')}s  "
+              f"budget-left={remaining}s{gauge}", file=out)
+        tiers = flight.get("lanes_by_tier") or {}
+        if tiers:
+            print("    lanes so far: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(tiers.items())
+            ), file=out)
+    else:
+        print("  in-flight: idle", file=out)
+    done = requests.get("requests") or {}
+    print(f"  totals: done={done.get('done', 0)} "
+          f"failed={done.get('failed', 0)} "
+          f"partial={done.get('partial', 0)}", file=out)
+    recent = requests.get("recent") or []
+    if recent:
+        print("  recent:", file=out)
+        for row in recent[:6]:
+            flags = " partial" if row.get("partial") else ""
+            print(f"    {row.get('status')} {row.get('contract'):<18} "
+                  f"{row.get('analysis_s')}s "
+                  f"trace={row.get('trace_id')}{flags}", file=out)
+
+
+def _render_fleet(requests: dict, out) -> None:
+    print(f"  coordinator trace: {requests.get('trace_id')}", file=out)
+    for lease in requests.get("leases", []):
+        running = (f" {lease['running_s']}s"
+                   if lease.get("running_s") is not None else "")
+        print(f"    {lease['lease_id']:<8} {lease['state']:<8} "
+              f"epoch={lease['epoch']} attempts={lease['attempts']} "
+              f"worker={lease.get('worker') or '-'}"
+              f" states={lease['states']}{running}", file=out)
+    for seat in requests.get("seats", []):
+        status = "dead" if seat["dead"] else (
+            "idle" if not seat.get("lease") else "busy"
+        )
+        print(f"    seat {seat['worker_id']:<4} {status}"
+              f" lease={seat.get('lease') or '-'}", file=out)
+
+
+def render_once(url: str, out=None) -> bool:
+    """One dashboard frame; returns False when nothing answered (the
+    caller decides whether that ends a --once run with an error)."""
+    out = out or sys.stdout
+    base = url.rstrip("/")
+    requests = _get_json(base + "/debug/requests")
+    lanes = _get_json(base + "/debug/lanes")
+    ready = _get_json(base + "/readyz")
+    print(f"myth top — {base}  "
+          f"({time.strftime('%H:%M:%S')})", file=out)
+    if requests is None and lanes is None:
+        print("  unreachable (is the server up? serve exposes "
+              "/debug/* on its port; a fleet coordinator needs "
+              "MYTHRIL_TPU_FLEET_DEBUG_PORT)", file=out)
+        return False
+    if requests is not None and requests.get("role") == "coordinator":
+        _render_fleet(requests, out)
+    else:
+        _render_serve(ready, requests, out)
+    _render_lanes(lanes, out)
+    return True
+
+
+def run_top(url: str, interval_s: float = 2.0,
+            once: bool = False) -> int:
+    """CLI entry (``myth top``).  Returns the process exit code."""
+    if once:
+        return 0 if render_once(url) else 1
+    try:
+        while True:
+            # ANSI clear + home keeps it one-screen without curses
+            sys.stdout.write("\x1b[2J\x1b[H")
+            render_once(url)
+            sys.stdout.flush()
+            time.sleep(max(0.2, interval_s))
+    except KeyboardInterrupt:
+        return 0

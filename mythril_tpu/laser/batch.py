@@ -45,11 +45,15 @@ def prune_infeasible(states: List) -> List:
 
 
 def _prune_infeasible(states: List) -> List:
+    from mythril_tpu.observability.ledger import get_ledger
+
+    ledger = get_ledger()
     undecided = []
     feasible = []
     for state in states:
         constraints = state.world_state.constraints
         if _structurally_false(constraints):
+            ledger.single("prune", "structural", "unsat")
             continue
         undecided.append(state)
 
@@ -97,20 +101,34 @@ def _prune_infeasible(states: List) -> List:
     # CDCL query is issued.  Memoized on the blast context, so lanes
     # the batch path already consulted cost a dict hit here.
     open_positions = [k for k, v in enumerate(verdicts) if v is None]
+    word_decided_here = set()
     if open_positions:
         try:
+            before = list(verdicts)
             verdicts = _consult_word_tier(
                 undecided, verdicts, open_positions
             )
+            word_decided_here = {
+                k for k in open_positions
+                if before[k] is None and verdicts[k] is not None
+            }
         except Exception as e:  # tier must never lose states
             log.debug("word tier unavailable in prune: %s", e)
 
     from mythril_tpu.resilience.budget import budget_expired
 
-    for state, verdict in zip(undecided, verdicts):
+    for k, (state, verdict) in enumerate(zip(undecided, verdicts)):
+        # ledger: lanes that went through batch_check_states were
+        # already recorded there (including tail demotions); only the
+        # prune-level decisions of a batchless round are lanes of their
+        # own (kind "prune"), so nothing is counted twice
         if verdict is True:
             feasible.append(state)
+            if not use_batch and k in word_decided_here:
+                ledger.single("prune", "word", "sat")
         elif verdict is False:
+            if not use_batch and k in word_decided_here:
+                ledger.single("prune", "word", "unsat")
             continue
         else:  # undecided by the batch pass: authoritative CDCL check
             if budget_expired():
@@ -121,8 +139,16 @@ def _prune_infeasible(states: List) -> List:
                 # Dropping an undecided state can only narrow the
                 # partial report's prefix, never invent a finding —
                 # and the report is already flagged partial
+                if not use_batch:
+                    ledger.count_transition("dropped")
+                    ledger.single("prune", "tail", "undecided")
                 continue
-            if state.world_state.constraints.is_possible:
+            possible = state.world_state.constraints.is_possible
+            if not use_batch:
+                ledger.single(
+                    "prune", "tail", "sat" if possible else "unsat"
+                )
+            if possible:
                 feasible.append(state)
     return feasible
 

@@ -223,6 +223,9 @@ class LaserEVM:
             # Frontier pruning across transactions: the reference issues
             # one solver call per open state (svm.py:201-204); here the
             # whole frontier goes through one batched pass.
+            from mythril_tpu.observability.ledger import set_origin
+
+            set_origin(tx_index=i)
             with obs.span("svm.transaction", cat="svm", tx=i,
                           open_states=len(self.open_states)):
                 old_states = self.open_states

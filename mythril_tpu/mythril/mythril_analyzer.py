@@ -251,6 +251,15 @@ class MythrilAnalyzer:
         execution_info = None
         shard = self.campaign.shard_corpus and len(self.contracts) > 1
         for index, contract in enumerate(self.contracts):
+            # lane-ledger origin: every lane record produced while this
+            # contract executes carries its name (per-contract
+            # attribution in /debug/lanes and --lane-ledger-out)
+            from mythril_tpu.observability.ledger import set_origin
+
+            set_origin(
+                contract=getattr(contract, "name", "") or "contract",
+                tx_index=None,
+            )
             # contract-level data parallelism: pin this contract's
             # device work to devices[index % n] (no-op on 1 device)
             with obs.span("analyzer.contract", cat="analyzer",

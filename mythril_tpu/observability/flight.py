@@ -99,10 +99,22 @@ class FlightRecorder:
                 seq = self._seq
             directory = self._resolve_dir()
             os.makedirs(directory, exist_ok=True)
-            path = os.path.join(
-                directory,
-                f"mythril-flight-{os.getpid()}-{seq:03d}-{reason}.json",
-            )
+            # the monotonic sequence keeps back-to-back trips from
+            # colliding within one recorder; the existence bump covers
+            # a fresh recorder (tests, re-exec) or a recycled pid
+            # landing on a predecessor's file — a dump must never
+            # silently overwrite an earlier post-mortem
+            while True:
+                path = os.path.join(
+                    directory,
+                    f"mythril-flight-{os.getpid()}-{seq:03d}-"
+                    f"{reason}.json",
+                )
+                if not os.path.exists(path):
+                    break
+                with self._lock:
+                    self._seq += 1
+                    seq = self._seq
             payload = {
                 "traceEvents": events,
                 "displayTimeUnit": "ms",

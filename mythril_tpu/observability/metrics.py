@@ -41,6 +41,33 @@ def _fmt(value) -> str:
     return repr(value) if isinstance(value, float) else str(value)
 
 
+def escape_label_value(value: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, newline
+    and double-quote must be escaped (contract source paths — the
+    ledger's contract label — can contain any of them; an unescaped
+    quote corrupts the whole exposition)."""
+    return (
+        str(value)
+        .replace("\\", r"\\")
+        .replace("\n", r"\n")
+        .replace('"', r'\"')
+    )
+
+
+def escape_help(text: str) -> str:
+    """HELP-line escaping per the text-format spec: backslash and
+    newline (a literal newline would terminate the HELP line early and
+    leave the remainder as a garbage sample)."""
+    return str(text).replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _base_name(sample_name: str) -> str:
+    """Metric name without the label set (``foo{bar="x"}`` -> ``foo``)
+    — HELP/TYPE lines are emitted once per base name, while sample
+    dedupe keys on the full labeled name."""
+    return sample_name.split("{", 1)[0]
+
+
 class Counter:
     """Monotonic-by-convention numeric cell.  ``set`` exists for the
     telemetry shim (per-contract resets, checkpoint restore)."""
@@ -151,11 +178,15 @@ class MetricsRegistry:
             self._collectors.append(collect)
 
     def render(self) -> str:
-        """Prometheus text exposition.  Each metric name is emitted
-        exactly once: registered metrics win over collector mirrors of
-        the same name."""
+        """Prometheus text exposition.  Each sample name (including its
+        label set) is emitted exactly once — registered metrics win
+        over collector mirrors of the same name — while HELP/TYPE
+        lines are emitted once per *base* name so labeled series from
+        collectors stay spec-shaped.  HELP text is escaped per the
+        text-format rules (see :func:`escape_help`)."""
         lines: List[str] = []
-        emitted = set()
+        emitted = set()       # full sample names (with labels)
+        emitted_meta = set()  # base names whose HELP/TYPE went out
         with self._lock:
             metrics = list(self._metrics.values())
             collectors = list(self._collectors)
@@ -163,8 +194,11 @@ class MetricsRegistry:
             if metric.name in emitted:
                 continue
             emitted.add(metric.name)
+            emitted_meta.add(metric.name)
             if metric.help:
-                lines.append(f"# HELP {metric.name} {metric.help}")
+                lines.append(
+                    f"# HELP {metric.name} {escape_help(metric.help)}"
+                )
             lines.append(f"# TYPE {metric.name} {metric.kind}")
             for sample_name, value in metric.samples():
                 lines.append(f"{sample_name} {_fmt(value)}")
@@ -177,9 +211,14 @@ class MetricsRegistry:
                 if name in emitted:
                     continue
                 emitted.add(name)
-                if help_:
-                    lines.append(f"# HELP {name} {help_}")
-                lines.append(f"# TYPE {name} {kind}")
+                base = _base_name(name)
+                if base not in emitted_meta and base not in emitted:
+                    emitted_meta.add(base)
+                    if help_:
+                        lines.append(
+                            f"# HELP {base} {escape_help(help_)}"
+                        )
+                    lines.append(f"# TYPE {base} {kind}")
                 lines.append(f"{name} {_fmt(value)}")
         return "\n".join(lines) + "\n"
 
@@ -219,6 +258,15 @@ def _async_stats_collector():
                    "AsyncStats field (ops/async_dispatch.py)", value)
 
 
+def _ledger_collector():
+    """Lazy pass-through to the lane ledger's own collector (the
+    registry is created before the ledger module loads, and a test
+    registry reset must re-attach it automatically)."""
+    from mythril_tpu.observability.ledger import _ledger_collector as c
+
+    yield from c()
+
+
 def _trace_collector():
     from mythril_tpu.observability.flight import get_flight_recorder
     from mythril_tpu.observability.spans import get_tracer
@@ -250,6 +298,7 @@ def get_registry() -> MetricsRegistry:
                 registry.register_collector(_dispatch_stats_collector)
                 registry.register_collector(_async_stats_collector)
                 registry.register_collector(_trace_collector)
+                registry.register_collector(_ledger_collector)
                 _registry = registry
     return _registry
 

@@ -41,6 +41,7 @@ import json
 import os
 import threading
 import time
+import uuid
 from typing import Dict, Optional
 
 #: event-buffer cap (events beyond it are dropped, counted, and still
@@ -171,6 +172,18 @@ class Tracer:
         self._cap = _env_cap()
         self._totals: Dict[str, float] = {}  # name -> cumulative seconds
         self._counts: Dict[str, int] = {}    # name -> completed spans
+        # advisory live view: thread ident -> that thread's span stack
+        # (the list object itself; registered once per thread, read by
+        # the /debug/requests endpoint without touching the hot path)
+        self._all_stacks: Dict[int, list] = {}
+        # cross-process trace identity: minted at the CLI/serve edge,
+        # propagated through the coalescer scope stamps and the fleet
+        # lease protocol so one request renders as one Perfetto trace
+        self.trace_id: Optional[str] = None
+        # pids already claimed by absorbed worker streams: a respawned
+        # fleet worker reusing an earlier worker's pid must not merge
+        # into its predecessor's Perfetto track
+        self._absorbed_pids: Dict[str, int] = {}
         self.span_count = 0
         self.instant_count = 0
         self.dropped = 0
@@ -214,7 +227,21 @@ class Tracer:
         stack = getattr(self._tls, "stack", None)
         if stack is None:
             stack = self._tls.stack = []
+            # one dict write per thread lifetime: the live-span view
+            # (/debug/requests "phase") reads these lists advisorily
+            self._all_stacks[threading.get_ident()] = stack
         return stack
+
+    def live_spans(self) -> Dict[int, str]:
+        """Advisory snapshot of each thread's innermost open span name
+        (the serve plane's ``/debug/requests`` phase field).  Reads the
+        per-thread stacks without locking — a torn read can at worst
+        name a span that just closed."""
+        out = {}
+        for tid, stack in list(self._all_stacks.items()):
+            if stack:
+                out[tid] = stack[-1]
+        return out
 
     def _record_span(self, name, cat, t0_ns, dur_ns, attrs) -> None:
         event = {
@@ -264,20 +291,75 @@ class Tracer:
 
         get_flight_recorder().record(event)
 
-    def absorb_events(self, events: list) -> int:
+    def record_counter(self, name: str, values: dict) -> None:
+        """Perfetto counter track (``ph: "C"``): live lanes, frontier
+        queue depth, resident-pool rows ride the trace as numeric
+        series alongside the spans."""
+        event = {
+            "name": name,
+            "cat": "counter",
+            "ph": "C",
+            "ts": (time.perf_counter_ns() - self._epoch_ns) / 1e3,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "args": {k: float(v) for k, v in values.items()},
+        }
+        with self._lock:
+            if self.record_events:
+                if len(self._events) < self._cap:
+                    self._events.append(event)
+                else:
+                    self.dropped += 1
+
+    def absorb_events(self, events: list, worker: Optional[str] = None,
+                      trace_id: Optional[str] = None) -> int:
         """Merge pre-built trace events from another process (a fleet
         worker's span stream) into the buffer so ``--trace-out``
-        renders one timeline — worker events keep their own ``pid``,
-        so Perfetto shows them as separate process tracks.  Per-name
-        *totals* are deliberately NOT updated: the phase buckets
-        (cone/sweep/tail...) describe THIS process's wall, and folding
-        a worker's spans in would double-count time the coordinator
-        spent waiting on it.  Returns the number absorbed."""
+        renders one timeline.  Per-name *totals* are deliberately NOT
+        updated: the phase buckets (cone/sweep/tail...) describe THIS
+        process's wall, and folding a worker's spans in would
+        double-count time the coordinator spent waiting on it.
+
+        ``worker`` names the stream: its events are re-pidded onto a
+        synthetic pid unique to that worker, so a respawned worker that
+        reuses an earlier worker's OS pid (pid recycling is routine
+        under heavy respawn) cannot silently merge two workers' streams
+        into one Perfetto track, and a ``process_name`` metadata event
+        labels the track.  ``trace_id`` re-parents the stream under the
+        request's trace identity (every absorbed event gains
+        ``args.trace_id``).  Returns the number absorbed."""
         absorbed = 0
+        remap_pid = None
         with self._lock:
+            if worker is not None:
+                remap_pid = self._absorbed_pids.get(worker)
+                if remap_pid is None:
+                    # own pid is reserved; synthetic pids grow downward
+                    # from a range no OS hands out, one per worker name
+                    remap_pid = 1_000_000 + len(self._absorbed_pids) + 1
+                    self._absorbed_pids[worker] = remap_pid
+                    if self.record_events and len(self._events) < (
+                        self._cap
+                    ):
+                        label = f"fleet-worker {worker}"
+                        if trace_id:
+                            label += f" [trace {trace_id}]"
+                        self._events.append({
+                            "name": "process_name", "ph": "M",
+                            "pid": remap_pid, "tid": 0,
+                            "args": {"name": label},
+                        })
             for event in events:
                 if not isinstance(event, dict) or "ph" not in event:
                     continue
+                if remap_pid is not None or trace_id is not None:
+                    event = dict(event)
+                    if remap_pid is not None:
+                        event["pid"] = remap_pid
+                    if trace_id is not None:
+                        args = dict(event.get("args") or {})
+                        args["trace_id"] = trace_id
+                        event["args"] = args
                 if len(self._events) < self._cap:
                     if self.record_events:
                         self._events.append(event)
@@ -316,15 +398,33 @@ class Tracer:
     def export_chrome(self, path: str) -> str:
         """Write the Chrome/Perfetto ``trace_event`` JSON.  The object
         form (``{"traceEvents": [...]}``) is used so metadata rides
-        alongside without breaking loaders."""
+        alongside without breaking loaders.  When the buffer cap
+        dropped events, a ``trace.truncated`` instant marks the
+        timeline itself — a consumer must not mistake a capped trace
+        for a complete one (the registry's
+        ``mythril_tpu_trace_dropped_events`` counter carries the same
+        number)."""
+        events = self.events()
+        if self.dropped:
+            last_ts = max(
+                (e.get("ts", 0.0) for e in events if "ts" in e),
+                default=0.0,
+            )
+            events.append({
+                "name": "trace.truncated", "cat": "meta", "ph": "i",
+                "s": "g", "ts": last_ts, "pid": os.getpid(), "tid": 0,
+                "args": {"dropped_events": int(self.dropped),
+                         "cap": int(self._cap)},
+            })
         payload = {
-            "traceEvents": self.events(),
+            "traceEvents": events,
             "displayTimeUnit": "ms",
             "otherData": {
                 "producer": "mythril-tpu observability plane",
                 "span_events": self.span_count,
                 "instant_events": self.instant_count,
                 "dropped_events": self.dropped,
+                "trace_id": self.trace_id,
             },
         }
         tmp = path + ".tmp"
@@ -363,6 +463,31 @@ def instant(name: str, cat: str = "event", **attrs) -> None:
     if not tracer.enabled:
         return
     tracer.record_instant(name, cat, attrs)
+
+
+def counter(name: str, **values) -> None:
+    """Record a Perfetto counter-track sample (live lanes, frontier
+    queue depth, pool rows).  No-op when tracing is off — one attribute
+    check, same contract as :func:`instant`."""
+    tracer = _tracer
+    if not tracer.enabled:
+        return
+    tracer.record_counter(name, values)
+
+
+def new_trace_id() -> str:
+    """Mint a request/run trace identity (hex, collision-safe across
+    hosts) — done once at the CLI or serve edge and propagated through
+    coalescer scope stamps and the fleet lease protocol."""
+    return uuid.uuid4().hex[:16]
+
+
+def set_trace_id(trace_id: Optional[str]) -> None:
+    _tracer.trace_id = trace_id
+
+
+def get_trace_id() -> Optional[str]:
+    return _tracer.trace_id
 
 
 def traced(name: str, cat: str = "pipeline"):

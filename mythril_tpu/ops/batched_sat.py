@@ -323,6 +323,7 @@ class DevicePool:
                 pass
         dispatch_stats.pool_uploads += 1
         dispatch_stats.h2d_bytes += int(mat.nbytes)
+        obs.counter("pool.rows", resident=real_rows, bucket=target_c)
         self._adj_np = None
         self._adj_dev = None
         self.num_vars = self._bucket(num_vars)
@@ -365,6 +366,8 @@ class DevicePool:
             # the dispatch ships only the appended rows, not the pool
             dispatch_stats.delta_uploads += 1
             dispatch_stats.h2d_bytes += int(rows.nbytes)
+            obs.counter("pool.rows", resident=self.filled,
+                        bucket=self.num_clauses)
             # appended rows (CDCL learnts, device-learned nogoods)
             # need adjacency entries too — rebuilt lazily on the next
             # frontier dispatch
@@ -804,6 +807,9 @@ class BatchedSatBackend:
             # the sharded layout re-broadcasts the pool mirror per
             # dispatch; the resident-pool savings are single-chip only
             dispatch_stats.h2d_bytes += int(pool_lits_np.nbytes)
+            # the mesh solve is monolithic (no per-round retirement),
+            # so the lane counter samples once per dispatch
+            obs.counter("lanes.live", live=batch)
 
             def _solve_mesh():
                 faults.maybe_fault_dispatch()
@@ -1068,6 +1074,18 @@ class BatchedSatBackend:
         for budget in budgets:
             if live.size == 0:
                 break
+            # counter tracks beside the span timeline: live lanes per
+            # round, and — in frontier mode — how many recently-
+            # assigned queue entries the event-driven rounds still hold
+            # (the queue sum is only worth computing when it will land
+            # on a timeline)
+            if obs.get_tracer().enabled:
+                obs.counter("lanes.live", live=int(live.size), bucket=B)
+                if frontier is not None:
+                    obs.counter(
+                        "frontier.queue_depth",
+                        queued=int(state["recent"][: live.size].sum()),
+                    )
             if drain_requested():
                 # cooperative drain checkpoint: abandon the remaining
                 # rounds — survivors retire undecided (the CDCL tail or
@@ -1097,6 +1115,15 @@ class BatchedSatBackend:
                 )
             for local in quarantined:
                 state["status"][local] = 3  # undecided -> CDCL tail
+            if quarantined:
+                # bisection cannot name the original state index from
+                # down here, so quarantines land in the ledger as an
+                # aggregate transition (the lanes themselves settle as
+                # tail-demoted when the batch closes)
+                from mythril_tpu.observability.ledger import get_ledger
+
+                get_ledger().count_transition("quarantined",
+                                              len(quarantined))
             dispatch_stats.rounds += 1
             if frontier is not None:
                 # device_sweeps counts FULL sweeps only, so the
@@ -1685,6 +1712,29 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
     # consume any finished async prefetch first: its UNSAT memos and
     # remembered models decide lanes of THIS frontier below for free
     get_async_dispatcher().harvest(ctx)
+    # per-lane attribution ledger (observability/ledger.py): every lane
+    # entering this funnel gets a lifecycle record; the try/finally
+    # guarantees conservation — whatever the funnel leaves undecided
+    # settles as tail-demoted when the batch closes
+    from mythril_tpu.observability.ledger import get_ledger
+
+    lanes_led = get_ledger().begin_batch(
+        "batch_check", len(constraint_sets)
+    )
+    try:
+        return _batch_check_states_inner(
+            ctx, constraint_sets, lanes_led
+        )
+    finally:
+        lanes_led.close()
+
+
+def _batch_check_states_inner(ctx, constraint_sets, lanes_led):
+    from mythril_tpu.smt import terms as T
+    from mythril_tpu.support.support_args import args
+
+    from mythril_tpu.ops.async_dispatch import get_async_dispatcher
+
     node_sets: List[Optional[List]] = []
     decided: List[Optional[bool]] = [None] * len(constraint_sets)
 
@@ -1707,6 +1757,7 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         if falsy:
             decided[i] = False
             node_sets.append(None)
+            lanes_led.decide(i, "structural", "unsat")
         else:
             node_sets.append(nodes)
 
@@ -1721,12 +1772,13 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
 
     stats = SolverStatistics()
     with obs.span("solver.probe", sink=(stats, "probe_s"), cat="solver",
-                  lanes=len(node_sets)):
+                  lanes=len(node_sets)) as probe_span:
         for i, nodes in enumerate(node_sets):
             if nodes is None:
                 continue
             if ctx.unsat_memo_hit(tuple(sorted(n.id for n in nodes))):
                 decided[i] = False  # permanent verdict (see BlastContext)
+                lanes_led.decide(i, "probe", "unsat")
                 continue
             # the per-query funnel may have solved this exact set
             # already (frontier sets repeat across rounds); a cached
@@ -1735,12 +1787,16 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
             cached = peek_model_verdict(constraint_sets[i])
             if cached is not None:
                 decided[i] = cached
+                lanes_led.decide(i, "probe",
+                                 "sat" if cached else "unsat")
                 continue
             if not getattr(args, "word_probing", True):
                 continue
             if ctx.probe_with_memo(nodes) is not None:
                 decided[i] = True
                 dispatch_stats.host_probe_sat += 1
+                lanes_led.decide(i, "probe", "sat")
+    lanes_led.tier_wall("probe", probe_span.elapsed_s)
 
     # word-level tier (smt/word_tier.py): batched interval + known-bits
     # propagation over the whole open frontier — interval-UNSAT and
@@ -1757,13 +1813,18 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
             nodes if decided[i] is None else None
             for i, nodes in enumerate(node_sets)
         ]
+        import time as _time
+
+        word_t0 = _time.perf_counter()
         word_verdicts, word_hints, word_envs = get_word_tier().decide(
             ctx, open_sets
         )
+        lanes_led.tier_wall("word", _time.perf_counter() - word_t0)
         for i, verdict in enumerate(word_verdicts):
             if verdict is None or decided[i] is not None:
                 continue
             decided[i] = verdict
+            lanes_led.decide(i, "word", "sat" if verdict else "unsat")
             if verdict and word_envs[i] is not None:
                 # a verified word-tier model serves sibling probes the
                 # same way a CDCL model would (no literal truth row, so
@@ -1802,6 +1863,10 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         except NotImplementedError:
             decided[i] = None
             open_indices.remove(i)
+            # a term outside the blaster's fragment: the lane can never
+            # reach a device tier — mark it opaque on its way to the
+            # tail so the artifact explains the demotion
+            lanes_led.transition(i, "opaque")
     if len(open_indices) < 2:
         return decided
 
@@ -1910,9 +1975,20 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
         # this round (verdicts unchanged — exactly what an undecided
         # device lane does) and a later merged dispatch pays them
         # back through the memo/nogood channel
+        lanes_led.transition_open(open_indices, "deferred")
         return decided
     extras = admitted
     prefetch_inflight = get_async_dispatcher().pending is not None
+    # ledger: the open lanes enter a device tier now.  Which kernel
+    # family answers (event-driven frontier rounds vs dense full-batch
+    # sweeps) follows the frontier kill switch — the same branch the
+    # ladder itself takes
+    from mythril_tpu.ops.frontier import frontier_enabled
+
+    device_tier = "frontier" if frontier_enabled() else "sweep"
+    lanes_led.transition_open(open_indices, "dispatched")
+    sweeps_before = dispatch_stats.device_sweeps
+    learned_before = dispatch_stats.learned_clauses
     # the span is the timing primitive: it feeds device_s whether or
     # not tracing is on, and lands on the --trace-out timeline when it
     # is (observability/spans.py), so the two can never disagree
@@ -1923,6 +1999,13 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
             ctx, rep_sets + [q.lits for q in extras],
         )
     dispatch_elapsed = dispatch_span.elapsed_s
+    lanes_led.tier_wall(device_tier, dispatch_elapsed)
+    lanes_led.add_sweeps(
+        device_tier, dispatch_stats.device_sweeps - sweeps_before
+    )
+    lanes_led.add_learned(
+        dispatch_stats.learned_clauses - learned_before
+    )
     # attribution counters tally only real device (or interpret-mode
     # kernel) passes — a bail-out to the CDCL tail is not a dispatch
     engaged = getattr(backend, "device_engaged", False)
@@ -1961,6 +2044,7 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
                     decided[i] = None  # tail re-solves with full budget
                     continue
             decided[i] = False
+            lanes_led.decide(i, device_tier, "unsat")
             # device UNSAT is permanent (the pool only gains implied
             # clauses): memoize the verdict and learn the assumption
             # nogood so the CDCL and future dispatches inherit the
@@ -1986,6 +2070,14 @@ def batch_check_states(constraint_sets) -> List[Optional[bool]]:
                 ok = False
                 break
         decided[i] = True if ok else None
+        if ok:
+            # a host-verified model: attributed to the device tier that
+            # produced the assignment, or to the probe tier when the
+            # dispatch bailed out and the zero assignment happened to
+            # verify (host evaluation did the deciding then)
+            lanes_led.decide(
+                i, device_tier if engaged else "probe", "sat"
+            )
         if first_for_lane:
             if ok:
                 # a verified device model serves future host probes the

@@ -75,14 +75,18 @@ COALESCE_MAX_AGE_S = 5.0  # a queue older than this stops deferring
 
 #: one deferred lane: dedupe key (sorted assumption lits), the literal
 #: set, the constraint nodes (for the UNSAT memo), the original
-#: constraint objects (for model verification at merge time), and the
-#: admitting request's scope (serve mode; None for CLI runs)
+#: constraint objects (for model verification at merge time), the
+#: admitting request's scope (serve mode; None for CLI runs), and the
+#: admitting request's trace id (so a merged dispatch that carries
+#: another request's lanes stays attributable on both timelines)
 QueuedLane = namedtuple(
-    "QueuedLane", "key lits nodes constraints scope", defaults=(None,)
+    "QueuedLane", "key lits nodes constraints scope trace",
+    defaults=(None, None),
 )
 
 _serve_mode = False
 _request_scope = None
+_request_trace = None
 
 
 def set_serve_mode(enabled: bool) -> None:
@@ -97,12 +101,14 @@ def serve_mode() -> bool:
     return _serve_mode
 
 
-def set_request_scope(scope) -> None:
+def set_request_scope(scope, trace_id=None) -> None:
     """Stamp lanes queued from here on with ``scope`` (the serve
     engine's request id) so :func:`purge_scope` can drop an aborted
-    request's lanes."""
-    global _request_scope
+    request's lanes, and with the request's ``trace_id`` so
+    cross-request merges keep both requests' trace identities."""
+    global _request_scope, _request_trace
     _request_scope = scope
+    _request_trace = trace_id
 
 
 def purge_scope(scope) -> int:
@@ -219,7 +225,7 @@ class LaneCoalescer:
                 self.queue.setdefault(
                     key,
                     QueuedLane(key, list(lits), nodes, cons,
-                               _request_scope),
+                               _request_scope, _request_trace),
                 )
             self.deferrals += 1
             dispatch_stats.coalesce_deferred += len(rep_sets)
@@ -227,6 +233,19 @@ class LaneCoalescer:
         extras = self.drain(ctx, exclude=current)
         self.deferrals = 0
         self.dispatched += 1
+        foreign = sorted({
+            q.trace for q in extras
+            if q.trace is not None and q.trace != _request_trace
+        })
+        if foreign:
+            # a cross-request merge: the dispatch about to run carries
+            # lanes minted under other requests' trace ids — put that
+            # on the timeline so neither request's trace has a silent
+            # gap (docs/observability.md, trace-id propagation rules)
+            from mythril_tpu.observability import spans as obs
+
+            obs.instant("coalesce.merge_traces", cat="dispatch",
+                        traces=foreign, lanes=len(extras))
         return extras
 
     def drain(self, ctx, exclude=frozenset()) -> List[QueuedLane]:

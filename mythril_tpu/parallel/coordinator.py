@@ -720,6 +720,113 @@ class Coordinator:
             self._declare_dead(seat, "grant send failed")
 
     # ------------------------------------------------------------------
+    # live introspection
+    # ------------------------------------------------------------------
+
+    def debug_status(self) -> dict:
+        """The fleet half of the ``/debug/requests`` surface: every
+        lease's state/epoch/attempts/holder and every seat's liveness,
+        plus the run's trace identity — what ``myth top`` renders when
+        pointed at a coordinator's debug port."""
+        from mythril_tpu.observability import get_trace_id
+
+        now = self.clock()
+        return {
+            "role": "coordinator",
+            "trace_id": get_trace_id(),
+            "leases": [
+                {
+                    "lease_id": lease.lease_id,
+                    "state": lease.state,
+                    "epoch": lease.epoch,
+                    "attempts": lease.attempts,
+                    "worker": lease.worker_id,
+                    "states": lease.n_states,
+                    "tx_index": lease.tx_index,
+                    "running_s": round(now - lease.granted_at, 1)
+                    if lease.state == RUNNING else None,
+                }
+                for lease in sorted(self.leases.values(),
+                                    key=lambda l: l.lease_id)
+            ],
+            "seats": [
+                {
+                    "worker_id": seat.worker_id,
+                    "dead": seat.dead,
+                    "lease": seat.lease_id,
+                    "connected": self._connected(seat),
+                }
+                for seat in sorted(self.seats.values(),
+                                   key=lambda s: s.worker_id)
+            ],
+        }
+
+    def open_debug_listener(self) -> Optional[int]:
+        """Optional localhost HTTP debug plane
+        (``MYTHRIL_TPU_FLEET_DEBUG_PORT``; 0 = ephemeral): serves
+        ``/debug/requests`` (the lease/seat status above) and
+        ``/debug/lanes`` (the coordinator process's ledger aggregates)
+        so ``myth top`` can watch a CLI fleet run the way it watches a
+        server.  Returns the bound port or None when the knob is
+        unset."""
+        import json as _json
+        from http.server import (
+            BaseHTTPRequestHandler, ThreadingHTTPServer,
+        )
+
+        port_env = os.environ.get("MYTHRIL_TPU_FLEET_DEBUG_PORT")
+        if port_env is None:
+            return None
+        try:
+            port = int(port_env)
+        except ValueError:
+            return None
+        coordinator = self
+
+        class _DebugHandler(BaseHTTPRequestHandler):
+            def log_message(self, format, *args):  # noqa: A002
+                pass
+
+            def do_GET(self):
+                from mythril_tpu.observability.ledger import get_ledger
+
+                path = self.path.split("?", 1)[0]
+                if path == "/debug/requests":
+                    body = coordinator.debug_status()
+                elif path == "/debug/lanes":
+                    body = get_ledger().snapshot()
+                else:
+                    body = {"error": {"code": "not_found"}}
+                payload = _json.dumps(body).encode("utf-8")
+                self.send_response(
+                    404 if "error" in body else 200
+                )
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._debug_httpd = ThreadingHTTPServer(
+            ("127.0.0.1", port), _DebugHandler
+        )
+        self._debug_httpd.daemon_threads = True
+        threading.Thread(
+            target=self._debug_httpd.serve_forever,
+            name="fleet-debug-http", daemon=True,
+        ).start()
+        return self._debug_httpd.server_address[1]
+
+    def close_debug_listener(self) -> None:
+        httpd = getattr(self, "_debug_httpd", None)
+        if httpd is not None:
+            try:
+                httpd.shutdown()
+                httpd.server_close()
+            except OSError:
+                pass
+            self._debug_httpd = None
+
+    # ------------------------------------------------------------------
     # the run loop (real mode)
     # ------------------------------------------------------------------
 
@@ -786,6 +893,7 @@ class Coordinator:
 
     def shutdown(self) -> None:
         self.close_listener()
+        self.close_debug_listener()
         for seat in self.seats.values():
             handle = seat.handle
             if handle is None:

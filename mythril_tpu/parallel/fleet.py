@@ -320,13 +320,24 @@ def _merge_result(lease, tracer) -> None:
                     lease.lease_id, exc_info=True)
         return
     _merge_findings(body.get("findings"))
+    from mythril_tpu.observability.ledger import get_ledger
+
+    get_ledger().merge_snapshot(body.get("ledger"))
     worker_id = (lease.result or {}).get("worker_id", "?")
     wall_s = float((lease.result or {}).get("wall_s", 0.0))
     if tracer is not None:
         tracer.add_external_total(f"fleet.worker:{worker_id}", wall_s)
         events = body.get("spans")
         if events:
-            tracer.absorb_events(events)
+            # named absorb: the stream gets its own synthetic Perfetto
+            # pid (a respawned worker reusing a dead worker's OS pid
+            # must not merge into its track) and every event is
+            # re-parented under the request's trace id
+            tracer.absorb_events(
+                events, worker=str(worker_id),
+                trace_id=(lease.result or {}).get("trace_id")
+                or tracer.trace_id,
+            )
 
 
 def _explore_inprocess(laser, address: int, tx_index: int,
@@ -413,6 +424,11 @@ def run_fleet(laser, address: int, tx_index: int) -> bool:
         "args": _args_snapshot(),
         "trace": bool(obs.get_tracer().enabled
                       and obs.get_tracer().record_events),
+        # the request/run trace identity crosses the process boundary
+        # in the lease payload: workers stamp their span streams with
+        # it and the coordinator re-parents them under it on absorb,
+        # so one `--workers N` analysis renders as ONE Perfetto trace
+        "trace_id": obs.get_trace_id(),
     }
     config = FleetConfig.from_env(workers)
     base_dir = tempfile.mkdtemp(prefix="mtpu-fleet-")
@@ -426,6 +442,7 @@ def run_fleet(laser, address: int, tx_index: int) -> bool:
         )
         coordinator.add_lease(lease_dir, tx_index, len(chunk))
     coordinator.open_listener()
+    coordinator.open_debug_listener()
     began = time.monotonic()
     try:
         with obs.span("fleet.run", cat="fleet", leases=shards,
@@ -615,6 +632,12 @@ def _worker_reset_scope(journal_dir: str, knobs: dict) -> None:
         module.cache.clear()
     dispatch_stats.reset()
     async_stats.reset()
+    # per-lease ledger scope: each lease's lanes ship home with ITS
+    # result, so a worker serving a second lease must not re-ship the
+    # first one's aggregates (origin stamps survive the reset)
+    from mythril_tpu.observability.ledger import get_ledger
+
+    get_ledger().reset()
     stats = SolverStatistics()
     stats.enabled = True
     stats.reset()
@@ -647,6 +670,14 @@ def _worker_run_lease(session: _WorkerSession, header: dict) -> None:
     if payload.get("trace"):
         tracer.enable(record_events=True)
         tracer.reset()
+    # adopt the coordinator's trace identity: this worker's spans and
+    # lane records belong to the same request timeline
+    obs.set_trace_id(payload.get("trace_id"))
+    from mythril_tpu.observability.ledger import set_origin
+
+    set_origin(contract=payload.get("name"),
+               scope=header.get("lease_id"),
+               trace=payload.get("trace_id"))
     _worker_reset_scope(journal_dir, payload.get("args", {}))
     with session.lease_lock:
         session.lease_header = header
@@ -698,9 +729,15 @@ def _worker_run_lease(session: _WorkerSession, header: dict) -> None:
     partial = bool(
         drain_requested() or get_checkpoint_plane().partial
     )
+    from mythril_tpu.observability.ledger import get_ledger
+
     body = pickle.dumps({
         "findings": findings,
         "spans": tracer.events() if payload.get("trace") else None,
+        # lane-ledger aggregates ride home with the result so the
+        # coordinator's artifact covers the whole fleet (records stay
+        # local — bounded memory on both sides)
+        "ledger": get_ledger().snapshot(),
     }, protocol=4)
     session.send(
         {
@@ -708,6 +745,7 @@ def _worker_run_lease(session: _WorkerSession, header: dict) -> None:
             "lease_id": header["lease_id"],
             "stamp": header["stamp"],
             "worker_id": session.worker_id,
+            "trace_id": payload.get("trace_id"),
             "partial": partial,
             "found_swcs": sorted(
                 {i.swc_id for i in issues if i.swc_id}

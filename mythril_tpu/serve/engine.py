@@ -32,6 +32,7 @@ that must not leak between callers:
   rebalance instead of the process dying.
 """
 
+import collections
 import logging
 import math
 import threading
@@ -60,6 +61,10 @@ class AnalysisEngine:
         self.requests_failed = 0
         self.requests_partial = 0
         self.in_flight = None  # request id while executing
+        # live introspection (/debug/requests): the in-flight request's
+        # descriptor and a bounded history of recently finished ones
+        self.in_flight_info = None
+        self.recent_requests = collections.deque(maxlen=16)
         self.started_at = time.time()
         self._thread = threading.Thread(
             target=self._run, name="mythril-serve-engine", daemon=True
@@ -110,6 +115,47 @@ class AnalysisEngine:
         args.checkpoint_dir = None
         args.resume_from = None
         set_serve_mode(True)
+
+    def debug_requests(self) -> dict:
+        """The ``/debug/requests`` body: the in-flight request (phase =
+        the engine thread's innermost open span, deadline budget
+        remaining, per-tier lane counts so far) plus a bounded history
+        of finished ones.  Read from HTTP handler threads — everything
+        here is an advisory snapshot, nothing locks the engine."""
+        from mythril_tpu.observability import get_tracer
+        from mythril_tpu.observability.ledger import get_ledger
+        from mythril_tpu.resilience.budget import current_budget
+
+        in_flight = None
+        info = self.in_flight_info
+        if info is not None:
+            budget = current_budget()
+            in_flight = dict(info)
+            elapsed = time.monotonic() - in_flight.pop(
+                "started_monotonic"
+            )
+            in_flight["elapsed_s"] = round(elapsed, 3)
+            in_flight["budget_remaining_s"] = (
+                round(budget.remaining_s(), 3) if budget else None
+            )
+            phase = None
+            tid = self._thread.ident
+            if tid is not None:
+                phase = get_tracer().live_spans().get(tid)
+            in_flight["phase"] = phase
+            in_flight["lanes_by_tier"] = get_ledger().scope_snapshot(
+                info["request_id"]
+            )
+        return {
+            "in_flight": in_flight,
+            "recent": list(self.recent_requests),
+            "requests": {
+                "done": self.requests_done,
+                "failed": self.requests_failed,
+                "partial": self.requests_partial,
+            },
+            "queue_depths": self.queue.depths(),
+        }
 
     def degraded(self) -> bool:
         """True when the device was demoted (cached verdict only — a
@@ -172,12 +218,28 @@ class AnalysisEngine:
             })
             return
 
+        trace_id = request.trace_id
+        if trace_id is None:
+            from mythril_tpu.observability import new_trace_id
+
+            trace_id = new_trace_id()
         self.in_flight = rid
+        self.in_flight_info = {
+            "request_id": rid,
+            "trace_id": trace_id,
+            "contract": request.name,
+            "source": request.source,
+            "priority": request.priority,
+            "budget_s": round(budget_s, 3),
+            "started_monotonic": time.monotonic(),
+        }
         began = time.monotonic()
         try:
-            status, body = self._analyze(ticket, rid, budget_s)
+            status, body = self._analyze(ticket, rid, trace_id,
+                                         budget_s)
         finally:
             self.in_flight = None
+            self.in_flight_info = None
         elapsed = time.monotonic() - began
         self._m_latency.observe(ticket.queued_s())
         self.requests_done += 1
@@ -188,21 +250,40 @@ class AnalysisEngine:
             self._m_failed.inc()
         if isinstance(body, dict):
             body.setdefault("request_id", rid)
+            body.setdefault("trace_id", trace_id)
             body.setdefault("analysis_s", round(elapsed, 3))
+        self.recent_requests.appendleft({
+            "request_id": rid,
+            "trace_id": trace_id,
+            "contract": request.name,
+            "source": request.source,
+            "status": status,
+            "partial": bool(
+                isinstance(body, dict) and body.get("partial")
+            ),
+            "analysis_s": round(elapsed, 3),
+        })
         ticket.resolve(status, body)
 
-    def _analyze(self, ticket: Ticket, rid: str, budget_s: float):
+    def _analyze(self, ticket: Ticket, rid: str, trace_id: str,
+                 budget_s: float):
         """Run one analysis inside the full isolation scope; returns
         (status, body) and never raises."""
-        from mythril_tpu.observability import spans as obs
+        from mythril_tpu.observability import set_trace_id, spans as obs
         from mythril_tpu.resilience import budget as request_budget
 
         request = ticket.request
         try:
+            # the request's trace identity governs everything this
+            # execution produces: the span tree, the lane-ledger scope,
+            # the coalescer stamps, and — through the fleet payload —
+            # any worker processes it spawns
+            set_trace_id(trace_id)
             with obs.span("serve.request", cat="serve", rid=rid,
+                          trace_id=trace_id,
                           source=request.source, contract=request.name,
                           priority=request.priority):
-                self._reset_request_scope(rid)
+                self._reset_request_scope(rid, trace_id)
                 request_budget.install_budget(
                     budget_s, label=f"{request.source}/{rid}"
                 )
@@ -213,7 +294,8 @@ class AnalysisEngine:
         except Exception as exc:  # noqa: BLE001 — isolate the request
             return 500, self._fail_request(rid, request, exc)
 
-    def _reset_request_scope(self, rid: str) -> None:
+    def _reset_request_scope(self, rid: str,
+                             trace_id: str = None) -> None:
         """Per-request state: telemetry scopes and detection modules
         reset; the WARM solver state (blast context, resident pool,
         memo channels, model cache) deliberately survives — that
@@ -242,7 +324,15 @@ class AnalysisEngine:
         # the partial flag is per-request in serve mode: a prior
         # request's deadline drain must not mark this one partial
         get_checkpoint_plane().partial = False
-        set_request_scope(rid)
+        set_request_scope(rid, trace_id)
+        # lane-ledger origin: records produced by this request carry
+        # its contract name, scope and trace id (/debug/lanes keys the
+        # per-scope aggregates on rid)
+        from mythril_tpu.observability.ledger import set_origin
+
+        set_origin(contract=self.in_flight_info["contract"]
+                   if self.in_flight_info else None,
+                   tx_index=None, scope=rid, trace=trace_id)
 
     def _fire(self, request, rid: str, budget_s: float) -> dict:
         """The analysis proper (the bench/_analyze_one shape), plus the

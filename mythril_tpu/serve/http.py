@@ -16,6 +16,15 @@ Endpoints::
     GET  /metrics    the unified metrics registry, live, in Prometheus
                      text format (the same registry ``--metrics-out``
                      dumps at CLI exit)
+    GET  /debug/requests
+                     live introspection: the in-flight request (phase,
+                     deadline budget remaining, lane counts by tier)
+                     plus a bounded history of finished requests —
+                     what ``myth top`` polls
+    GET  /debug/lanes
+                     the lane-attribution ledger's aggregates (tier
+                     decisions, transitions, per-contract and
+                     per-request splits; observability/ledger.py)
 
 Shutdown: SIGTERM/SIGINT ride the resilience plane's cooperative drain
 (``install_signal_handlers``).  The serve loop notices, closes
@@ -99,6 +108,12 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)
+        elif path == "/debug/requests":
+            self._send_json(200, self._srv.engine.debug_requests())
+        elif path == "/debug/lanes":
+            from mythril_tpu.observability.ledger import get_ledger
+
+            self._send_json(200, get_ledger().snapshot())
         else:
             self._send_json(404, {"error": {
                 "code": "not_found", "message": f"no route {path!r}",

@@ -21,6 +21,12 @@ internals and its partial effects would contaminate the pool.
       "source": "team-abc",         optional caller id (breaker key)
       "max_depth": 128,             optional, 1..1024
       "modules": ["SuicideModule"], optional detector allow-list
+      "trace_id": "a1b2...",        optional caller-minted trace id
+                                    (hex/alnum, <= 64 chars) — the
+                                    server mints one otherwise; either
+                                    way it threads the whole request
+                                    (spans, ledger, fleet workers) and
+                                    comes back in the response
       "solc_json": {...}            optional solc settings (validated,
                                     reserved for source-level inputs)
     }
@@ -67,6 +73,7 @@ class AnalyzeRequest:
     source: str = "anonymous"
     max_depth: int = 128
     modules: Optional[List[str]] = None
+    trace_id: Optional[str] = None
     solc_json: Optional[dict] = field(default=None, repr=False)
 
 
@@ -183,6 +190,19 @@ def parse_analyze_request(raw: bytes, config) -> AnalyzeRequest:
                 "'modules' must be a list of detector names",
             )
 
+    trace_id = body.get("trace_id")
+    if trace_id is not None:
+        # a trace id crosses process boundaries and lands in Perfetto
+        # metadata and Prometheus-adjacent artifacts: keep the alphabet
+        # boring at the edge rather than escaping it everywhere inside
+        if not isinstance(trace_id, str) or not trace_id or (
+            len(trace_id) > 64
+        ) or not all(c.isalnum() or c in "-_" for c in trace_id):
+            raise RequestError(
+                "bad_trace_id",
+                "'trace_id' must be 1-64 chars of [A-Za-z0-9_-]",
+            )
+
     solc_json = body.get("solc_json")
     if solc_json is not None:
         # accept an object or a JSON string of one; anything else is
@@ -210,5 +230,6 @@ def parse_analyze_request(raw: bytes, config) -> AnalyzeRequest:
         source=source,
         max_depth=_bounded_int(body, "max_depth", 128, 1, MAX_DEPTH),
         modules=modules,
+        trace_id=trace_id,
         solc_json=solc_json,
     )

@@ -63,6 +63,11 @@ class Args(object, metaclass=Singleton):
         # dump destinations (--trace-out / --metrics-out; None = off)
         self.trace_out = None
         self.metrics_out = None
+        # per-lane attribution ledger artifact (--lane-ledger-out;
+        # schema mythril-tpu-lane-ledger/1, validated by
+        # scripts/trace_lint.py; None = no artifact, aggregates still
+        # feed /metrics and /debug/lanes)
+        self.lane_ledger_out = None
         # frontier fleet (mythril_tpu/parallel/fleet.py): shard the
         # transaction-boundary frontier into subtree leases across N
         # worker processes (--workers N).  None = defer to the

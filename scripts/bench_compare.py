@@ -43,11 +43,15 @@ import sys
 #: back — shows up here long before a corpus wall moves);
 #: sweeps_per_lane gates the device-native propagation tier (full
 #: sweeps per decided lane — dense sweeping creeping back past the
-#: event-driven frontier rounds trips this fence first)
+#: event-driven frontier rounds trips this fence first);
+#: tier_tail_pct (flattened out of the ledger's tier_decided_pct
+#: split by load_headline) gates the attribution funnel: the share of
+#: lanes demoted to the host CDCL tail growing means the word/device
+#: tiers stopped deciding — visible here before any wall-clock moves
 GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
          "device_sweeps", "h2d_bytes", "trace_overhead_s",
          "blast_s", "word_prop_s", "serve_warm_p50_s",
-         "sweeps_per_lane")
+         "sweeps_per_lane", "tier_tail_pct")
 #: gated metrics where LARGER is better (delta sign inverted):
 #: sustained warm-server throughput must not fall, the microbench
 #: device-vs-host ratio (both sides measured in the same run since the
@@ -65,20 +69,31 @@ MIN_BASE = 0.05
 def load_headline(path):
     """Headline dict of one artifact: the ``parsed`` block when the
     capture parsed it, else the last headline-shaped JSON line of the
-    raw tail (the 500-char-capped line bench.py prints last)."""
+    raw tail (the 500-char-capped line bench.py prints last).  The
+    ledger's ``tier_decided_pct`` dict is flattened to the scalar
+    ``tier_tail_pct`` so the regression loop can gate it."""
     with open(path) as fh:
         art = json.load(fh)
+    headline = None
     parsed = art.get("parsed")
     if isinstance(parsed, dict) and "metric" in parsed:
-        return parsed
-    for line in reversed(art.get("tail", "").splitlines()):
-        line = line.strip()
-        if line.startswith("{") and '"metric"' in line:
-            try:
-                return json.loads(line)
-            except ValueError:
-                continue
-    return None
+        headline = parsed
+    else:
+        for line in reversed(art.get("tail", "").splitlines()):
+            line = line.strip()
+            if line.startswith("{") and '"metric"' in line:
+                try:
+                    headline = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+    if isinstance(headline, dict):
+        split = headline.get("tier_decided_pct")
+        if isinstance(split, dict) and isinstance(
+            split.get("tail"), (int, float)
+        ):
+            headline.setdefault("tier_tail_pct", split["tail"])
+    return headline
 
 
 def round_number(path):

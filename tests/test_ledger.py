@@ -1,0 +1,514 @@
+"""Solver X-ray tests: the per-lane attribution ledger (lifecycle
+records, lane conservation, kill switch + disabled-path overhead),
+cross-process trace identity (serve edge, coalescer stamps, response
+surfaces), the live ``/debug/*`` introspection endpoints + ``myth top``
+rendering, and the ``scripts/trace_lint.py`` artifact validators."""
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from mythril_tpu.observability import flight, ledger, metrics, spans
+
+pytestmark = pytest.mark.obs
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+
+import trace_lint  # noqa: E402  (scripts/trace_lint.py)
+
+
+@pytest.fixture(autouse=True)
+def clean_plane(monkeypatch):
+    monkeypatch.delenv("MYTHRIL_TPU_TRACE", raising=False)
+    monkeypatch.delenv("MYTHRIL_TPU_LEDGER", raising=False)
+    spans.reset_for_tests()
+    metrics.reset_for_tests()
+    flight.reset_for_tests()
+    ledger.reset_for_tests()
+    yield
+    spans.reset_for_tests()
+    metrics.reset_for_tests()
+    flight.reset_for_tests()
+    ledger.reset_for_tests()
+
+
+# -- ledger unit behavior ---------------------------------------------------
+
+
+def test_batch_lifecycle_conservation_and_records():
+    led = ledger.get_ledger()
+    led.set_origin(contract="token.sol", tx_index=2, scope="req-1",
+                   trace="t-abc")
+    batch = led.begin_batch("batch_check", 5)
+    batch.decide(0, "structural", "unsat")
+    batch.decide(1, "word", "sat")
+    batch.transition(2, "dispatched")
+    batch.decide(2, "frontier", "unsat")
+    batch.transition(3, "deferred")
+    batch.tier_wall("word", 0.25)
+    batch.add_sweeps("frontier", 12)
+    batch.add_learned(3)
+    batch.close()  # lanes 3 (deferred) and 4 settle as tail
+
+    snap = led.snapshot()
+    assert snap["lanes_total"] == 5
+    assert sum(snap["decided"].values()) == 5  # conservation
+    assert snap["decided"]["structural"] == 1
+    assert snap["decided"]["word"] == 1
+    assert snap["decided"]["frontier"] == 1
+    assert snap["decided"]["tail"] == 2
+    assert snap["transitions"] == {"dispatched": 1, "deferred": 1}
+    assert snap["verdicts"]["tail:undecided"] == 2
+    assert snap["tier_wall_s"]["word"] == 0.25
+    assert snap["tier_sweeps"]["frontier"] == 12
+    assert snap["learned_clauses"] == 3
+    assert snap["by_contract"]["token.sol"]["tail"] == 2
+    assert led.scope_snapshot("req-1")["word"] == 1
+
+    records = {r["path"][-1]: r for r in led.records}
+    assert records["frontier"]["path"] == [
+        "opened", "dispatched", "frontier",
+    ]
+    origin = records["frontier"]["origin"]
+    assert origin == {"contract": "token.sol", "tx": 2,
+                      "scope": "req-1", "trace": "t-abc"}
+    deferred = [r for r in led.records
+                if r["path"] == ["opened", "deferred", "tail"]]
+    assert len(deferred) == 1
+
+    pct = led.tier_decided_pct()
+    assert pct == {"word": 20.0, "frontier": 20.0, "full": 0.0,
+                   "tail": 40.0}
+
+
+def test_first_decision_wins_and_single():
+    led = ledger.get_ledger()
+    batch = led.begin_batch("batch_check", 1)
+    batch.decide(0, "probe", "sat")
+    batch.decide(0, "tail", "undecided")  # ignored
+    batch.close()
+    led.single("prune", "tail", "unsat")
+    snap = led.snapshot()
+    assert snap["decided"]["probe"] == 1
+    assert snap["decided"]["tail"] == 1
+    assert snap["by_kind"] == {"batch_check": 1, "prune": 1}
+
+
+def test_record_cap_bounds_memory_but_not_aggregates(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_LEDGER_CAP", "64")
+    ledger.reset_for_tests()
+    led = ledger.get_ledger()
+    for _ in range(10):
+        batch = led.begin_batch("batch_check", 10)
+        for i in range(10):
+            batch.decide(i, "word", "unsat")
+        batch.close()
+    snap = led.snapshot()
+    assert snap["lanes_total"] == 100
+    assert snap["decided"]["word"] == 100  # aggregates keep counting
+    assert snap["records_kept"] == 64
+    assert snap["records_dropped"] == 36
+
+
+def test_kill_switch_and_disabled_overhead(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_LEDGER", "0")
+    ledger.reset_for_tests()
+    led = ledger.get_ledger()
+    assert not led.enabled
+    # the shared no-op singleton comes back, never an allocation
+    assert led.begin_batch("batch_check", 8) is led.begin_batch(
+        "prune", 8
+    )
+    led.single("prune", "tail", "unsat")
+    led.count_transition("quarantined", 3)
+    assert led.snapshot()["lanes_total"] == 0
+    batch = led.begin_batch("batch_check", 4)
+    n = 100_000
+    began = time.perf_counter()
+    for _ in range(n):
+        batch.decide(0, "word", "unsat")
+        batch.transition(1, "deferred")
+    per_call = (time.perf_counter() - began) / (2 * n)
+    assert per_call < 10e-6, f"disabled ledger {per_call * 1e6:.2f}us"
+    batch.close()
+
+
+def test_ledger_registry_series():
+    led = ledger.get_ledger()
+    batch = led.begin_batch("batch_check", 3)
+    batch.decide(0, "word", "unsat")
+    batch.close()
+    text = metrics.get_registry().render()
+    assert "mythril_tpu_ledger_lanes_total 3" in text
+    assert 'mythril_tpu_ledger_decided_total{tier="word"} 1' in text
+    assert 'mythril_tpu_ledger_decided_total{tier="tail"} 2' in text
+    assert "# TYPE mythril_tpu_ledger_decided_total counter" in text
+
+
+# -- lane conservation through the real funnel ------------------------------
+
+
+def _frontier(tag: str):
+    from mythril_tpu.smt import UGT, ULT, symbol_factory
+
+    lanes = []
+    for i in range(6):
+        x = symbol_factory.BitVecSym(f"{tag}{i}", 16)
+        if i % 2 == 0:
+            lanes.append([x == 3 + i])
+        else:  # UNSAT: x < 2 and x > 9
+            lanes.append(
+                [ULT(x, symbol_factory.BitVecVal(2, 16)),
+                 UGT(x, symbol_factory.BitVecVal(9, 16))]
+            )
+    return lanes
+
+
+@pytest.fixture
+def funnel(monkeypatch):
+    from mythril_tpu.ops.async_dispatch import get_async_dispatcher
+    from mythril_tpu.smt.solver import (
+        SolverStatistics, reset_blast_context,
+    )
+
+    reset_blast_context()
+    get_async_dispatcher().drop()
+    SolverStatistics().reset()
+    monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
+    monkeypatch.setenv("MYTHRIL_TPU_WORD_TIER", "0")
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setattr(args, "device_min_lanes", 2)
+    monkeypatch.setattr(args, "device_force_dispatch", True)
+    monkeypatch.setattr(args, "async_dispatch", False)
+    monkeypatch.setattr(args, "device_coalesce", False)
+    yield
+    get_async_dispatcher().drop()
+    reset_blast_context()
+
+
+def test_batch_check_states_conserves_lanes(funnel):
+    from mythril_tpu.laser.ethereum.state.constraints import Constraints
+    from mythril_tpu.ops.batched_sat import batch_check_states
+
+    led = ledger.get_ledger()
+    verdicts = batch_check_states(
+        [Constraints(lane) for lane in _frontier("lg")]
+    )
+    assert len(verdicts) == 6
+    snap = led.snapshot()
+    assert snap["lanes_total"] == 6
+    assert sum(snap["decided"].values()) == 6  # conservation
+    # the dispatch engaged: device tiers (or demotions) are recorded,
+    # and the dispatched transition names the lanes that went down
+    assert snap["transitions"].get("dispatched", 0) >= 1
+    device_decided = (
+        snap["decided"].get("frontier", 0)
+        + snap["decided"].get("sweep", 0)
+    )
+    assert device_decided >= 1, snap
+
+
+def test_batch_check_kill_switch_parity(funnel, monkeypatch):
+    from mythril_tpu.laser.ethereum.state.constraints import Constraints
+    from mythril_tpu.ops.batched_sat import batch_check_states
+
+    baseline = batch_check_states(
+        [Constraints(lane) for lane in _frontier("kp")]
+    )
+    from mythril_tpu.smt.solver import reset_blast_context
+
+    reset_blast_context()
+    monkeypatch.setenv("MYTHRIL_TPU_LEDGER", "0")
+    ledger.reset_for_tests()
+    killed = batch_check_states(
+        [Constraints(lane) for lane in _frontier("kp")]
+    )
+    assert killed == baseline  # verdicts identical with the ledger off
+    assert ledger.get_ledger().snapshot()["lanes_total"] == 0
+
+
+def test_prune_infeasible_records_batchless_lanes(funnel, monkeypatch):
+    from mythril_tpu.laser.batch import prune_infeasible
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setattr(args, "batched_solving", False)
+
+    from mythril_tpu.laser.ethereum.state.constraints import Constraints
+
+    class _View:
+        def __init__(self, constraints):
+            self.constraints = constraints
+            self.world_state = self
+
+    views = [_View(Constraints(lane)) for lane in _frontier("pr")]
+    kept = prune_infeasible(views)
+    assert len(kept) == 3  # the SAT half
+    snap = ledger.get_ledger().snapshot()
+    assert snap["by_kind"].get("prune", 0) == 6
+    assert sum(snap["decided"].values()) == snap["lanes_total"]
+
+
+# -- artifact + linter ------------------------------------------------------
+
+
+def test_export_and_trace_lint_round_trip(tmp_path, funnel):
+    from mythril_tpu.laser.ethereum.state.constraints import Constraints
+    from mythril_tpu.ops.batched_sat import batch_check_states
+
+    tracer = spans.get_tracer()
+    tracer.enable()
+    spans.set_trace_id(spans.new_trace_id())
+    batch_check_states([Constraints(lane) for lane in _frontier("xl")])
+    trace_path = str(tmp_path / "trace.json")
+    ledger_path = str(tmp_path / "ledger.json")
+    tracer.export_chrome(trace_path)
+    ledger.get_ledger().export_json(ledger_path)
+    assert trace_lint.lint_trace(json.load(open(trace_path))) == []
+    assert trace_lint.lint_ledger(json.load(open(ledger_path))) == []
+    payload = json.load(open(ledger_path))
+    assert payload["schema"] == "mythril-tpu-lane-ledger/1"
+    assert payload["conservation"]["lanes_total"] == payload[
+        "conservation"
+    ]["decided_total"]
+    # the dispatch rounds put counter tracks on the same timeline
+    trace = json.load(open(trace_path))
+    counters = {e["name"] for e in trace["traceEvents"]
+                if e["ph"] == "C"}
+    assert "lanes.live" in counters
+    assert "pool.rows" in counters
+
+
+def test_trace_lint_catches_violations():
+    bad_trace = {"traceEvents": [
+        {"name": "x", "ph": "X", "ts": 0.0, "pid": 1, "tid": 1},
+        {"name": "y", "ph": "?", "ts": 0.0, "pid": 1, "tid": 1},
+    ]}
+    findings = trace_lint.lint_trace(bad_trace)
+    assert any("dur" in f for f in findings)
+    assert any("unknown phase" in f for f in findings)
+
+    bad_ledger = {
+        "schema": "mythril-tpu-lane-ledger/1",
+        "cap": 10,
+        "aggregates": {
+            "lanes_total": 3,
+            "decided": {"word": 1},  # conservation violated
+            "by_kind": {}, "transitions": {},
+            "records_kept": 1, "records_dropped": 0,
+        },
+        "records": [
+            {"id": 1, "path": ["opened", "deferred", "word"],
+             "tier": "word", "verdict": "sat"},
+        ],
+        "conservation": {"lanes_total": 3, "decided_total": 1},
+    }
+    findings = trace_lint.lint_ledger(bad_ledger)
+    assert any("conservation violated" in f for f in findings)
+    assert any("illegal transition" in f for f in findings)
+    assert any("disagrees" in f for f in findings)
+
+
+def test_headline_carries_tier_split(tmp_path):
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    import bench
+    from tests.test_bench_headline import BASE_SUMMARY
+
+    summary = dict(BASE_SUMMARY)
+    summary["tier_decided_pct"] = {"word": 41.0, "frontier": 12.5,
+                                   "full": 3.1, "tail": 20.0}
+    payload = json.loads(bench.build_headline_line(summary, None, None))
+    assert payload["tier_decided_pct"]["tail"] == 20.0
+    assert len(json.dumps(payload)) <= 500
+    # ...and without ledger data, the key is absent (not null)
+    assert "tier_decided_pct" not in json.loads(
+        bench.build_headline_line(dict(BASE_SUMMARY), None, None)
+    )
+    # bench_compare flattens the split into the gated scalar
+    import bench_compare
+
+    art = tmp_path / "BENCH_r98.json"
+    art.write_text(json.dumps({"parsed": payload}))
+    headline = bench_compare.load_headline(str(art))
+    assert headline["tier_tail_pct"] == 20.0
+    assert "tier_tail_pct" in bench_compare.GATED
+
+
+# -- fleet merge: worker spans re-parent under the request trace -----------
+
+
+def test_fleet_merge_reparents_worker_spans_under_trace():
+    import pickle
+
+    from mythril_tpu.parallel.coordinator import Lease
+    from mythril_tpu.parallel.fleet import _merge_result
+
+    tracer = spans.get_tracer()
+    tracer.enable()
+    spans.set_trace_id("req-trace-1")
+    worker_events = [
+        {"name": "svm.transaction", "ph": "X", "ts": 5.0, "dur": 9.0,
+         "pid": 777, "tid": 1},
+        {"name": "cdcl.solve", "ph": "X", "ts": 6.0, "dur": 2.0,
+         "pid": 777, "tid": 1},
+    ]
+    lease = Lease(lease_id="lease1", journal_dir="/nonexistent",
+                  tx_index=1, n_states=2)
+    lease.result = {"worker_id": "w9", "trace_id": "req-trace-1",
+                    "wall_s": 1.5}
+    worker_ledger = {
+        "enabled": True, "lanes_total": 7, "batches": 2,
+        "by_kind": {"batch_check": 7},
+        "decided": {"word": 3, "tail": 4},
+        "verdicts": {"word:unsat": 3, "tail:undecided": 4},
+        "transitions": {"dispatched": 4},
+        "tier_wall_s": {"word": 0.5}, "tier_sweeps": {"sweep": 9},
+        "learned_clauses": 2,
+        "by_contract": {"fleet-target": {"word": 3, "tail": 4}},
+        "by_scope": {"lease1": {"word": 3, "tail": 4}},
+        "records_kept": 7, "records_dropped": 0,
+    }
+    lease.result_body = pickle.dumps({
+        "findings": {"issues": {}, "caches": {}},
+        "spans": worker_events,
+        "ledger": worker_ledger,
+    }, protocol=4)
+    _merge_result(lease, tracer)
+    # the worker's lane aggregates folded in, conservation intact
+    snap = ledger.get_ledger().snapshot()
+    assert snap["lanes_total"] == 7
+    assert sum(snap["decided"].values()) == 7
+    assert snap["by_contract"]["fleet-target"]["word"] == 3
+    assert snap["learned_clauses"] == 2
+    absorbed = [e for e in tracer.events()
+                if e["name"] in ("svm.transaction", "cdcl.solve")]
+    assert len(absorbed) == 2
+    # every worker span parents under the request's trace id, on a
+    # synthetic (non-OS) pid
+    assert all(e["args"]["trace_id"] == "req-trace-1"
+               for e in absorbed)
+    assert all(e["pid"] != 777 for e in absorbed)
+    labels = [e for e in tracer.events() if e.get("ph") == "M"]
+    assert any("w9" in e["args"]["name"] and "req-trace-1"
+               in e["args"]["name"] for e in labels)
+    # the per-worker wall landed as an external total, not a timeline
+    # event (no phase double-count)
+    assert tracer.totals_snapshot()["fleet.worker:w9"] == 1.5
+
+
+# -- serve: /debug endpoints, trace ids, myth top ---------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    from mythril_tpu.ops.async_dispatch import get_async_dispatcher
+    from mythril_tpu.ops.coalesce import (
+        reset_coalescer, set_request_scope, set_serve_mode,
+    )
+    from mythril_tpu.resilience import budget, faults, watchdog
+    from mythril_tpu.resilience.checkpoint import reset_for_tests
+    from mythril_tpu.serve import AnalysisServer
+    from mythril_tpu.serve.config import ServeConfig
+    from mythril_tpu.smt.solver import reset_blast_context
+
+    def _clean():
+        budget.reset_for_tests()
+        faults.reset_for_tests()
+        watchdog.reset_for_tests()
+        reset_for_tests()
+        set_serve_mode(False)
+        set_request_scope(None)
+        reset_coalescer(hard=True)
+        get_async_dispatcher().drop()
+        reset_blast_context()
+
+    _clean()
+    ledger.reset_for_tests()
+    srv = AnalysisServer(ServeConfig.from_env(port=0))
+    srv.start()
+    yield srv
+    srv.drain_and_stop("ledger tests done")
+    _clean()
+
+
+def _post(srv, payload, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/analyze",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get_json(srv, path):
+    resp = urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.port}{path}", timeout=30
+    )
+    return resp.status, json.loads(resp.read())
+
+
+def _tiny_contract():
+    import bench
+
+    return bench._corpus()[0][1]
+
+
+def test_serve_trace_id_and_debug_endpoints(server):
+    status, body = _post(server, {
+        "code": _tiny_contract(), "name": "ledgerling", "tx_count": 1,
+        "source": "xray", "trace_id": "client-trace-7",
+    })
+    assert status == 200, body
+    # the caller-minted trace id comes back on the response
+    assert body["trace_id"] == "client-trace-7"
+
+    status, lanes = _get_json(server, "/debug/lanes")
+    assert status == 200
+    assert lanes["lanes_total"] == sum(lanes["decided"].values())
+    status, debug = _get_json(server, "/debug/requests")
+    assert status == 200
+    assert debug["in_flight"] is None  # request already finished
+    recent = debug["recent"]
+    assert recent and recent[0]["trace_id"] == "client-trace-7"
+    assert recent[0]["contract"] == "ledgerling"
+    assert recent[0]["status"] == 200
+    # a server-minted id on the next request: present and distinct
+    status, body2 = _post(server, {
+        "code": _tiny_contract(), "name": "ledgerling", "tx_count": 1,
+        "source": "xray",
+    })
+    assert status == 200 and body2["trace_id"]
+    assert body2["trace_id"] != "client-trace-7"
+
+
+def test_serve_rejects_bad_trace_id(server):
+    status, body = _post(server, {
+        "code": "6001", "trace_id": 'bad"id\n',
+    })
+    assert status == 400
+    assert body["error"]["code"] == "bad_trace_id"
+
+
+def test_myth_top_renders_once_against_server(server, capsys):
+    from mythril_tpu.interfaces.top import render_once, run_top
+
+    ok = render_once(f"http://127.0.0.1:{server.port}")
+    out = capsys.readouterr().out
+    assert ok
+    assert "myth top" in out
+    assert "lanes:" in out
+    assert "in-flight: idle" in out
+    # run_top --once exits 0 against a live server, 1 against nothing
+    assert run_top(f"http://127.0.0.1:{server.port}", once=True) == 0
+    assert run_top("http://127.0.0.1:9", once=True) == 1

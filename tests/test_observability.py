@@ -440,11 +440,151 @@ def test_report_meta_observability_section_is_stable():
     payload = json.loads(Report().as_swc_standard_format())
     section = payload[0]["meta"]["observability"]
     assert set(section) == {
-        "enabled", "trace_out", "metrics_out", "span_events",
-        "instant_events", "dropped_events", "flight_dumps",
+        "enabled", "trace_id", "trace_out", "metrics_out",
+        "lane_ledger_out", "span_events", "instant_events",
+        "dropped_events", "flight_dumps", "ledger_lanes",
     }
     assert section["enabled"] is False
     assert section["trace_out"] is None
+
+
+def test_trace_truncation_marker_in_export(tmp_path, monkeypatch):
+    """A capped trace must say so on its own timeline: the export
+    appends a trace.truncated instant carrying the drop count, and the
+    registry's mythril_tpu_trace_dropped_events counter agrees."""
+    monkeypatch.setenv("MYTHRIL_TPU_TRACE_CAP", "1024")
+    spans.reset_for_tests()
+    tracer = spans.get_tracer()
+    tracer.enable()
+    for _ in range(1200):
+        with spans.span("flood"):
+            pass
+    path = tracer.export_chrome(str(tmp_path / "t.json"))
+    payload = json.load(open(path))
+    markers = [e for e in payload["traceEvents"]
+               if e["name"] == "trace.truncated"]
+    assert len(markers) == 1
+    assert markers[0]["ph"] == "i"
+    assert markers[0]["args"]["dropped_events"] == 1200 - 1024
+    text = metrics.get_registry().render()
+    assert f"mythril_tpu_trace_dropped_events {1200 - 1024}" in text
+    # an uncapped trace carries no marker
+    spans.reset_for_tests()
+    tracer = spans.get_tracer()
+    tracer.enable()
+    with spans.span("small"):
+        pass
+    payload = json.load(open(tracer.export_chrome(
+        str(tmp_path / "t2.json")
+    )))
+    assert not any(e["name"] == "trace.truncated"
+                   for e in payload["traceEvents"])
+
+
+def test_prometheus_escaping_of_labels_and_help():
+    """Contract source paths land in label values and HELP text can
+    carry anything — backslash/newline/double-quote must be escaped per
+    the text-format spec or the whole exposition corrupts."""
+    nasty = 'C:\\contracts\n"token".sol'
+    assert metrics.escape_label_value(nasty) == (
+        r'C:\\contracts\n\"token\".sol'
+    )
+    registry = metrics.get_registry()
+    registry.counter(
+        "mythril_tpu_test_nasty_help",
+        'line one\nline two with \\ and "quotes"',
+    ).inc()
+    from mythril_tpu.observability.ledger import get_ledger
+
+    get_ledger().set_origin(contract=nasty)
+    batch = get_ledger().begin_batch("batch_check", 1)
+    batch.decide(0, "word", "unsat")
+    batch.close()
+    text = registry.render()
+    for line in text.splitlines():
+        # the spec-breaking characters never appear raw inside a line
+        assert "\r" not in line
+        if line.startswith("# HELP mythril_tpu_test_nasty_help"):
+            assert "\\n" in line and '\\\\' in line
+    assert 'contract="C:\\\\contracts\\n\\"token\\".sol"' in text
+    # labeled collector series keep HELP/TYPE on the BASE name
+    assert ("# TYPE mythril_tpu_ledger_contract_lanes_total counter"
+            in text)
+    assert "# TYPE mythril_tpu_ledger_contract_lanes_total{" not in text
+
+
+def test_flight_dump_filenames_never_collide(tmp_path):
+    """Two back-to-back trips must both survive on disk — including
+    across a recorder replacement that resets the sequence while the
+    dump directory persists (the pid-reuse shape)."""
+    tracer = spans.get_tracer()
+    tracer.enable()
+    recorder = flight.get_flight_recorder()
+    recorder.configure(str(tmp_path))
+    with spans.span("pre.trip"):
+        pass
+    first = recorder.dump("trip")
+    second = recorder.dump("trip")
+    assert first and second and first != second
+    assert os.path.exists(first) and os.path.exists(second)
+    # a FRESH recorder (seq restarts at 1) in the same dir + pid must
+    # bump past the survivors instead of overwriting them
+    flight.reset_for_tests()
+    fresh = flight.get_flight_recorder()
+    fresh.configure(str(tmp_path))
+    fresh.record({"name": "later", "ph": "i", "ts": 0.0,
+                  "pid": os.getpid(), "tid": 0})
+    third = fresh.dump("trip")
+    assert third and third not in (first, second)
+    assert len([n for n in os.listdir(str(tmp_path))
+                if n.endswith("-trip.json")]) == 3
+
+
+def test_absorb_events_separates_pid_reusing_workers():
+    """A respawned fleet worker can reuse a dead worker's OS pid; the
+    absorb path must keep the two streams on distinct Perfetto tracks
+    (synthetic pids) and re-parent both under the trace id."""
+    tracer = spans.get_tracer()
+    tracer.enable()
+    stream = lambda: [  # noqa: E731 — identical pid on purpose
+        {"name": "worker.span", "ph": "X", "ts": 1.0, "dur": 2.0,
+         "pid": 4242, "tid": 1}
+    ]
+    assert tracer.absorb_events(stream(), worker="w1",
+                                trace_id="trace-abc") == 1
+    assert tracer.absorb_events(stream(), worker="w2",
+                                trace_id="trace-abc") == 1
+    events = tracer.events()
+    spans_abs = [e for e in events if e["name"] == "worker.span"]
+    assert len(spans_abs) == 2
+    # distinct synthetic pids: the streams cannot merge
+    assert spans_abs[0]["pid"] != spans_abs[1]["pid"]
+    assert all(e["pid"] != 4242 for e in spans_abs)
+    assert all(e["args"]["trace_id"] == "trace-abc" for e in spans_abs)
+    # process_name metadata labels each track
+    labels = [e for e in events if e.get("ph") == "M"]
+    assert {e["args"]["name"] for e in labels} == {
+        "fleet-worker w1 [trace trace-abc]",
+        "fleet-worker w2 [trace trace-abc]",
+    }
+    # the SAME worker absorbing twice stays one track
+    assert tracer.absorb_events(stream(), worker="w1") == 1
+    spans_abs = [e for e in tracer.events()
+                 if e["name"] == "worker.span"]
+    assert len({e["pid"] for e in spans_abs}) == 2
+
+
+def test_counter_track_events():
+    tracer = spans.get_tracer()
+    tracer.enable()
+    spans.counter("pool.rows", resident=7, bucket=256)
+    (event,) = [e for e in tracer.events() if e["ph"] == "C"]
+    assert event["name"] == "pool.rows"
+    assert event["args"] == {"resident": 7.0, "bucket": 256.0}
+    # disabled: no-op
+    tracer.disable()
+    spans.counter("pool.rows", resident=9)
+    assert len([e for e in tracer.events() if e["ph"] == "C"]) == 1
 
 
 def test_cli_trace_and_metrics_artifacts(tmp_path):
@@ -453,13 +593,15 @@ def test_cli_trace_and_metrics_artifacts(tmp_path):
     the absorbed telemetry counters; the jsonv2 meta names both."""
     trace_path = tmp_path / "t.json"
     metrics_path = tmp_path / "m.prom"
+    ledger_path = tmp_path / "lanes.json"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run(
         [sys.executable, MYTH, "analyze", "-c", "0x6001600101",
          "--bin-runtime", "-t", "1", "--no-onchain-data",
          "--execution-timeout", "30", "-o", "jsonv2",
          "--trace-out", str(trace_path),
-         "--metrics-out", str(metrics_path)],
+         "--metrics-out", str(metrics_path),
+         "--lane-ledger-out", str(ledger_path)],
         capture_output=True, text=True, timeout=300,
         cwd=os.path.dirname(MYTH), env=env,
     )
@@ -468,6 +610,17 @@ def test_cli_trace_and_metrics_artifacts(tmp_path):
     assert section["enabled"] is True
     assert section["trace_out"] == str(trace_path)
     assert section["span_events"] > 0
+    assert section["trace_id"]  # minted at the CLI edge
+    assert section["lane_ledger_out"] == str(ledger_path)
+
+    # the lane-ledger artifact is schema-valid and conserves lanes
+    # (the acceptance invariant scripts/trace_lint.py enforces)
+    sys.path.insert(0, os.path.join(os.path.dirname(MYTH), "scripts"))
+    import trace_lint
+
+    ledger_payload = json.load(open(ledger_path))
+    assert trace_lint.lint_ledger(ledger_payload) == []
+    assert trace_lint.lint_trace(json.load(open(trace_path))) == []
 
     trace = json.load(open(trace_path))
     names = {e["name"] for e in trace["traceEvents"]}
