@@ -951,6 +951,14 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
         # when nothing was ledgered; the tail share is gated in
         # scripts/bench_compare.py as tier_tail_pct
         headline["tier_decided_pct"] = summary["tier_decided_pct"]
+    if isinstance(summary.get("autopilot"), dict):
+        # adaptive-routing activity: lanes routed off the static path
+        # and tuner steps taken/undone — absent (not null) on a static
+        # or killed (MYTHRIL_TPU_AUTOPILOT=0) run
+        pilot = summary["autopilot"]
+        headline["autopilot_routed"] = pilot.get("lanes_routed", 0)
+        headline["autopilot_ladder"] = pilot.get("ladder_decided", 0)
+        headline["autopilot_tuned"] = pilot.get("tuner_adjustments", 0)
     if summary.get("sweeps_per_lane") is not None:
         # device-native propagation (frontier tier): full sweeps per
         # decided lane — THE success metric of the event-driven BCP
@@ -995,7 +1003,8 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
         headline["error"] = str(summary["error"])[:160]
     line = json.dumps(headline)
     if len(line) > 500:  # hard cap so the tail capture can never lose it
-        for key in ("tier_decided_pct",
+        for key in ("autopilot_tuned", "autopilot_ladder",
+                    "autopilot_routed", "tier_decided_pct",
                     "worker_deaths_recovered", "fleet_speedup",
                     "microbench_device_vs_host",
                     "microbench_device_warm_s",
@@ -1353,6 +1362,15 @@ def main() -> None:
     from mythril_tpu.observability.ledger import get_ledger
 
     summary["tier_decided_pct"] = get_ledger().tier_decided_pct()
+    # autopilot activity (mythril_tpu/autopilot): routing counters +
+    # tuner adjustments for this run — {} (and absent from the
+    # headline) when the autopilot never engaged, so a static run's
+    # surface is byte-identical to pre-autopilot rounds
+    from mythril_tpu.autopilot import counters_snapshot
+
+    autopilot_snap = counters_snapshot()
+    if autopilot_snap.get("lanes_seen"):
+        summary["autopilot"] = autopilot_snap
     for (label, run_mode), row in scale_rows.items():
         key = label if run_mode == mode else f"{label}_{run_mode}"
         summary[key] = _scale_summary(row)

@@ -895,6 +895,18 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
             "--enable-iprof must be used with -v LOG_LEVEL where LOG_LEVEL >= 4",
         )
 
+    # numeric MYTHRIL_TPU_* knobs are validated here with the same
+    # exit-2 contract as fault/serve specs: a typo'd value must die at
+    # startup, never silently run a default mid-analysis
+    # (support/env.py)
+    from mythril_tpu.support.env import EnvSpecError, validate_env
+
+    try:
+        validate_env()
+    except EnvSpecError as e:
+        print(f"bad environment knob: {e}", file=sys.stderr)
+        sys.exit(2)
+
     if os.environ.get("MYTHRIL_TPU_FAULT") or os.environ.get(
         "MYTHRIL_TPU_KILL_AT"
     ):

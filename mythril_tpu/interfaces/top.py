@@ -2,10 +2,12 @@
 daemon or fleet coordinator.
 
 Polls the live introspection endpoints the observability plane exposes
-(``/debug/requests``, ``/debug/lanes``, and — on a serve instance —
-``/readyz``) and renders a compact terminal dashboard: server health,
-the in-flight request (phase, deadline budget remaining, lane counts
-by tier), recent requests, and the lane-attribution funnel split.
+(``/debug/requests``, ``/debug/lanes``, ``/debug/autopilot``, and — on
+a serve instance — ``/readyz``) and renders a compact terminal
+dashboard: server health, the in-flight request (phase, deadline
+budget remaining, lane counts by tier), recent requests, the
+lane-attribution funnel split, and the autopilot's routing/tuning
+activity.
 Stdlib-only, read-only, and safe against a half-up server (connection
 errors render as a status line, not a traceback).
 
@@ -66,6 +68,38 @@ def _render_lanes(lanes: Optional[dict], out) -> None:
         print("    transitions: " + ", ".join(
             f"{k}={v}" for k, v in sorted(transitions.items())
         ), file=out)
+
+
+def _render_autopilot(pilot: Optional[dict], out) -> None:
+    if not pilot:
+        return  # endpoint absent (older server) — panel just drops out
+    if not pilot.get("enabled"):
+        print("  autopilot: off (MYTHRIL_TPU_AUTOPILOT=0)", file=out)
+        return
+    counters = pilot.get("counters") or {}
+    print(f"  autopilot: policy={pilot.get('policy')}  "
+          f"seen={counters.get('lanes_seen', 0)} "
+          f"routed={counters.get('lanes_routed', 0)} "
+          f"(word-skip={counters.get('word_skips', 0)}, "
+          f"tail-direct={counters.get('tail_routes', 0)}, "
+          f"ladder={counters.get('ladder_decided', 0)}/"
+          f"{counters.get('ladder_solves', 0)})", file=out)
+    tuner = pilot.get("tuner") or {}
+    overrides = tuner.get("overrides") or {}
+    line = (f"    tuner: tail-ewma={tuner.get('tail_ewma')} "
+            f"queue-ewma={tuner.get('queue_ewma')} "
+            f"adjust={tuner.get('adjustments', 0)} "
+            f"revert={tuner.get('reverts', 0)}")
+    if overrides:
+        line += "  overrides: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(overrides.items())
+        )
+    print(line, file=out)
+    model = pilot.get("model") or {}
+    top_rows = model.get("top") or []
+    if top_rows:
+        print(f"    model: {model.get('signatures', 0)} signatures, "
+              f"{model.get('observations', 0)} observations", file=out)
 
 
 def _render_serve(ready: Optional[dict], requests: Optional[dict],
@@ -136,6 +170,7 @@ def render_once(url: str, out=None) -> bool:
     base = url.rstrip("/")
     requests = _get_json(base + "/debug/requests")
     lanes = _get_json(base + "/debug/lanes")
+    pilot = _get_json(base + "/debug/autopilot")
     ready = _get_json(base + "/readyz")
     print(f"myth top — {base}  "
           f"({time.strftime('%H:%M:%S')})", file=out)
@@ -149,6 +184,7 @@ def render_once(url: str, out=None) -> bool:
     else:
         _render_serve(ready, requests, out)
     _render_lanes(lanes, out)
+    _render_autopilot(pilot, out)
     return True
 
 

@@ -32,9 +32,13 @@ consumers:
   per-tier and per-contract labels — rendered live by ``/metrics`` and
   the ``/debug/lanes`` endpoint);
 - the ``--lane-ledger-out FILE`` JSON artifact
-  (schema ``mythril-tpu-lane-ledger/1``, validated by
-  ``scripts/trace_lint.py`` including the lane-conservation invariant:
-  every opened lane terminates in exactly one tier);
+  (schema ``mythril-tpu-lane-ledger/2``, validated by
+  ``scripts/trace_lint.py`` — which still reads v1 — including the
+  lane-conservation invariant: every opened lane terminates in exactly
+  one tier).  v2 records additionally carry the autopilot's per-lane
+  ``features`` vector and ``routed_by`` rule (null on the static
+  path), which is what makes recorded artifacts replayable through
+  any routing policy offline (autopilot/replay.py);
 - the bench headline's ``tier_decided_pct`` split
   (:meth:`LaneLedger.tier_decided_pct`, gated via ``tier_tail_pct`` in
   ``scripts/bench_compare.py``).
@@ -73,9 +77,26 @@ LEDGER_CAP = 4096       # full records retained (aggregates unbounded)
 MAX_CONTRACTS = 64      # per-contract aggregate keys retained
 MAX_SCOPES = 32         # per-request-scope aggregate keys retained
 
-SCHEMA = "mythril-tpu-lane-ledger/1"
+SCHEMA = "mythril-tpu-lane-ledger/2"
 
 _KEEP = object()  # set_origin sentinel: leave this field unchanged
+
+#: batch observers: called with each LaneBatch right after it folds
+#: into the aggregates (the autopilot's cost model feeds from here —
+#: a callback keeps the ledger free of any autopilot import)
+_batch_observers: List = []
+
+
+def add_batch_observer(fn) -> None:
+    if fn not in _batch_observers:
+        _batch_observers.append(fn)
+
+
+def remove_batch_observer(fn) -> None:
+    try:
+        _batch_observers.remove(fn)
+    except ValueError:
+        pass
 
 
 def ledger_enabled() -> bool:
@@ -85,11 +106,9 @@ def ledger_enabled() -> bool:
 
 
 def _env_cap() -> int:
-    try:
-        return max(64, int(os.environ.get("MYTHRIL_TPU_LEDGER_CAP",
-                                          LEDGER_CAP)))
-    except ValueError:
-        return LEDGER_CAP
+    from mythril_tpu.support.env import env_int
+
+    return env_int("MYTHRIL_TPU_LEDGER_CAP", LEDGER_CAP, floor=64)
 
 
 class _NoopBatch:
@@ -106,6 +125,12 @@ class _NoopBatch:
         pass
 
     def decide(self, index, tier, verdict):
+        pass
+
+    def set_features(self, index, features):
+        pass
+
+    def set_routed(self, index, rule):
         pass
 
     def tier_wall(self, tier, seconds):
@@ -130,7 +155,8 @@ class LaneBatch:
     the ledger's aggregates in one pass."""
 
     __slots__ = ("_ledger", "kind", "origin", "paths", "tiers",
-                 "verdicts", "walls", "sweeps", "learned", "_closed")
+                 "verdicts", "features", "routed", "walls", "sweeps",
+                 "learned", "_closed")
 
     def __init__(self, ledger: "LaneLedger", kind: str, lanes: int,
                  origin: dict):
@@ -140,6 +166,8 @@ class LaneBatch:
         self.paths: List[List[str]] = [["opened"] for _ in range(lanes)]
         self.tiers: List[Optional[str]] = [None] * lanes
         self.verdicts: List[Optional[str]] = [None] * lanes
+        self.features: List[Optional[dict]] = [None] * lanes
+        self.routed: List[Optional[str]] = [None] * lanes
         self.walls: Dict[str, float] = {}
         self.sweeps: Dict[str, int] = {}
         self.learned = 0
@@ -164,6 +192,17 @@ class LaneBatch:
         self.tiers[index] = tier
         self.verdicts[index] = verdict
         self.paths[index].append(tier)
+
+    def set_features(self, index: int, features: Optional[dict]) -> None:
+        """Attach the autopilot's feature vector (rides on the v2
+        record so recorded artifacts are policy-replayable)."""
+        self.features[index] = features
+
+    def set_routed(self, index: int, rule: Optional[str]) -> None:
+        """Name the routing rule that rerouted this lane (None = the
+        static path; a record field, not a lifecycle state, so the
+        LEGAL_NEXT machine is untouched)."""
+        self.routed[index] = rule
 
     def tier_wall(self, tier: str, seconds: float) -> None:
         if seconds > 0:
@@ -205,6 +244,7 @@ class LaneLedger:
         self.decided: Dict[str, int] = {t: 0 for t in TERMINAL_TIERS}
         self.verdicts: Dict[str, int] = {}      # "tier:verdict" -> n
         self.transitions: Dict[str, int] = {}   # non-terminal states
+        self.routed: Dict[str, int] = {}        # autopilot rule -> n
         self.tier_wall_s: Dict[str, float] = {}
         self.tier_sweeps: Dict[str, int] = {}
         self.learned_clauses = 0
@@ -311,6 +351,9 @@ class LaneLedger:
                     self.transitions[state] = (
                         self.transitions.get(state, 0) + 1
                     )
+                rule = batch.routed[index]
+                if rule is not None:
+                    self.routed[rule] = self.routed.get(rule, 0) + 1
                 if len(self.records) < self._cap:
                     self._seq += 1
                     self.records.append({
@@ -320,6 +363,8 @@ class LaneLedger:
                         "path": list(batch.paths[index]),
                         "tier": tier,
                         "verdict": batch.verdicts[index],
+                        "features": batch.features[index],
+                        "routed_by": rule,
                     })
                 else:
                     self.records_dropped += 1
@@ -332,6 +377,13 @@ class LaneLedger:
                     self.tier_sweeps.get(tier, 0) + sweeps
                 )
             self.learned_clauses += batch.learned
+        # observers run outside the lock: the autopilot's cost-model
+        # fold calls back into ledger reads (tier_decided_pct)
+        for observer in list(_batch_observers):
+            try:
+                observer(batch)
+            except Exception:  # noqa: BLE001 — observers are telemetry
+                pass
 
     # -- aggregation / export -------------------------------------------
 
@@ -347,6 +399,7 @@ class LaneLedger:
                 "decided": dict(self.decided),
                 "verdicts": dict(self.verdicts),
                 "transitions": dict(self.transitions),
+                "routed": dict(self.routed),
                 "tier_wall_s": {
                     t: round(s, 4) for t, s in self.tier_wall_s.items()
                 },
@@ -404,7 +457,7 @@ class LaneLedger:
             self.learned_clauses += int(snap.get("learned_clauses", 0))
             for field, cast in (("by_kind", int), ("decided", int),
                                 ("verdicts", int), ("transitions", int),
-                                ("tier_sweeps", int),
+                                ("routed", int), ("tier_sweeps", int),
                                 ("tier_wall_s", float)):
                 ours = getattr(self, field)
                 for key, value in (snap.get(field) or {}).items():
@@ -486,6 +539,11 @@ def _ledger_collector():
                f'mythril_tpu_ledger_transitions_total'
                f'{{state="{escape_label_value(state)}"}}',
                "non-terminal lane lifecycle transitions", count)
+    for rule, count in sorted((snap.get("routed") or {}).items()):
+        yield ("counter",
+               f'mythril_tpu_ledger_routed_total'
+               f'{{rule="{escape_label_value(rule)}"}}',
+               "lanes rerouted by the autopilot, per rule", count)
     for tier, seconds in sorted(snap["tier_wall_s"].items()):
         yield ("counter",
                f'mythril_tpu_ledger_tier_wall_seconds'

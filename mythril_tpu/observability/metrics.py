@@ -267,6 +267,14 @@ def _ledger_collector():
     yield from c()
 
 
+def _autopilot_collector():
+    """Lazy pass-through to the autopilot's collector (same shape as
+    the ledger's — mythril_tpu_autopilot_* series)."""
+    from mythril_tpu.autopilot import _autopilot_collector as c
+
+    yield from c()
+
+
 def _trace_collector():
     from mythril_tpu.observability.flight import get_flight_recorder
     from mythril_tpu.observability.spans import get_tracer
@@ -299,6 +307,7 @@ def get_registry() -> MetricsRegistry:
                 registry.register_collector(_async_stats_collector)
                 registry.register_collector(_trace_collector)
                 registry.register_collector(_ledger_collector)
+                registry.register_collector(_autopilot_collector)
                 _registry = registry
     return _registry
 

@@ -1761,6 +1761,16 @@ def _batch_check_states_inner(ctx, constraint_sets, lanes_led):
         else:
             node_sets.append(nodes)
 
+    # autopilot (mythril_tpu/autopilot): per-lane feature extraction +
+    # routing from the ledger-fed cost model.  Feature vectors are
+    # stamped on the ledger records (artifact schema v2) so this batch
+    # is replayable offline; a decision only skips tiers whose work the
+    # host CDCL tail redoes soundly — verdict logic is untouched, and
+    # MYTHRIL_TPU_AUTOPILOT=0 makes this a row of Nones
+    from mythril_tpu.autopilot import route_lanes
+
+    routes = route_lanes(node_sets, lanes_led)
+
     # host word-level probe: evaluation against candidate models is a
     # full verification, so a hit is a sound SAT verdict.  Results are
     # memoized on the context (shared with the CDCL tail): SAT is
@@ -1809,8 +1819,12 @@ def _batch_check_states_inner(ctx, constraint_sets, lanes_led):
     )
 
     if word_tier_enabled():
+        # lanes the autopilot routed past the word tier (signatures it
+        # never decides) stay out of the propagation batch entirely
         open_sets: List[Optional[List]] = [
-            nodes if decided[i] is None else None
+            nodes if decided[i] is None and not (
+                routes[i] is not None and routes[i].skip_word
+            ) else None
             for i, nodes in enumerate(node_sets)
         ]
         import time as _time
@@ -1843,6 +1857,16 @@ def _batch_check_states_inner(ctx, constraint_sets, lanes_led):
     # to the authoritative CDCL tail.
 
     open_indices = [i for i, d in enumerate(decided) if d is None]
+    # tail-direct lanes skip the device pipeline entirely: the CDCL
+    # tail answers them with full budget either way, so the only change
+    # is not paying blast/dispatch for a predicted-doomed lane (the
+    # ledger already carries their routed_by stamp; they settle as
+    # tail-demoted at batch close like any undecided lane)
+    if any(r is not None and r.skip_device for r in routes):
+        open_indices = [
+            i for i in open_indices
+            if not (routes[i] is not None and routes[i].skip_device)
+        ]
     if len(open_indices) < effective_min_lanes():
         return decided
 

@@ -137,21 +137,25 @@ def _enabled() -> bool:
 
 def _window() -> int:
     default = SERVE_WINDOW if _serve_mode else COALESCE_WINDOW
-    try:
-        return max(0, int(os.environ.get(
-            "MYTHRIL_TPU_COALESCE_WINDOW", default
-        )))
-    except ValueError:
-        return default
+    if not os.environ.get("MYTHRIL_TPU_COALESCE_WINDOW", "").strip():
+        # autopilot tuner may shrink the window when its queue-depth
+        # EWMA says lanes wait too long for a merged dispatch; an
+        # operator pin always wins (autopilot/tuner.py)
+        from mythril_tpu.autopilot import knob_override
+
+        tuned = knob_override("coalesce_window")
+        if tuned is not None:
+            return max(0, tuned)
+    from mythril_tpu.support.env import env_int
+
+    return env_int("MYTHRIL_TPU_COALESCE_WINDOW", default, floor=0)
 
 
 def _min_fill() -> float:
-    try:
-        return float(os.environ.get(
-            "MYTHRIL_TPU_COALESCE_FILL", COALESCE_MIN_FILL
-        ))
-    except ValueError:
-        return COALESCE_MIN_FILL
+    from mythril_tpu.support.env import env_float
+
+    return env_float("MYTHRIL_TPU_COALESCE_FILL", COALESCE_MIN_FILL,
+                     floor=0.0)
 
 
 class LaneCoalescer:

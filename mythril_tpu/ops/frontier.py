@@ -91,18 +91,32 @@ def frontier_enabled() -> bool:
 
 
 def _env_int(name: str, default: int, floor: int = 1) -> int:
-    try:
-        return max(floor, int(os.environ.get(name, default)))
-    except ValueError:
-        return default
+    from mythril_tpu.support.env import env_int
+
+    return env_int(name, default, floor=floor)
+
+
+def _tuned_int(name: str, knob: str, default: int,
+               floor: int = 1) -> int:
+    """Env pin wins; otherwise the autopilot tuner may publish a
+    bounded override (autopilot/tuner.py); otherwise the default."""
+    if not os.environ.get(name, "").strip():
+        from mythril_tpu.autopilot import knob_override
+
+        tuned = knob_override(knob)
+        if tuned is not None:
+            return max(floor, tuned)
+    return _env_int(name, default, floor=floor)
 
 
 def frontier_period() -> int:
-    return _env_int("MYTHRIL_TPU_FRONTIER_PERIOD", DEFAULT_PERIOD)
+    return _tuned_int("MYTHRIL_TPU_FRONTIER_PERIOD", "frontier_period",
+                      DEFAULT_PERIOD)
 
 
 def frontier_fan() -> int:
-    return _env_int("MYTHRIL_TPU_FRONTIER_FAN", DEFAULT_FAN)
+    return _tuned_int("MYTHRIL_TPU_FRONTIER_FAN", "frontier_fan",
+                      DEFAULT_FAN)
 
 
 def frontier_deg() -> int:

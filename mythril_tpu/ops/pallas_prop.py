@@ -175,12 +175,18 @@ def _bucket(n: int, floor: int = 128) -> int:
 
 
 def _tier_period() -> int:
-    """Cold-sweep period (env-tunable; <= 1 disables the tier split)."""
-    try:
-        return max(1, int(os.environ.get("MYTHRIL_TPU_TIER_PERIOD",
-                                         TIER_PERIOD)))
-    except ValueError:
-        return TIER_PERIOD
+    """Cold-sweep period (env-tunable; <= 1 disables the tier split).
+    Without an operator pin the autopilot tuner may publish a bounded
+    override (autopilot/tuner.py)."""
+    if not os.environ.get("MYTHRIL_TPU_TIER_PERIOD", "").strip():
+        from mythril_tpu.autopilot import knob_override
+
+        tuned = knob_override("tier_period")
+        if tuned is not None:
+            return max(1, tuned)
+    from mythril_tpu.support.env import env_int
+
+    return env_int("MYTHRIL_TPU_TIER_PERIOD", TIER_PERIOD, floor=1)
 
 
 def _ladder_budgets(total_steps: int, interpret: bool) -> list:

@@ -25,6 +25,11 @@ Endpoints::
                      the lane-attribution ledger's aggregates (tier
                      decisions, transitions, per-contract and
                      per-request splits; observability/ledger.py)
+    GET  /debug/autopilot
+                     the autopilot's live state: policy, routing
+                     counters, cost-model signature buckets, tuner
+                     EWMAs/overrides (mythril_tpu/autopilot; what the
+                     ``myth top`` autopilot panel renders)
 
 Shutdown: SIGTERM/SIGINT ride the resilience plane's cooperative drain
 (``install_signal_handlers``).  The serve loop notices, closes
@@ -114,6 +119,10 @@ class _Handler(BaseHTTPRequestHandler):
             from mythril_tpu.observability.ledger import get_ledger
 
             self._send_json(200, get_ledger().snapshot())
+        elif path == "/debug/autopilot":
+            from mythril_tpu.autopilot import get_autopilot
+
+            self._send_json(200, get_autopilot().debug_state())
         else:
             self._send_json(404, {"error": {
                 "code": "not_found", "message": f"no route {path!r}",

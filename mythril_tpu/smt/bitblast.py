@@ -44,14 +44,10 @@ def probe_memo_cap() -> int:
     soak driver analyzing thousands of contracts wants a bigger live
     set; a memory-tight CI wants a smaller one), else the default.
     Floored so the eviction quarter never rounds to zero."""
-    import os
+    from mythril_tpu.support.env import env_int
 
-    try:
-        return max(64, int(os.environ.get(
-            "MYTHRIL_TPU_PROBE_MEMO_CAP", PROBE_MEMO_CAP
-        )))
-    except ValueError:
-        return PROBE_MEMO_CAP
+    return env_int("MYTHRIL_TPU_PROBE_MEMO_CAP", PROBE_MEMO_CAP,
+                   floor=64)
 
 # powers of two for vectorized bit packing (64-bit limbs)
 _POW2_64 = np.uint64(1) << np.arange(64, dtype=np.uint64)
@@ -713,6 +709,16 @@ class BlastContext:
         key = tuple(sorted(n.id for n in nodes))
         if self.unsat_memo_hit(key):
             return SatSolver.UNSAT, None
+        # autopilot routing (mythril_tpu/autopilot): a per-query tier
+        # plan from the ledger-fed cost model — at most skip the word
+        # tier for shapes it never decides, and stage the tail solve as
+        # a bounded-then-unbounded ladder for predicted-easy shapes.
+        # Both are verdict-neutral (the word tier is a pure accelerator
+        # and the ladder's UNKNOWN rung falls through to the exact
+        # static solve); None on the static path / kill switch.
+        from mythril_tpu.autopilot import note_ladder, route_query
+
+        route = route_query(nodes)
         from mythril_tpu.support.support_args import args as _args
 
         stats = _solver_stats()
@@ -739,7 +745,7 @@ class BlastContext:
         )
 
         word_hints = None
-        if word_tier_enabled():
+        if word_tier_enabled() and not (route and route.skip_word):
             word_verdicts, hint_rows, word_envs = get_word_tier().decide(
                 self, [nodes]
             )
@@ -793,9 +799,21 @@ class BlastContext:
                 self.solver.set_relevant([])
         with obs.span("cdcl.solve", sink=(stats, "native_s"),
                       cat="tail", assumptions=len(assumptions)):
-            status = self._solve_native(
-                assumptions, conflict_budget, timeout_s
-            )
+            status = SatSolver.UNKNOWN
+            if route is not None and route.ladder and (
+                conflict_budget < 0
+            ):
+                # predicted-easy first rung: a decided bounded solve is
+                # the same sound verdict for a fraction of the
+                # conflicts; UNKNOWN falls through to the static call
+                status = self._solve_native(
+                    assumptions, route.ladder, timeout_s
+                )
+                note_ladder(status != SatSolver.UNKNOWN)
+            if status == SatSolver.UNKNOWN:
+                status = self._solve_native(
+                    assumptions, conflict_budget, timeout_s
+                )
         stats.native_calls += 1
         if status != SatSolver.SAT:
             if status == SatSolver.UNSAT:

@@ -88,10 +88,9 @@ def word_tier_enabled() -> bool:
 
 
 def _env_int(name: str, default: int) -> int:
-    try:
-        return max(1, int(os.environ.get(name, default)))
-    except ValueError:
-        return default
+    from mythril_tpu.support.env import env_int
+
+    return env_int(name, default, floor=1)
 
 
 def tightening_digest(hints: Optional[Dict[int, Tuple[int, int]]]) -> int:

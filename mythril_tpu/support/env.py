@@ -1,0 +1,141 @@
+"""Centralized ``MYTHRIL_TPU_*`` numeric knob parsing.
+
+Before this module, every subsystem re-parsed its own env vars with a
+bare ``int()``/``float()`` and a silent fallback — a typo'd
+``MYTHRIL_TPU_FRONTIER_FAN=1b`` quietly ran the default and the
+operator only found out from a bench delta.  This module gives every
+numeric knob one home:
+
+- :func:`env_int` / :func:`env_float` — the *read-time* accessors.
+  They stay lenient (malformed → default) because knobs are read on
+  hot paths mid-run, where raising would turn a config typo into a
+  mid-analysis crash.  Each call also self-registers the knob's spec
+  (name, kind, floor) into the module registry.
+- :func:`validate_env` — the *startup* gate.  Walks every registered
+  spec (plus the static :data:`KNOWN_SPECS` table, so knobs whose
+  module has not imported yet are still covered) and raises
+  :class:`EnvSpecError` on the first malformed or out-of-range value.
+  The CLI calls it before an analyze/serve command and exits 2 —
+  the same contract as the fault plane's ``FaultSpecError`` and the
+  serve plane's ``ServeConfigError``.
+
+The autopilot's knobs (``MYTHRIL_TPU_AUTOPILOT_*``) use this helper
+from day one; legacy knob sites (frontier, coalescer, tier period,
+ledger cap, probe memo, word tier) were migrated onto it.
+"""
+
+import os
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "EnvSpecError", "env_int", "env_float", "env_flag",
+    "register_spec", "validate_env", "KNOWN_SPECS",
+]
+
+
+class EnvSpecError(RuntimeError):
+    """A malformed ``MYTHRIL_TPU_*`` numeric value, raised by
+    :func:`validate_env` at CLI/serve startup (exit code 2) so a typo
+    dies loudly instead of silently running a default mid-analysis."""
+
+
+#: name -> (kind, floor, ceil); kind in {"int", "float"}.  Static
+#: entries cover knobs whose owning module may not have imported by
+#: validation time; env_int/env_float self-register the rest.
+KNOWN_SPECS: Dict[str, Tuple[str, Optional[float], Optional[float]]] = {
+    "MYTHRIL_TPU_FRONTIER_PERIOD": ("int", 1, None),
+    "MYTHRIL_TPU_FRONTIER_FAN": ("int", 1, None),
+    "MYTHRIL_TPU_FRONTIER_DEG": ("int", 2, None),
+    "MYTHRIL_TPU_TIER_PERIOD": ("int", 1, None),
+    "MYTHRIL_TPU_COALESCE_WINDOW": ("int", 0, None),
+    "MYTHRIL_TPU_COALESCE_FILL": ("float", 0.0, None),
+    "MYTHRIL_TPU_LEDGER_CAP": ("int", 1, None),
+    "MYTHRIL_TPU_PROBE_MEMO_CAP": ("int", 1, None),
+    "MYTHRIL_TPU_WORD_ROUNDS": ("int", 1, None),
+    "MYTHRIL_TPU_WORD_MAX_NODES": ("int", 1, None),
+    "MYTHRIL_TPU_AUTOPILOT_MIN_SAMPLES": ("int", 1, None),
+    "MYTHRIL_TPU_AUTOPILOT_LADDER": ("int", 1, None),
+    "MYTHRIL_TPU_AUTOPILOT_EVAL_EVERY": ("int", 1, None),
+}
+
+_registered: Dict[str, Tuple[str, Optional[float], Optional[float]]] = {}
+
+
+def register_spec(name: str, kind: str = "int",
+                  floor: Optional[float] = None,
+                  ceil: Optional[float] = None) -> None:
+    _registered[name] = (kind, floor, ceil)
+
+
+def _clamp(value, floor, ceil):
+    if floor is not None and value < floor:
+        value = type(value)(floor)
+    if ceil is not None and value > ceil:
+        value = type(value)(ceil)
+    return value
+
+
+def env_int(name: str, default: int, floor: Optional[int] = None,
+            ceil: Optional[int] = None) -> int:
+    """Lenient integer knob read: unset/blank/malformed → ``default``,
+    out-of-range values clamp to [floor, ceil].  Registers the spec so
+    :func:`validate_env` rejects the malformed case at startup."""
+    register_spec(name, "int", floor, ceil)
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return default
+    try:
+        return _clamp(int(raw), floor, ceil)
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float, floor: Optional[float] = None,
+              ceil: Optional[float] = None) -> float:
+    """Float twin of :func:`env_int` (same lenient-read / strict-
+    validate split)."""
+    register_spec(name, "float", floor, ceil)
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return default
+    try:
+        return _clamp(float(raw), floor, ceil)
+    except ValueError:
+        return default
+
+
+def env_flag(name: str, default: bool = True) -> bool:
+    """Kill-switch style boolean: ``0``/``off``/``false`` disable,
+    ``1``/``on``/``true``/``force`` enable, anything else (including
+    unset) keeps the default."""
+    raw = os.environ.get(name, "").lower()
+    if raw in ("0", "off", "false"):
+        return False
+    if raw in ("1", "on", "true", "force"):
+        return True
+    return default
+
+
+def validate_env(environ=None) -> None:
+    """Strict startup pass over every known numeric knob: raises
+    :class:`EnvSpecError` on the first malformed or out-of-range value
+    currently set in the environment.  Unset knobs are fine — only a
+    value the operator actually typed can be a typo."""
+    environ = os.environ if environ is None else environ
+    specs = dict(KNOWN_SPECS)
+    specs.update(_registered)
+    for name in sorted(specs):
+        raw = environ.get(name)
+        if raw is None or raw.strip() == "":
+            continue
+        kind, floor, ceil = specs[name]
+        try:
+            value = int(raw) if kind == "int" else float(raw)
+        except ValueError:
+            raise EnvSpecError(
+                f"{name}={raw!r}: not {'an integer' if kind == 'int' else 'a number'}"
+            ) from None
+        if floor is not None and value < floor:
+            raise EnvSpecError(f"{name}={value}: must be >= {floor}")
+        if ceil is not None and value > ceil:
+            raise EnvSpecError(f"{name}={value}: must be <= {ceil}")

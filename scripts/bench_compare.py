@@ -47,7 +47,13 @@ import sys
 #: tier_tail_pct (flattened out of the ledger's tier_decided_pct
 #: split by load_headline) gates the attribution funnel: the share of
 #: lanes demoted to the host CDCL tail growing means the word/device
-#: tiers stopped deciding — visible here before any wall-clock moves
+#: tiers stopped deciding — visible here before any wall-clock moves.
+#: It is gated only *at equal verdicts* — when both headlines carry the
+#: same ``vs_baseline`` findings score — because an autopilot routing
+#: change that trades tail share against verdict coverage is a
+#: different experiment, not a like-for-like regression (the verdict
+#: score itself is the findings-parity pin); at unequal verdicts the
+#: delta prints as informational
 GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
          "device_sweeps", "h2d_bytes", "trace_overhead_s",
          "blast_s", "word_prop_s", "serve_warm_p50_s",
@@ -152,6 +158,13 @@ def main() -> int:
         if base <= MIN_BASE:
             print(f"  {key}: {base} -> {cur} (baseline below noise "
                   "floor; not gated)")
+            continue
+        if key == "tier_tail_pct" and (
+            old.get("vs_baseline") != new.get("vs_baseline")
+        ):
+            print(f"  {key}: {base} -> {cur} (verdicts differ — "
+                  f"vs_baseline {old.get('vs_baseline')!r} -> "
+                  f"{new.get('vs_baseline')!r}; not gated)")
             continue
         delta = (cur - base) / base
         if key in GATED_HIGHER_BETTER:

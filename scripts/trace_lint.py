@@ -7,10 +7,13 @@ Validates the two machine-readable artifacts an analysis can emit:
   ``name``/``ph``/``pid``/``tid``, a numeric ``ts`` for timed phases,
   a non-negative ``dur`` on complete events, and a known phase letter);
 - ``--lane-ledger-out`` against the published
-  ``mythril-tpu-lane-ledger/1`` schema: required fields, tier-transition
-  legality per record (observability/ledger.py ``LEGAL_NEXT``), and the
+  ``mythril-tpu-lane-ledger/2`` schema (the ``/1`` reader is kept —
+  old recordings stay lintable): required fields, tier-transition
+  legality per record (observability/ledger.py ``LEGAL_NEXT``), the
   lane-conservation invariant — every opened lane terminates in exactly
-  one tier, so ``lanes_total == sum(decided.values())``.
+  one tier, so ``lanes_total == sum(decided.values())`` — and, on v2
+  records, the shape of the autopilot's ``features``/``routed_by``
+  annotations (mythril_tpu/autopilot).
 
 Usage::
 
@@ -38,7 +41,10 @@ if REPO_ROOT not in sys.path:
 #: spec): X complete, i instant, C counter, M metadata
 KNOWN_PHASES = {"X", "i", "C", "M"}
 
-LEDGER_SCHEMA = "mythril-tpu-lane-ledger/1"
+LEDGER_SCHEMA = "mythril-tpu-lane-ledger/2"
+#: every schema this linter reads; v1 artifacts predate the autopilot's
+#: per-record features/routed_by annotations but are otherwise identical
+LEDGER_SCHEMAS = ("mythril-tpu-lane-ledger/1", LEDGER_SCHEMA)
 
 
 def lint_trace(payload) -> list:
@@ -97,10 +103,10 @@ def lint_ledger(payload) -> list:
     findings = []
     if not isinstance(payload, dict):
         return ["ledger: top level must be a JSON object"]
-    if payload.get("schema") != LEDGER_SCHEMA:
+    if payload.get("schema") not in LEDGER_SCHEMAS:
         findings.append(
-            f"ledger: schema {payload.get('schema')!r} != "
-            f"{LEDGER_SCHEMA!r}"
+            f"ledger: schema {payload.get('schema')!r} not one of "
+            f"{LEDGER_SCHEMAS!r}"
         )
     aggregates = payload.get("aggregates")
     if not isinstance(aggregates, dict):
@@ -174,6 +180,14 @@ def lint_ledger(payload) -> list:
             findings.append(
                 f"{where}: unknown verdict {record.get('verdict')!r}"
             )
+        # v2 annotations are optional per record but must be shaped
+        # right when present (replay depends on them)
+        features = record.get("features")
+        if features is not None and not isinstance(features, dict):
+            findings.append(f"{where}: 'features' is not an object")
+        routed_by = record.get("routed_by")
+        if routed_by is not None and not isinstance(routed_by, str):
+            findings.append(f"{where}: 'routed_by' is not a string")
     return findings
 
 
@@ -210,9 +224,12 @@ def _selftest() -> int:
         spans.counter("selftest.gauge", value=3)
     led = ledger_mod.get_ledger()
     batch = led.begin_batch("batch_check", 4)
+    batch.set_features(0, {"v": 1, "constraints": 2, "nodes": 8})
     batch.decide(0, "word", "unsat")
     batch.transition(1, "dispatched")
     batch.decide(1, "sweep", "sat")
+    batch.set_features(2, {"v": 1, "constraints": 1, "nodes": 3})
+    batch.set_routed(2, "tail-direct")
     batch.transition(2, "deferred")
     batch.close()  # lanes 2 and 3 settle as tail-demoted
     led.single("prune", "structural", "unsat")

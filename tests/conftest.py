@@ -38,3 +38,19 @@ REFERENCE_ROOT = "/root/reference"
 def reference_path(*parts: str) -> str:
     """Path into the read-only reference checkout (tests skip if absent)."""
     return os.path.join(REFERENCE_ROOT, *parts)
+
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _isolate_autopilot():
+    """The autopilot singleton (cost model + tuner knob overrides) is
+    process-wide and fed by every ledger batch any test closes; without
+    a per-test reset, a tuner step taken during one module's funnel
+    tests changes another module's sweep counts (order-dependence).
+    Tests that need accumulation build it within themselves."""
+    yield
+    from mythril_tpu.autopilot import reset_for_tests
+
+    reset_for_tests()

@@ -1,0 +1,662 @@
+"""Autopilot tests: feature extraction determinism, cost-model math,
+routing-policy rules, kill-switch parity through the real funnel,
+online tuner adjust/revert, deterministic offline replay (including the
+checked-in tests/fixtures/ artifact), the ``/debug/autopilot`` surface,
+and the headline / bench_compare gate wiring."""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from mythril_tpu import autopilot
+from mythril_tpu.autopilot import features as features_mod
+from mythril_tpu.autopilot.features import (
+    feature_signature, lane_features,
+)
+from mythril_tpu.autopilot.model import ALPHA, CostModel
+from mythril_tpu.autopilot.policy import make_policy
+from mythril_tpu.autopilot.tuner import KNOBS, OnlineTuner
+from mythril_tpu.observability import ledger, metrics
+
+pytestmark = pytest.mark.autopilot
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+
+FIXTURE = os.path.join(REPO_ROOT, "tests", "fixtures",
+                       "lane_ledger_v2.json")
+
+_KNOB_VARS = (
+    "MYTHRIL_TPU_AUTOPILOT", "MYTHRIL_TPU_AUTOPILOT_POLICY",
+    "MYTHRIL_TPU_AUTOPILOT_MIN_SAMPLES", "MYTHRIL_TPU_AUTOPILOT_LADDER",
+    "MYTHRIL_TPU_AUTOPILOT_TAIL_SHARE",
+    "MYTHRIL_TPU_AUTOPILOT_EVAL_EVERY",
+    "MYTHRIL_TPU_LEDGER", "MYTHRIL_TPU_FRONTIER_FAN",
+    "MYTHRIL_TPU_FRONTIER_PERIOD", "MYTHRIL_TPU_TIER_PERIOD",
+    "MYTHRIL_TPU_COALESCE_WINDOW",
+)
+
+
+@pytest.fixture(autouse=True)
+def clean(monkeypatch):
+    for var in _KNOB_VARS:
+        monkeypatch.delenv(var, raising=False)
+    autopilot.reset_for_tests()
+    ledger.reset_for_tests()
+    metrics.reset_for_tests()
+    yield
+    autopilot.reset_for_tests()
+    ledger.reset_for_tests()
+    metrics.reset_for_tests()
+
+
+def _lane_nodes(tag: str, sat: bool):
+    """One constraint set as raw term nodes (interned DAG)."""
+    from mythril_tpu.smt import UGT, ULT, symbol_factory
+
+    x = symbol_factory.BitVecSym(tag, 16)
+    if sat:
+        return [(x == 7).raw]
+    return [ULT(x, symbol_factory.BitVecVal(2, 16)).raw,
+            UGT(x, symbol_factory.BitVecVal(9, 16)).raw]
+
+
+# -- features ---------------------------------------------------------------
+
+
+def test_feature_vector_deterministic_and_memoized():
+    nodes = _lane_nodes("fd0", sat=False)
+    first = lane_features(nodes)
+    second = lane_features(nodes)
+    assert first == second
+    assert feature_signature(first) == feature_signature(second)
+    # the memo actually holds the entry (one walk per constraint set)
+    key = tuple(sorted(n.id for n in nodes))
+    assert key in features_mod._memo
+    # the vector reads the cone correctly: two comparisons over one
+    # 16-bit var and two constants
+    assert first["constraints"] == 2
+    assert first["vars"] == 1
+    assert first["consts"] == 2
+    assert first["max_width"] == 16
+    assert first["ops"]["cmp"] == 2
+    # tx stamping never mutates the memoized base vector
+    stamped = lane_features(nodes, tx=3)
+    assert stamped["tx"] == 3
+    assert "tx" not in lane_features(nodes)
+
+
+def test_feature_signature_buckets_generalize():
+    base = {"v": 1, "constraints": 5, "nodes": 40, "vars": 3,
+            "max_width": 256, "ops": {"arith": 2, "cmp": 1}}
+    near = dict(base, nodes=44)   # same power-of-two bucket
+    far = dict(base, nodes=100)   # different bucket
+    assert feature_signature(base) == feature_signature(near)
+    assert feature_signature(base) != feature_signature(far)
+    assert feature_signature(base).startswith("f1.")
+    # tx depth is part of the key verbatim
+    assert feature_signature(dict(base, tx=2)) != feature_signature(base)
+
+
+# -- cost model -------------------------------------------------------------
+
+
+def test_cost_model_ewma_recurrence_pinned():
+    model = CostModel()
+    xs = [1.0, 0.0, 0.0, 1.0]
+    walls = [0.5, 0.1, 0.3, 0.2]
+    expected_rate, expected_wall = xs[0], walls[0]
+    model.observe("sig", "word", bool(xs[0]), walls[0])
+    for x, w in zip(xs[1:], walls[1:]):
+        model.observe("sig", "word", bool(x), w)
+        expected_rate = (1 - ALPHA) * expected_rate + ALPHA * x
+        expected_wall = (1 - ALPHA) * expected_wall + ALPHA * w
+    assert model.decide_rate("sig", "word") == pytest.approx(
+        expected_rate
+    )
+    cell = model.snapshot()["top"]["sig"]["word"]
+    assert cell["n"] == 4
+    assert cell["decided_n"] == 2
+    assert cell["wall_ewma_s"] == pytest.approx(expected_wall, abs=1e-6)
+    assert model.samples("sig") == 4
+    assert model.tail_share("sig") == 0.0
+
+
+def test_cost_model_tail_share_and_eviction():
+    model = CostModel()
+    for _ in range(3):
+        model.observe("s1", "tail", False)
+    model.observe("s1", "word", True)
+    assert model.tail_share("s1") == pytest.approx(0.75)
+    assert model.tail_share("nope") is None
+    # bounded: overflowing evicts the fewest-sample bucket, never the
+    # well-observed one
+    from mythril_tpu.autopilot import model as model_mod
+
+    for i in range(model_mod.MAX_SIGNATURES):
+        model.observe(f"bulk{i}", "word", True)
+    assert model.samples("s1") == 4  # survived (most samples)
+    snap = model.snapshot(top=0)
+    assert snap["signatures"] <= model_mod.MAX_SIGNATURES
+
+
+# -- routing policy ---------------------------------------------------------
+
+
+def test_policy_routes_nothing_below_min_samples(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_AUTOPILOT_MIN_SAMPLES", "4")
+    model = CostModel()
+    policy = make_policy("ledger-v1")
+    features = {"v": 1, "constraints": 1, "nodes": 3, "vars": 1,
+                "max_width": 16, "ops": {"cmp": 1}}
+    sig = feature_signature(features)
+    for _ in range(3):
+        model.observe(sig, "tail", False)
+    assert policy.decide(features, model).routed_by is None
+
+
+def test_policy_word_skip_and_tail_direct(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_AUTOPILOT_MIN_SAMPLES", "4")
+    model = CostModel()
+    policy = make_policy("ledger-v1")
+    features = {"v": 1, "constraints": 1, "nodes": 3, "vars": 1,
+                "max_width": 16, "ops": {"cmp": 1}}
+    sig = feature_signature(features)
+    for _ in range(4):
+        model.observe(sig, "tail", False)
+    decision = policy.decide(features, model)
+    assert decision.skip_word       # word never decided this shape
+    assert decision.skip_device     # every lane tailed
+    assert decision.ladder is None
+    assert decision.routed_by == "word-skip+tail-direct"
+    # a shape the word tier DOES decide is never word-skipped
+    model2 = CostModel()
+    for _ in range(4):
+        model2.observe(sig, "word", True)
+    decision2 = policy.decide(features, model2)
+    assert not decision2.skip_word
+
+
+def test_policy_ladder_for_predicted_easy(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_AUTOPILOT_MIN_SAMPLES", "4")
+    monkeypatch.setenv("MYTHRIL_TPU_AUTOPILOT_LADDER", "500")
+    model = CostModel()
+    policy = make_policy("ledger-v1")
+    features = {"v": 1, "constraints": 2, "nodes": 8, "vars": 1,
+                "max_width": 16, "ops": {"cmp": 2}}
+    sig = feature_signature(features)
+    for _ in range(6):
+        model.observe(sig, "probe", True)
+    decision = policy.decide(features, model)
+    assert decision.ladder == 500
+    assert not decision.skip_device
+    assert decision.routed_by == "ladder"
+
+
+def test_static_policy_and_unknown_name():
+    model = CostModel()
+    for _ in range(100):
+        model.observe("any", "tail", False)
+    assert make_policy("static").decide({}, model).routed_by is None
+    with pytest.raises(ValueError):
+        make_policy("no-such-policy")
+
+
+# -- kill-switch parity through the real funnel -----------------------------
+
+
+def _frontier(tag: str):
+    lanes = []
+    for i in range(6):
+        lanes.append(_lane_nodes_as_exprs(f"{tag}{i}", sat=i % 2 == 0))
+    return lanes
+
+
+def _lane_nodes_as_exprs(tag: str, sat: bool):
+    from mythril_tpu.smt import UGT, ULT, symbol_factory
+
+    x = symbol_factory.BitVecSym(tag, 16)
+    if sat:
+        return [x == 3]
+    return [ULT(x, symbol_factory.BitVecVal(2, 16)),
+            UGT(x, symbol_factory.BitVecVal(9, 16))]
+
+
+@pytest.fixture
+def funnel(monkeypatch):
+    from mythril_tpu.ops.async_dispatch import get_async_dispatcher
+    from mythril_tpu.smt.solver import (
+        SolverStatistics, reset_blast_context,
+    )
+
+    reset_blast_context()
+    get_async_dispatcher().drop()
+    SolverStatistics().reset()
+    monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "off")
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setattr(args, "device_min_lanes", 2)
+    monkeypatch.setattr(args, "device_force_dispatch", True)
+    monkeypatch.setattr(args, "async_dispatch", False)
+    monkeypatch.setattr(args, "device_coalesce", False)
+    yield
+    get_async_dispatcher().drop()
+    reset_blast_context()
+
+
+class _View:
+    def __init__(self, constraints):
+        self.constraints = constraints
+        self.world_state = self
+
+
+def _prune_positions(tag: str):
+    """Which lane positions survive prune_infeasible on one chaos-tree
+    frontier — the verdict surface routing must never change."""
+    from mythril_tpu.laser.batch import prune_infeasible
+    from mythril_tpu.laser.ethereum.state.constraints import Constraints
+
+    views = [_View(Constraints(lane)) for lane in _frontier(tag)]
+    kept = prune_infeasible(views)
+    return [i for i, v in enumerate(views) if v in kept]
+
+
+def _seed_aggressive_routes(tag: str) -> None:
+    """Pre-load the cost model so every lane of this frontier's two
+    shapes routes word-skip + tail-direct — the most invasive plan the
+    policy can emit."""
+    pilot = autopilot.get_autopilot()
+    for i in range(2):
+        nodes = [c.raw for c in _frontier(tag)[i]]
+        sig = feature_signature(lane_features(nodes))
+        for _ in range(30):
+            pilot.model.observe(sig, "tail", False)
+
+
+def test_kill_switch_parity_both_ways(funnel, monkeypatch):
+    from mythril_tpu.smt.solver import reset_blast_context
+
+    # static first: the exact pre-autopilot funnel
+    monkeypatch.setenv("MYTHRIL_TPU_AUTOPILOT", "0")
+    static = _prune_positions("kpa")
+    assert static == [0, 2, 4]  # the SAT half
+
+    # routed second: fresh context, model seeded so routing engages on
+    # every lane — verdict surface must be identical
+    reset_blast_context()
+    monkeypatch.setenv("MYTHRIL_TPU_AUTOPILOT", "1")
+    _seed_aggressive_routes("kpa")
+    routed = _prune_positions("kpa")
+    assert routed == static
+    counters = autopilot.get_autopilot().counters
+    assert counters.lanes_routed > 0      # the adaptive path really ran
+    assert counters.tail_routes > 0
+    # ...and the ledger carries the routing attribution
+    snap = ledger.get_ledger().snapshot()
+    assert sum(snap["routed"].values()) == counters.lanes_routed
+
+    # killed third (the other direction): back to the static path
+    reset_blast_context()
+    monkeypatch.setenv("MYTHRIL_TPU_AUTOPILOT", "0")
+    assert _prune_positions("kpa") == static
+
+
+def test_check_ladder_parity(funnel, monkeypatch):
+    """The bounded-then-unbounded tail ladder returns the same verdicts
+    as the static single solve."""
+    from mythril_tpu.smt.solver import SatSolver, get_blast_context
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setenv("MYTHRIL_TPU_AUTOPILOT_MIN_SAMPLES", "2")
+    # force the queries all the way to the CDCL tail: the ladder is a
+    # tail-stage rung, and probe/word tier would decide these small
+    # lanes before it
+    monkeypatch.setenv("MYTHRIL_TPU_WORD_TIER", "0")
+    monkeypatch.setattr(args, "word_probing", False)
+    ctx = get_blast_context()
+    sat_nodes = _lane_nodes("ckl0", sat=True)
+    unsat_nodes = _lane_nodes("ckl1", sat=False)
+    pilot = autopilot.get_autopilot()
+    for nodes in (sat_nodes, unsat_nodes):
+        sig = feature_signature(lane_features(nodes))
+        for _ in range(4):
+            pilot.model.observe(sig, "probe", True)  # predicted easy
+    status_sat, env = ctx.check(sat_nodes)
+    status_unsat, _ = ctx.check(unsat_nodes)
+    assert status_sat == SatSolver.SAT and env is not None
+    assert status_unsat == SatSolver.UNSAT
+    counters = pilot.counters
+    assert counters.ladder_solves >= 1
+    assert counters.ladder_decided + counters.ladder_fallbacks == (
+        counters.ladder_solves
+    )
+
+
+# -- online tuner -----------------------------------------------------------
+
+
+def test_tuner_takes_one_bounded_step(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_AUTOPILOT_EVAL_EVERY", "2")
+    tuner = OnlineTuner()
+    tuner.observe(40.0, 0)
+    tuner.observe(40.0, 0)
+    # one knob, one step, bounded by the knob's own step size
+    assert tuner.adjustments == 1
+    (name, value), = tuner.debug_state()["overrides"].items()
+    knob = KNOBS[name]
+    assert abs(value - knob.default) == knob.step
+    assert knob.lo <= value <= knob.hi
+    # a stable window keeps the step and moves to the next knob
+    tuner.observe(40.0, 0)
+    tuner.observe(40.0, 0)
+    assert tuner.adjustments == 2
+    assert tuner.reverts == 0
+    assert len(tuner.debug_state()["overrides"]) == 2
+
+
+def test_tuner_reverts_on_regression(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_AUTOPILOT_EVAL_EVERY", "2")
+    tuner = OnlineTuner()
+    tuner.observe(10.0, 0)
+    tuner.observe(10.0, 0)    # step taken, baseline tail ewma = 10
+    assert tuner.adjustments == 1
+    stepped = dict(tuner.debug_state()["overrides"])
+    tuner.observe(50.0, 0)    # tail share blows up
+    tuner.observe(50.0, 0)
+    assert tuner.reverts == 1
+    state = tuner.debug_state()
+    assert state["overrides"] == {}  # the step was undone
+    assert list(stepped)[0] in state["cooldown"]
+
+
+def test_tuner_respects_operator_pins(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_AUTOPILOT_EVAL_EVERY", "2")
+    monkeypatch.setenv("MYTHRIL_TPU_FRONTIER_FAN", "32")
+    tuner = OnlineTuner()
+    tuner.observe(40.0, 0)
+    tuner.observe(40.0, 0)
+    overrides = tuner.debug_state()["overrides"]
+    assert "frontier_fan" not in overrides  # pinned knob untouched
+    assert overrides  # ...but an unpinned knob still stepped
+
+
+def test_tuner_coalesce_window_is_queue_driven(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_AUTOPILOT_EVAL_EVERY", "2")
+    for knob in ("MYTHRIL_TPU_FRONTIER_FAN",
+                 "MYTHRIL_TPU_FRONTIER_PERIOD",
+                 "MYTHRIL_TPU_TIER_PERIOD"):
+        monkeypatch.setenv(knob, "8")  # pin everything else
+    tuner = OnlineTuner()
+    tuner.observe(40.0, 0)
+    tuner.observe(40.0, 0)
+    assert tuner.adjustments == 0  # shallow queue: window left alone
+    tuner.observe(40.0, 20)        # deep queue
+    tuner.observe(40.0, 20)
+    assert tuner.debug_state()["overrides"].get("coalesce_window") == 1
+
+
+def test_tuner_override_reaches_knob_getters(monkeypatch):
+    from mythril_tpu.ops.coalesce import _window
+    from mythril_tpu.ops.frontier import frontier_fan
+    from mythril_tpu.ops.pallas_prop import _tier_period
+
+    pilot = autopilot.get_autopilot()
+    pilot.tuner._overrides.update(
+        frontier_fan=24, tier_period=4, coalesce_window=1,
+    )
+    assert frontier_fan() == 24
+    assert _tier_period() == 4
+    assert _window() == 1
+    # the operator pin always wins over the tuner
+    monkeypatch.setenv("MYTHRIL_TPU_FRONTIER_FAN", "12")
+    assert frontier_fan() == 12
+    monkeypatch.delenv("MYTHRIL_TPU_FRONTIER_FAN")
+    # the kill switch instantly restores every static default
+    monkeypatch.setenv("MYTHRIL_TPU_AUTOPILOT", "0")
+    assert frontier_fan() == 16
+    assert _tier_period() == 8
+    assert _window() == 2
+
+
+# -- offline replay ---------------------------------------------------------
+
+
+def test_fixture_replay_is_deterministic():
+    from mythril_tpu.autopilot.replay import replay_artifact
+
+    first = replay_artifact(FIXTURE)
+    second = replay_artifact(FIXTURE)
+    assert first["digest"] == second["digest"]
+    assert first["schema"] == "mythril-tpu-lane-ledger/2"
+    assert first["records"] == first["with_features"] > 0
+
+
+def test_replay_routes_and_freezes_routed_observations(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_AUTOPILOT_MIN_SAMPLES", "4")
+    from mythril_tpu.autopilot.replay import replay_records
+
+    features = {"v": 1, "constraints": 1, "nodes": 3, "vars": 1,
+                "max_width": 16, "ops": {"cmp": 1}}
+    records = [{"tier": "tail", "verdict": "undecided",
+                "features": features} for _ in range(10)]
+    result = replay_records(records)
+    # the first MIN_SAMPLES feed the model; everything after routes,
+    # and routed records do NOT update the model (mirroring live)
+    assert result["decisions"][:4] == [None] * 4
+    assert all(d == "word-skip+tail-direct"
+               for d in result["decisions"][4:])
+    assert result["routed"] == 6
+    assert result["rules"] == {"word-skip+tail-direct": 6}
+    # the static policy replays the same artifact to zero routes
+    assert replay_records(records, policy="static")["routed"] == 0
+
+
+def test_replay_rejects_unknown_schema(tmp_path):
+    from mythril_tpu.autopilot.replay import load_artifact
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"schema": "something/9", "records": []}))
+    with pytest.raises(ValueError):
+        load_artifact(str(bad))
+
+
+def test_replay_cli_selftest():
+    script = os.path.join(REPO_ROOT, "scripts", "autopilot_replay.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, script, "--selftest"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "selftest: ok" in proc.stdout
+
+
+# -- ledger v2 surface ------------------------------------------------------
+
+
+def test_ledger_v2_routed_attribution():
+    led = ledger.get_ledger()
+    batch = led.begin_batch("batch_check", 3)
+    batch.set_features(0, {"v": 1, "constraints": 1, "nodes": 3})
+    batch.set_routed(0, "tail-direct")
+    batch.decide(1, "word", "unsat")
+    batch.close()
+    snap = led.snapshot()
+    assert snap["routed"] == {"tail-direct": 1}
+    assert sum(snap["decided"].values()) == 3  # conservation intact
+    by_tier = {r["tier"]: r for r in led.records}
+    routed_record = [r for r in led.records
+                    if r.get("routed_by") == "tail-direct"]
+    assert len(routed_record) == 1
+    assert routed_record[0]["features"]["nodes"] == 3
+    assert by_tier["word"].get("routed_by") is None
+    text = metrics.get_registry().render()
+    assert ('mythril_tpu_ledger_routed_total{rule="tail-direct"} 1'
+            in text)
+    assert "mythril_tpu_autopilot_enabled 1" in text
+
+
+def test_autopilot_registry_series():
+    pilot = autopilot.get_autopilot()
+    pilot.counters.lanes_seen = 5
+    pilot.counters.lanes_routed = 2
+    text = metrics.get_registry().render()
+    assert "mythril_tpu_autopilot_lanes_seen 5" in text
+    assert "mythril_tpu_autopilot_lanes_routed 2" in text
+    assert "mythril_tpu_autopilot_model_signatures 0" in text
+
+
+# -- serve: /debug/autopilot ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    from mythril_tpu.ops.async_dispatch import get_async_dispatcher
+    from mythril_tpu.ops.coalesce import (
+        reset_coalescer, set_request_scope, set_serve_mode,
+    )
+    from mythril_tpu.resilience import budget, faults, watchdog
+    from mythril_tpu.resilience.checkpoint import reset_for_tests
+    from mythril_tpu.serve import AnalysisServer
+    from mythril_tpu.serve.config import ServeConfig
+    from mythril_tpu.smt.solver import reset_blast_context
+
+    def _clean():
+        budget.reset_for_tests()
+        faults.reset_for_tests()
+        watchdog.reset_for_tests()
+        reset_for_tests()
+        set_serve_mode(False)
+        set_request_scope(None)
+        reset_coalescer(hard=True)
+        get_async_dispatcher().drop()
+        reset_blast_context()
+
+    _clean()
+    ledger.reset_for_tests()
+    srv = AnalysisServer(ServeConfig.from_env(port=0))
+    srv.start()
+    yield srv
+    srv.drain_and_stop("autopilot tests done")
+    _clean()
+
+
+def test_debug_autopilot_endpoint(server):
+    resp = urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/debug/autopilot", timeout=30
+    )
+    assert resp.status == 200
+    body = json.loads(resp.read())
+    assert body["enabled"] is True
+    assert body["policy"] == "ledger-v1"
+    assert "lanes_seen" in body["counters"]
+    assert "signatures" in body["model"]
+    assert "overrides" in body["tuner"]
+
+
+def test_myth_top_renders_autopilot_panel(server, capsys):
+    from mythril_tpu.interfaces.top import render_once
+
+    assert render_once(f"http://127.0.0.1:{server.port}")
+    out = capsys.readouterr().out
+    assert "autopilot: policy=ledger-v1" in out
+
+
+# -- headline + bench_compare gate ------------------------------------------
+
+
+def test_headline_carries_autopilot_counters():
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    import bench
+    from tests.test_bench_headline import BASE_SUMMARY
+
+    summary = dict(BASE_SUMMARY)
+    summary["autopilot"] = {"lanes_seen": 40, "lanes_routed": 12,
+                            "ladder_decided": 3,
+                            "tuner_adjustments": 2}
+    payload = json.loads(bench.build_headline_line(summary, None, None))
+    assert payload["autopilot_routed"] == 12
+    assert payload["autopilot_ladder"] == 3
+    assert payload["autopilot_tuned"] == 2
+    assert len(json.dumps(payload)) <= 500
+    # absent (not null) when the autopilot never engaged
+    quiet = json.loads(
+        bench.build_headline_line(dict(BASE_SUMMARY), None, None)
+    )
+    assert "autopilot_routed" not in quiet
+
+
+def _bench_art(directory, n, tail_pct, vs_baseline):
+    (directory / f"BENCH_r{n}.json").write_text(json.dumps({"parsed": {
+        "metric": "corpus_wall_s", "value": 10.0, "unit": "s",
+        "vs_baseline": vs_baseline,
+        "tier_decided_pct": {"word": 40.0, "tail": tail_pct},
+    }}))
+
+
+def test_bench_compare_gates_tail_only_at_equal_verdicts(
+    tmp_path, monkeypatch
+):
+    import bench_compare
+
+    equal = tmp_path / "equal"
+    equal.mkdir()
+    _bench_art(equal, 1, 10.0, 1.0)
+    _bench_art(equal, 2, 50.0, 1.0)   # tail exploded, same verdicts
+    monkeypatch.setattr(sys, "argv",
+                        ["bench_compare", "--dir", str(equal)])
+    assert bench_compare.main() == 1  # gated: regression
+
+    unequal = tmp_path / "unequal"
+    unequal.mkdir()
+    _bench_art(unequal, 1, 10.0, 1.0)
+    _bench_art(unequal, 2, 50.0, 0.5)  # verdicts differ
+    monkeypatch.setattr(sys, "argv",
+                        ["bench_compare", "--dir", str(unequal)])
+    assert bench_compare.main() == 0  # informational only
+
+
+# -- env validation ---------------------------------------------------------
+
+
+def test_env_validation_lenient_read_strict_startup(monkeypatch):
+    from mythril_tpu.support.env import (
+        EnvSpecError, env_int, validate_env,
+    )
+
+    monkeypatch.setenv("MYTHRIL_TPU_FRONTIER_FAN", "1b")
+    # read-time: malformed falls back to the default (hot paths must
+    # not crash mid-analysis on a config typo)
+    assert env_int("MYTHRIL_TPU_FRONTIER_FAN", 16, floor=1) == 16
+    # startup: the same typo is fatal
+    with pytest.raises(EnvSpecError):
+        validate_env()
+    monkeypatch.setenv("MYTHRIL_TPU_FRONTIER_FAN", "0")
+    with pytest.raises(EnvSpecError):
+        validate_env()  # below the knob's floor
+    monkeypatch.setenv("MYTHRIL_TPU_FRONTIER_FAN", "8")
+    validate_env()  # a sane value passes
+    # read-time clamping still applies to out-of-range values
+    monkeypatch.setenv("MYTHRIL_TPU_FRONTIER_FAN", "-3")
+    assert env_int("MYTHRIL_TPU_FRONTIER_FAN", 16, floor=1) == 1
+
+
+def test_cli_rejects_bad_env_knob_with_exit_2():
+    myth = os.path.join(REPO_ROOT, "myth")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MYTHRIL_TPU_FRONTIER_FAN"] = "1b"
+    proc = subprocess.run(
+        [sys.executable, myth, "disassemble", "-c", "6001"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "bad environment knob" in proc.stderr

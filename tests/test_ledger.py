@@ -273,7 +273,7 @@ def test_export_and_trace_lint_round_trip(tmp_path, funnel):
     assert trace_lint.lint_trace(json.load(open(trace_path))) == []
     assert trace_lint.lint_ledger(json.load(open(ledger_path))) == []
     payload = json.load(open(ledger_path))
-    assert payload["schema"] == "mythril-tpu-lane-ledger/1"
+    assert payload["schema"] == "mythril-tpu-lane-ledger/2"
     assert payload["conservation"]["lanes_total"] == payload[
         "conservation"
     ]["decided_total"]
