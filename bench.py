@@ -448,6 +448,12 @@ def _analyze_one(name, code, tx_count, execution_timeout, max_depth):
         execution_timeout=execution_timeout,
         create_timeout=10,
         transaction_count=tx_count,
+        # what every production entry point passes (mythril_analyzer,
+        # serve/engine, parallel/fleet): all detection modules are
+        # CALLBACK, so recording the full statespace is pure overhead —
+        # and requires_statespace pins the lockstep tier off, so leaving
+        # the default here would bench a pipeline nothing else runs
+        compulsory_statespace=False,
     )
     issues = fire_lasers(sym)
     # an unharvested prefetch belongs to THIS contract's row: drop it
@@ -600,6 +606,15 @@ def _mesh_scale_row():
         JAX_PLATFORMS="cpu",
         MYTHRIL_TPU_PALLAS="off",  # gather/mesh path, not the dense kernel
         MYTHRIL_TPU_HEALTH="ok",
+        # the lockstep tier deliberately concentrates each frontier's
+        # JUMPI forks into one wide batch_check_states dispatch — the
+        # production win — but the interpret-mode shard_map this row
+        # simulates with pays a per-shape compile that scales
+        # pathologically with lane width (358s in dispatch.batch_check
+        # vs 15s serial on this very row); pin it off so the row keeps
+        # measuring what it exists for: the sharded dp×cp path
+        # executing the production workload with findings parity
+        MYTHRIL_TPU_SYM_LOCKSTEP="0",
     )
     try:
         proc = subprocess.run(
@@ -882,6 +897,9 @@ def _scale_summary(row):
         # device-native propagation (frontier tier: adjacency-gather
         # iterations + on-device first-UIP clauses harvested)
         "frontier_steps", "learned_clauses",
+        # symbolic lockstep tier (interpreter steps inside batched
+        # segments + their wall, the states_per_s numerator/denominator)
+        "states_stepped", "segment_s",
     )
     out = {k: row[k] for k in keys if k in row}
     total = out.get("lane_sweeps_total", 0)
@@ -968,6 +986,12 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
         # pair, so the cap headroom is untouched on quiet rounds
         headline["sweeps_per_lane"] = summary["sweeps_per_lane"]
         headline["learned_clauses"] = summary.get("learned_clauses", 0)
+    if summary.get("states_per_s") is not None:
+        # symbolic lockstep tier: interpreter steps per second inside
+        # batched segments (gated higher-is-better in bench_compare).
+        # Absent (not null) when no segment ran — kill switch on, or a
+        # corpus whose frontiers never shared a pc
+        headline["states_per_s"] = summary["states_per_s"]
     if "t3_wall_s" in summary:
         headline["t3_wall_s"] = summary["t3_wall_s"]
     if isinstance(mesh_scale, dict) and "skipped" not in mesh_scale:
@@ -1012,7 +1036,7 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
                     "mesh_row_ok", "trace_overhead_s", "word_prop_s",
                     "blast_s", "sweep_util", "learned_clauses",
                     "sweeps_per_lane",
-                    "h2d_bytes", "device_sweeps",
+                    "h2d_bytes", "device_sweeps", "states_per_s",
                     "checkpoint_overhead_s", "t3_wall_s", "error",
                     "watchdog_trips", "demotions"):
             headline.pop(key, None)
@@ -1239,6 +1263,22 @@ def main() -> None:
         "word_tightened_bits": sum(
             r.get("word_tightened_bits", 0) for r in rows
         ),
+        # symbolic lockstep tier (laser/ethereum/symbolic_lockstep.py):
+        # interpreter (state, opcode) steps executed inside batched
+        # segments, the wall-clock of those segments (svm.segment
+        # span's sink), and the limb-plane carriage's known-bit density
+        "states_stepped": sum(
+            r.get("states_stepped", 0) for r in rows
+        ),
+        "segment_s": round(
+            sum(r.get("segment_s", 0.0) for r in rows), 3
+        ),
+        "plane_known_bits": sum(
+            r.get("plane_known_bits", 0) for r in rows
+        ),
+        "plane_total_bits": sum(
+            r.get("plane_total_bits", 0) for r in rows
+        ),
         # degradation ladder telemetry (resilience/): a faulted or
         # flaky-device round is attributable from the artifact alone
         "watchdog_trips": sum(r.get("watchdog_trips", 0) for r in rows),
@@ -1354,6 +1394,21 @@ def main() -> None:
     summary["learned_clauses"] = sum(
         r.get("learned_clauses", 0) for r in rows
     ) + sum(r.get("learned_clauses", 0) for r in scale_rows.values())
+    # symbolic lockstep tier headline: interpreter-attributed
+    # throughput — (state, opcode) steps executed inside batched
+    # segments over the svm.segment span wall, across the corpus and
+    # scale passes.  None (and absent from the headline) when no
+    # segment ever ran, e.g. MYTHRIL_TPU_SYM_LOCKSTEP=0; gated
+    # higher-is-better in scripts/bench_compare.py alongside t3_wall_s
+    seg_steps = summary["states_stepped"] + sum(
+        r.get("states_stepped", 0) for r in scale_rows.values()
+    )
+    seg_wall = summary["segment_s"] + sum(
+        r.get("segment_s", 0.0) for r in scale_rows.values()
+    )
+    summary["states_per_s"] = (
+        round(seg_steps / seg_wall, 1) if seg_wall else None
+    )
     # ledger-derived attribution: what share of all dispatched lanes
     # each funnel tier decided across this whole bench process (the
     # lane ledger accumulates run-wide; observability/ledger.py).

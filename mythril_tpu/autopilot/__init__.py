@@ -60,7 +60,8 @@ class AutopilotCounters:
 
     __slots__ = ("lanes_seen", "lanes_routed", "word_skips",
                  "tail_routes", "ladder_solves", "ladder_decided",
-                 "ladder_fallbacks")
+                 "ladder_fallbacks", "segments_seen",
+                 "segments_declined")
 
     def __init__(self):
         for field in self.__slots__:
@@ -219,6 +220,53 @@ def knob_override(name: str) -> Optional[int]:
     return pilot.tuner.override(name)
 
 
+#: per-lane lockstep wall (seconds) above which a learned segment shape
+#: is routed back to the serial interpreter; ceiling in milliseconds
+#: via MYTHRIL_TPU_SEG_CEIL_MS
+_SEG_CEIL_MS_DEFAULT = 50.0
+#: observations of a segment signature required before the ceiling may
+#: fire (threshold-fired like the policy rules, not learned)
+_SEG_MIN_SAMPLES = 8
+
+
+def route_segment(features: dict) -> bool:
+    """Segment-shape hook for the symbolic lockstep tier: True = run
+    the segment group in lockstep, False = decline (the group falls
+    through to the per-state interpreter, verdict-neutral either way).
+    Declines only when the cost model has seen this shape enough times
+    AND its per-lane lockstep wall EWMA exceeds the ceiling — i.e. the
+    tier demonstrably loses on this shape (incoherent frontiers whose
+    term traffic defeats the shared-structure win)."""
+    if not autopilot_enabled():
+        return True
+    pilot = get_autopilot()
+    pilot.counters.segments_seen += 1
+    signature = feature_signature(features)
+    if pilot.model.tier_count(signature, "lockstep") < _SEG_MIN_SAMPLES:
+        return True
+    from mythril_tpu.support.env import env_float
+
+    ceil_s = env_float(
+        "MYTHRIL_TPU_SEG_CEIL_MS", _SEG_CEIL_MS_DEFAULT, floor=0.0
+    ) / 1e3
+    wall = pilot.model.wall_share(signature, "lockstep")
+    if wall is not None and wall > ceil_s:
+        pilot.counters.segments_declined += 1
+        return False
+    return True
+
+
+def note_segment(features: dict, lanes: int, wall_s: float) -> None:
+    """Fold one executed segment group into the cost model under the
+    ``lockstep`` tier key (per-lane wall share, always 'decided' — the
+    tier never leaves a lane undecided, it hands it back)."""
+    if not autopilot_enabled() or _autopilot is None or lanes <= 0:
+        return
+    _autopilot.model.observe(
+        feature_signature(features), "lockstep", True, wall_s / lanes
+    )
+
+
 def note_ladder(decided_first_rung: bool) -> None:
     """Tail-ladder accounting from ``BlastContext.check``."""
     if _autopilot is None:
@@ -256,7 +304,8 @@ def _autopilot_collector():
         return
     for field in ("lanes_seen", "lanes_routed", "word_skips",
                   "tail_routes", "ladder_solves", "ladder_decided",
-                  "ladder_fallbacks", "tuner_adjustments",
+                  "ladder_fallbacks", "segments_seen",
+                  "segments_declined", "tuner_adjustments",
                   "tuner_reverts"):
         yield ("counter", f"mythril_tpu_autopilot_{field}",
                "autopilot routing/tuning activity", snap.get(field, 0))

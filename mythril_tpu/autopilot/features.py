@@ -111,6 +111,22 @@ def _extract(nodes: List) -> dict:
     }
 
 
+def segment_features(lanes: int, ops: int, coherence: float) -> dict:
+    """Shape vector for one lockstep segment group (symbolic_lockstep):
+    lane count, straight-line run length, and entry-stack coherence —
+    the fraction of entry stack slots holding interned-shared or
+    constant terms across the group (1.0 = fully coherent siblings,
+    0.0 = unrelated states that happen to share a pc).  Rides the same
+    signature/cost-model machinery as the solver lanes under the
+    ``lockstep`` tier key."""
+    return {
+        "v": FEATURE_VERSION,
+        "seg_lanes": int(lanes),
+        "seg_ops": int(ops),
+        "seg_coherence": round(float(coherence), 3),
+    }
+
+
 def _bucket(n: int) -> int:
     """Power-of-two bucket (0, 1, 2, 4, 8, ...) — the signature must
     generalize across cones that differ by a node or two."""
@@ -123,6 +139,17 @@ def feature_signature(features: dict) -> str:
     op-class *mix* (which classes are present) rather than exact
     counts; carries the transaction depth verbatim (depth changes the
     workload shape wholesale — deeper txs mean wider storage cones)."""
+    if "seg_lanes" in features:
+        # segment-shape signature (lockstep tier): lane count and run
+        # length bucket like cone counts; coherence in tenths — solver
+        # signatures are untouched (no seg_* fields, no suffix)
+        coh = int(round(features.get("seg_coherence", 0.0) * 10))
+        return (
+            f"f{features.get('v', 0)}"
+            f".g{_bucket(features.get('seg_lanes', 0))}"
+            f".o{_bucket(features.get('seg_ops', 0))}"
+            f".h{coh}"
+        )
     ops = features.get("ops") or {}
     mix = "".join(c[0] for c in OP_CLASSES if ops.get(c))
     return (

@@ -124,6 +124,15 @@ class CostModel:
             stats = bucket.get(tier) if bucket else None
             return stats.decide_ewma if stats and stats.n else None
 
+    def wall_share(self, signature: str, tier: str) -> Optional[float]:
+        """Per-lane wall EWMA for one cell (None until observed) — the
+        lockstep segment router compares this against its ceiling to
+        steer incoherent frontiers around the tier."""
+        with self._lock:
+            bucket = self._buckets.get(signature)
+            stats = bucket.get(tier) if bucket else None
+            return stats.wall_ewma if stats and stats.n else None
+
     # -- introspection ------------------------------------------------
 
     def snapshot(self, top: int = 12) -> dict:

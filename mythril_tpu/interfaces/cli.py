@@ -262,8 +262,16 @@ def create_analyzer_parser(analyzer_parser: argparse.ArgumentParser) -> None:
         "--lockstep-dispatch",
         action="store_true",
         help="Pre-split transaction seeds by function selector via the "
-        "SoA-validated dispatcher plan (experimental, see "
-        "docs/measurements_r3.md)",
+        "SoA-validated dispatcher plan (now the default; kept for "
+        "script compatibility)",
+    )
+    options.add_argument(
+        "--no-lockstep-dispatch",
+        action="store_true",
+        help="Disable the dispatcher pre-split: every transaction seed "
+        "executes the full dispatcher prefix serially (the pre-split "
+        "already auto-declines per contract on non-canonical "
+        "dispatchers)",
     )
     options.add_argument(
         "--no-async-dispatch",
@@ -656,7 +664,7 @@ def _build_analyzer(
     return MythrilAnalyzer(
         batched_solving=not args.no_batched_solving,
         device_force_dispatch=args.device_force_dispatch,
-        lockstep_dispatch=args.lockstep_dispatch,
+        lockstep_dispatch=not args.no_lockstep_dispatch,
         proof_log=args.proof_log,
         async_dispatch=not args.no_async_dispatch,
         checkpoint_dir=getattr(args, "checkpoint_dir", None),

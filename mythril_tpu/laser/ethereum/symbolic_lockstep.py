@@ -1,0 +1,733 @@
+"""Symbolic lockstep tier: batch the LASER interpreter over sibling
+states.
+
+``LaserEVM._exec_round`` draws a batch of GlobalStates per scheduler
+round; without this module each one walks the per-state Python
+interpreter (``execute_state``) one opcode at a time, re-entering the
+scheduler after every instruction.  Sibling states — forks of the same
+contract exploring different path conditions — overwhelmingly sit at
+the *same* code offset, about to execute the *same* straight-line run
+of opcodes up to the next branch point.  This tier exploits that:
+
+- **frontier grouping** — eligible lanes are grouped by (bytecode,
+  pc); each group executes one *segment* (the straight-line opcode run
+  from the shared pc up to JUMP/JUMPI, an unsupported opcode, or the
+  ``MYTHRIL_TPU_SEG_MAX_OPS`` cap) in lockstep, advancing all lanes op
+  by op;
+- **raw-mutator execution** — each supported opcode's undecorated
+  mutator (``Instruction.<op>_.mutator``, stashed by the
+  ``StateTransition`` decorator) runs on the live lane, and the
+  decorator's gas/pc bookkeeping is replayed from the same
+  ``StateTransition`` instance, so the per-opcode state copy the serial
+  path pays disappears while the semantics cannot drift;
+- **fault prechecks** — stack underflow/overflow and out-of-gas are
+  checked *before* the mutator runs (the serial path discovers them on
+  a throwaway copy); a faulting lane leaves the segment through the
+  exact ``execute_state`` exception arms, so hook traffic and successor
+  shapes match the serial path call for call;
+- **fork handoff** — JUMP/JUMPI terminate the segment through the real
+  (decorated) semantics on a defensive copy; every successor's path
+  constraint flows into the round's single ``prune_infeasible`` pass,
+  which hands the whole frontier's fork masks to ``batch_check_states``
+  in one dispatch (laser/batch.py);
+- **NEEDS_HOST boundary** — any opcode outside the supported set
+  (CALL/CREATE/KECCAK, storage, host services — the same philosophy as
+  ``ops/lockstep.py``'s NEEDS_HOST set) ends the segment *before* the
+  opcode: the lane returns to the scheduler as its own successor with
+  identical machine state and the serial interpreter takes over;
+- **limb-plane carriage** — while a segment runs, a top-relative
+  shadow of the group's stack slots is carried as ops/word_prop
+  abstract words: batched ``f_*`` kernels over a lane axis when the
+  group has 2+ lanes, scalar ``s_*`` twins otherwise
+  (``MYTHRIL_TPU_SEG_PLANES=0`` disables).  The shadow is telemetry —
+  known-bit density feeds ``DispatchStats`` — and never influences
+  execution;
+- **autopilot routing** — each group's shape (lanes, run length, entry
+  coherence) is scored by ``autopilot.route_segment``; shapes the cost
+  model has learned to be slower per lane than
+  ``MYTHRIL_TPU_SEG_CEIL_MS`` fall back to the serial interpreter.
+
+Kill switch: ``MYTHRIL_TPU_SYM_LOCKSTEP=0`` restores the exact
+per-state path (``run_lockstep`` returns the batch untouched).  The
+tier also declines whole rounds under create transactions, gas-focused
+runs (``track_gas``) and ``requires_statespace`` — those paths consume
+per-opcode round records the segment compression elides.
+"""
+
+import logging
+import time
+from copy import copy
+from datetime import datetime, timedelta
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mythril_tpu.laser.ethereum.evm_exceptions import VmException
+from mythril_tpu.laser.ethereum.instructions import Instruction
+from mythril_tpu.laser.ethereum.state.machine_state import STACK_LIMIT
+from mythril_tpu.laser.plugin.signals import (
+    PluginSkipState,
+    PluginSkipWorldState,
+)
+from mythril_tpu.observability import spans as obs
+from mythril_tpu.ops import u256
+from mythril_tpu.ops import word_prop as W
+from mythril_tpu.ops.batched_sat import dispatch_stats
+from mythril_tpu.smt import BitVec
+from mythril_tpu.support.env import env_flag, env_int
+from mythril_tpu.support.opcodes import BY_NAME
+
+log = logging.getLogger(__name__)
+
+#: straight-line opcodes the tier executes in-segment: pure stack/term
+#: traffic with no host services, no new transactions, no state forks.
+#: Everything else is a NEEDS_HOST boundary.
+INTERIOR_OPS = frozenset(
+    ["POP", "ADD", "SUB", "MUL", "DIV", "SDIV", "MOD", "SMOD",
+     "ADDMOD", "MULMOD", "EXP", "SIGNEXTEND",
+     "LT", "GT", "SLT", "SGT", "EQ", "ISZERO",
+     "AND", "OR", "XOR", "NOT", "BYTE", "SHL", "SHR", "SAR",
+     "JUMPDEST", "PC", "MSIZE", "GAS",
+     "ADDRESS", "ORIGIN", "CALLER", "CALLVALUE", "GASPRICE",
+     "CHAINID", "CALLDATASIZE", "CALLDATALOAD"]
+    + [f"PUSH{i}" for i in range(1, 33)]
+    + [f"DUP{i}" for i in range(1, 17)]
+    + [f"SWAP{i}" for i in range(1, 17)]
+)
+
+#: branch points: executed in-segment (they end it) through the real
+#: decorated semantics on a defensive copy
+TERMINATORS = frozenset(("JUMP", "JUMPI"))
+
+_SEG_MAX_OPS_DEFAULT = 64
+
+
+def lockstep_enabled() -> bool:
+    """``MYTHRIL_TPU_SYM_LOCKSTEP=0`` pins the exact per-state
+    interpreter path."""
+    return env_flag("MYTHRIL_TPU_SYM_LOCKSTEP", True)
+
+
+def _fold(op_code: str) -> str:
+    op = op_code.lower()
+    for prefix in ("push", "dup", "swap"):
+        if op.startswith(prefix):
+            return prefix
+    return op
+
+
+class _OpPlan:
+    """Everything one segment step needs about one instruction."""
+
+    __slots__ = ("op", "pops", "pushes", "terminator", "mutator",
+                 "transition", "instr_obj", "address")
+
+    def __init__(self, op, pops, pushes, terminator, mutator, transition,
+                 instr_obj, address):
+        self.op = op
+        self.pops = pops
+        self.pushes = pushes
+        self.terminator = terminator
+        self.mutator = mutator
+        self.transition = transition
+        self.instr_obj = instr_obj
+        self.address = address
+
+
+class SegmentPlan:
+    """Per-bytecode segment metadata, cached like lockstep_dispatch's
+    DispatcherPlan: for every instruction index, either an
+    :class:`_OpPlan` (the tier can execute it) or None (NEEDS_HOST
+    boundary).  Entry at *any* pc is supported — a state resumed
+    mid-basic-block (checkpointed frontier, fleet handoff) groups
+    exactly like a fresh fork."""
+
+    __slots__ = ("info",)
+
+    def __init__(self, code):
+        self.info: List[Optional[_OpPlan]] = []
+        instr_objs: Dict[str, Instruction] = {}
+        for instr in code.instruction_list:
+            self.info.append(self._plan_op(instr, instr_objs))
+
+    @staticmethod
+    def _plan_op(instr, instr_objs) -> Optional[_OpPlan]:
+        op = instr.op_code
+        terminator = op in TERMINATORS
+        if not terminator and op not in INTERIOR_OPS:
+            return None
+        table = BY_NAME.get(op)
+        wrapped = getattr(Instruction, _fold(op) + "_", None)
+        mutator = getattr(wrapped, "mutator", None)
+        transition = getattr(wrapped, "transition", None)
+        if table is None or mutator is None or transition is None:
+            return None
+        if transition.is_state_mutation_instruction:
+            return None  # pragma: no cover — none in the supported set
+        if not terminator and not (
+            transition.increment_pc and transition.enable_gas
+        ):
+            return None  # pragma: no cover — defensive
+        obj = instr_objs.get(op)
+        if obj is None:
+            # hook-free Instruction solely as the mutator's self (push_
+            # /dup_/swap_ read self.op_code); svm-level hooks are fired
+            # by the segment loop from the svm's own tables
+            obj = instr_objs[op] = Instruction(op, None)
+        return _OpPlan(op, table.pops, table.pushes, terminator, mutator,
+                       transition, obj, instr.address)
+
+    def supported_at(self, pc: int) -> bool:
+        return 0 <= pc < len(self.info) and self.info[pc] is not None
+
+    def run_length(self, pc: int, cap: int) -> int:
+        """Planned ops from ``pc`` to the segment end (inclusive of a
+        terminator), capped."""
+        n = 0
+        while n < cap and self.supported_at(pc + n):
+            n += 1
+            if self.info[pc + n - 1].terminator:
+                break
+        return n
+
+
+_plan_cache: Dict[str, Optional[SegmentPlan]] = {}
+_PLAN_CACHE_CAP = 64
+
+
+def plan_for(code) -> Optional[SegmentPlan]:
+    """Cached per-bytecode plan (keyed by the bytecode string, same
+    idiom as lockstep_dispatch's plan cache)."""
+    key = getattr(code, "bytecode", None)
+    if not isinstance(key, str):
+        return None
+    plan = _plan_cache.get(key)
+    if plan is None and key not in _plan_cache:
+        try:
+            plan = SegmentPlan(code)
+        except Exception:  # noqa: BLE001 — decline, never break the run
+            log.debug("segment plan build failed", exc_info=True)
+            plan = None
+        if len(_plan_cache) >= _PLAN_CACHE_CAP:
+            for stale in list(_plan_cache)[: _PLAN_CACHE_CAP // 4]:
+                del _plan_cache[stale]
+        _plan_cache[key] = plan
+    return plan
+
+
+def reset_for_tests() -> None:
+    _plan_cache.clear()
+
+
+# ---------------------------------------------------------------------------
+# limb-plane shadow (telemetry only)
+# ---------------------------------------------------------------------------
+
+_WM = W.FULL
+
+
+def _term_sword(item):
+    """Scalar abstract word for one stack slot: concrete values (raw
+    ints or constant BitVecs) become singletons, symbolic terms top."""
+    if isinstance(item, int):
+        return W.s_const(item, _WM)
+    value = getattr(item, "value", None)
+    if value is not None:
+        return W.s_const(value, _WM)
+    return W.s_top(_WM)
+
+
+def _slot_key(item):
+    """Coherence identity of a stack slot: constants compare by value,
+    symbolic terms by object identity (shared sub-DAG)."""
+    if isinstance(item, int):
+        return ("c", item)
+    value = getattr(item, "value", None)
+    if value is not None:
+        return ("c", value)
+    return ("t", id(getattr(item, "raw", item)))
+
+
+def entry_coherence(states, depth: int = 4) -> float:
+    """Fraction of the top ``depth`` entry stack slots whose term is
+    shared (or an equal constant) across every lane of the group —
+    1.0 for a single lane or fully coherent siblings."""
+    if len(states) < 2:
+        return 1.0
+    slots = min(depth, *(len(s.mstate.stack) for s in states))
+    if slots == 0:
+        return 1.0
+    shared = 0
+    for d in range(1, slots + 1):
+        keys = {_slot_key(s.mstate.stack[-d]) for s in states}
+        if len(keys) == 1:
+            shared += 1
+    return shared / slots
+
+
+class _PlaneShadow:
+    """Top-relative abstract-word shadow of the group's machine stacks.
+
+    ``words[0]`` shadows the stack top; slots below the materialized
+    window derive lazily from the live terms.  Batched ``f_*`` kernels
+    carry the whole group in one [lanes, 8] limb plane per bound; a
+    single-lane group uses the scalar ``s_*`` twins.  Purely
+    observational: known-bit density accumulates into DispatchStats and
+    the shadow dies (rather than resyncing) when a lane faults out
+    mid-segment."""
+
+    def __init__(self, states):
+        self.states = states
+        self.scalar = len(states) < 2
+        self.words: List = []
+        self.dead = False
+        self.known_bits = 0
+        self.total_bits = 0
+        if not self.scalar:
+            shape = (len(states),)
+            self._wm = W.width_mask(256, shape)
+            self._one = W.const_word(1, 256, shape)
+            self._zero = W.const_word(0, 256, shape)
+            bit0 = W.width_mask(1, shape)
+            self._unk_bool = (W.zeros_plane(shape), bit0,
+                              u256.bit_not(bit0), W.zeros_plane(shape))
+
+    # -- slot plumbing --------------------------------------------------
+
+    def _materialize(self, depth: int) -> None:
+        while len(self.words) <= depth:
+            d = len(self.words)
+            if self.scalar:
+                self.words.append(
+                    _term_sword(self.states[0].mstate.stack[-1 - d])
+                )
+            else:
+                sws = [_term_sword(s.mstate.stack[-1 - d])
+                       for s in self.states]
+                self.words.append(self._lift(sws))
+
+    @staticmethod
+    def _lift(sws):
+        return tuple(
+            np.stack([
+                np.asarray(u256.from_int(w[k], ()), dtype=np.uint32)
+                for w in sws
+            ])
+            for k in range(4)
+        )
+
+    def _operands(self, n: int):
+        self._materialize(n - 1)
+        taken, self.words = self.words[:n], self.words[n:]
+        return taken
+
+    def _note(self, word) -> None:
+        km = word[2]
+        if self.scalar:
+            self.known_bits += bin(km & _WM).count("1")
+            self.total_bits += 256
+        else:
+            self.known_bits += int(np.sum(W.popcount(km)))
+            self.total_bits += 256 * len(self.states)
+
+    def _push(self, word) -> None:
+        self.words.insert(0, word)
+        self._note(word)
+
+    def _bool_word(self, tri):
+        if self.scalar:
+            if tri > 0:
+                return W.s_const(1, _WM)
+            if tri < 0:
+                return W.s_const(0, _WM)
+            return (0, 1, W.FULL ^ 1, 0)  # unknown bool: bit 0 free
+        return W.select_word(
+            tri == 1, self._one,
+            W.select_word(tri == -1, self._zero, self._unk_bool),
+        )
+
+    def _zero_divisor_fold(self, word, b):
+        """EVM DIV/MOD push 0 when the divisor is 0 — fold that branch
+        into the SMT-LIB transfer result wherever it stays feasible."""
+        lo_b = b[0]
+        if self.scalar:
+            if lo_b == 0:
+                return W.s_join(word, W.s_const(0, _WM))
+            return word
+        maybe_zero = ~W.any_bit(lo_b)
+        joined = W.join(word, self._zero, self._wm)
+        return W.select_word(maybe_zero, joined, word)
+
+    # -- per-op update ---------------------------------------------------
+
+    def prepare(self, info: "_OpPlan") -> None:
+        """Materialize the op's operand slots from the live terms —
+        must run *before* the mutators, while the stacks are still
+        pre-op (DUPn pops n, SWAPn pops n+1, so ``info.pops`` is
+        exactly the operand depth for every supported op)."""
+        if self.dead or not info.pops:
+            return
+        if any(len(s.mstate.stack) < info.pops for s in self.states):
+            self.dead = True  # a lane is about to underflow out
+            return
+        try:
+            self._materialize(info.pops - 1)
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            log.debug("plane shadow materialize failed", exc_info=True)
+            self.dead = True
+
+    def step(self, info: "_OpPlan", survivors: int) -> None:
+        """Advance the shadow past one executed interior op.  Stacks of
+        the surviving lanes have already been mutated."""
+        if self.dead:
+            return
+        if survivors != len(self.states):
+            self.dead = True  # lane left mid-segment; shadow is stale
+            return
+        op = info.op
+        try:
+            self._transfer(op, info)
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            log.debug("plane shadow transfer failed on %s", op,
+                      exc_info=True)
+            self.dead = True
+
+    def _transfer(self, op: str, info: "_OpPlan") -> None:
+        sc = self.scalar
+        if op.startswith("PUSH"):
+            item = self.states[0].mstate.stack[-1]
+            if sc:
+                self._push(_term_sword(item))
+            else:
+                self._push(self._lift(
+                    [_term_sword(s.mstate.stack[-1]) for s in self.states]
+                ))
+            return
+        if op == "POP":
+            self._operands(1)
+            return
+        if op.startswith("DUP"):
+            n = int(op[3:])
+            self._materialize(n - 1)
+            self._push(self.words[n - 1])
+            return
+        if op.startswith("SWAP"):
+            n = int(op[4:])
+            self._materialize(n)
+            self.words[0], self.words[n] = self.words[n], self.words[0]
+            return
+        binops = {
+            "ADD": (W.s_add, W.f_add), "SUB": (W.s_sub, W.f_sub),
+            "MUL": (W.s_mul, W.f_mul), "DIV": (W.s_udiv, W.f_udiv),
+            "MOD": (W.s_urem, W.f_urem),
+        }
+        if op in binops:
+            a, b = self._operands(2)
+            s_fn, f_fn = binops[op]
+            word = (s_fn(a, b, 256, _WM)[0] if sc
+                    else f_fn(a, b, 256, self._wm)[0])
+            if op in ("DIV", "MOD"):
+                word = self._zero_divisor_fold(word, b)
+            self._push(word)
+            return
+        if op in ("AND", "OR", "XOR"):
+            a, b = self._operands(2)
+            fn = {"AND": (W.s_and, W.f_and), "OR": (W.s_or, W.f_or),
+                  "XOR": (W.s_xor, W.f_xor)}[op]
+            word = fn[0](a, b, _WM)[0] if sc else fn[1](a, b, self._wm)[0]
+            self._push(word)
+            return
+        if op == "NOT":
+            (a,) = self._operands(1)
+            word = (W.s_not(a, 256, _WM)[0] if sc
+                    else W.f_not(a, 256, self._wm)[0])
+            self._push(word)
+            return
+        if op in ("SHL", "SHR", "SAR"):
+            # EVM pops the shift amount first, then the value
+            amt, val = self._operands(2)
+            fn = {"SHL": (W.s_shl, W.f_shl),
+                  "SHR": (W.s_lshr, W.f_lshr),
+                  "SAR": (W.s_ashr, W.f_ashr)}[op]
+            word = (fn[0](val, amt, 256, _WM)[0] if sc
+                    else fn[1](val, amt, 256, self._wm)[0])
+            self._push(word)
+            return
+        if op in ("LT", "GT", "SLT", "SGT", "EQ", "ISZERO"):
+            if op == "ISZERO":
+                (a,) = self._operands(1)
+                b = W.s_const(0, _WM) if sc else self._zero
+            else:
+                a, b = self._operands(2)
+            if op in ("GT", "SGT"):
+                a, b = b, a
+            if op in ("LT", "GT"):
+                tri = W.s_p_ult(a, b) if sc else W.p_ult(a, b)
+            elif op in ("SLT", "SGT"):
+                tri = (W.s_p_slt(a, b, 256) if sc
+                       else W.p_slt(a, b, 256))
+            else:
+                tri = W.s_p_eq(a, b) if sc else W.p_eq(a, b)
+            self._push(self._bool_word(tri))
+            return
+        # generic supported op (EXP, BYTE, env reads, ...): pop the
+        # consumed slots, derive the pushed slot from the live terms
+        if info.pops:
+            self._operands(info.pops)
+        if info.pushes:
+            if sc:
+                self._push(_term_sword(self.states[0].mstate.stack[-1]))
+            else:
+                self._push(self._lift(
+                    [_term_sword(s.mstate.stack[-1]) for s in self.states]
+                ))
+
+    def flush(self) -> None:
+        dispatch_stats.plane_known_bits += self.known_bits
+        dispatch_stats.plane_total_bits += self.total_bits
+
+
+# ---------------------------------------------------------------------------
+# per-lane, per-op pipeline (execute_state's exact fault/hook ordering)
+# ---------------------------------------------------------------------------
+
+
+def _vm_exception_path(svm, lane, op_code: str, msg: str):
+    """execute_state's VmException arm, verbatim: transaction-end hooks
+    with a None return state, the VM-exception unwind, the final laser
+    post hook."""
+    for hook in svm._transaction_end_hooks:
+        hook(lane, lane.current_transaction, None, False)
+    new_states = svm.handle_vm_exception(lane, op_code, msg)
+    svm._execute_post_hook(op_code, new_states)
+    return new_states
+
+
+def _would_out_of_gas(lane, gas_min: int) -> bool:
+    """Preflight of StateTransition.check_gas_usage_limit with the gas
+    interval already advanced by this opcode's minimum — including the
+    decorator's concrete-gas-limit unwrap side effect, which the serial
+    path also persists on the shared transaction object."""
+    mstate = lane.mstate
+    prospective = mstate.min_gas_used + gas_min
+    if prospective > mstate.gas_limit:
+        return True
+    tx = lane.current_transaction
+    gas_limit = tx.gas_limit
+    if isinstance(gas_limit, BitVec):
+        if gas_limit.value is None:
+            return False
+        tx.gas_limit = gas_limit.value
+        gas_limit = gas_limit.value
+    return gas_limit is not None and prospective >= gas_limit
+
+
+def _step_lane(svm, lane, info: _OpPlan):
+    """Execute one supported opcode on one lane with the exact fault
+    ordering, hook traffic and successor shapes of
+    ``LaserEVM.execute_state``.  Returns ``None`` while the lane stays
+    in the segment, else the ``(op_code, successors)`` round record."""
+    op_code = info.op
+    mstate = lane.mstate
+
+    # 1. stack underflow — execute_state checks this before any hook
+    if len(mstate.stack) < info.pops:
+        msg = (
+            f"Stack Underflow Exception due to insufficient stack elements "
+            f"for the address {info.address}"
+        )
+        new_states = svm.handle_vm_exception(lane, op_code, msg)
+        svm._execute_post_hook(op_code, new_states)
+        return op_code, new_states
+
+    # 2. stack overflow — the mutator's append would raise it before
+    #    the decorator's gas accounting, on an unmutated-state copy;
+    #    with no copy we must fault before mutating
+    if (info.pushes
+            and len(mstate.stack) - info.pops + info.pushes > STACK_LIMIT):
+        return op_code, _vm_exception_path(
+            svm, lane, op_code,
+            f"Reached the EVM stack limit of {STACK_LIMIT}",
+        )
+
+    # 3. out of gas — the decorator raises it after the mutator ran on
+    #    the discarded copy; preflight it so the live lane stays clean.
+    #    Terminators skip the preflight: they run on a defensive copy
+    #    anyway, and accumulate_gas reads the opcode at the *post-jump*
+    #    pc, which this table lookup cannot know
+    if (not info.terminator and info.transition.enable_gas
+            and _would_out_of_gas(lane, BY_NAME[op_code].gas_min)):
+        return op_code, _vm_exception_path(svm, lane, op_code, "")
+
+    # 4. laser-level pre hook + state hooks
+    try:
+        svm._execute_pre_hook(op_code, lane)
+    except PluginSkipState:
+        svm._add_world_state(lane)
+        return None, []
+    except PluginSkipWorldState:
+        return None, []
+    for hook in svm._execute_state_hooks:
+        hook(lane)
+
+    # 5. instruction hooks around the raw mutator, plus the decorator's
+    #    gas/pc bookkeeping replayed from its own StateTransition —
+    #    terminators get the defensive copy the decorator would make
+    #    (JUMP pops before it can raise InvalidJumpDestination)
+    try:
+        for hook in svm.instr_pre_hook[op_code]:
+            hook(lane)
+        target = copy(lane) if info.terminator else lane
+        result = info.mutator(info.instr_obj, target)
+        for state in result:
+            info.transition.accumulate_gas(state)
+        if info.transition.increment_pc:
+            for state in result:
+                state.mstate.pc += 1
+        for hook in svm.instr_post_hook[op_code]:
+            for state in result:
+                hook(state)
+    except VmException as e:
+        return op_code, _vm_exception_path(svm, lane, op_code, str(e))
+
+    svm._execute_post_hook(op_code, result)
+    if not info.terminator and len(result) == 1 and result[0] is lane:
+        return None  # still in the segment
+    return op_code, result
+
+
+# ---------------------------------------------------------------------------
+# frontier grouping + segment scheduler
+# ---------------------------------------------------------------------------
+
+
+class _Group:
+    __slots__ = ("plan", "pc", "states")
+
+    def __init__(self, plan, pc):
+        self.plan = plan
+        self.pc = pc
+        self.states: List = []
+
+
+def _run_group(svm, group: _Group, rounds, max_ops: int) -> int:
+    """Execute one segment group in lockstep.  Appends one round record
+    per lane outcome to ``rounds`` and returns the number of (state,
+    opcode) interpreter steps executed."""
+    plan = group.plan
+    pc = group.pc
+    active = list(group.states)
+    shadow = (_PlaneShadow(active)
+              if env_flag("MYTHRIL_TPU_SEG_PLANES", True) else None)
+    stepped = 0
+    last_op: Optional[str] = None
+    for _ in range(max_ops):
+        info = plan.info[pc] if 0 <= pc < len(plan.info) else None
+        if info is None:
+            break  # NEEDS_HOST boundary: hand the lanes back below
+        if shadow is not None and not info.terminator:
+            shadow.prepare(info)
+        survivors = []
+        for lane in active:
+            try:
+                outcome = _step_lane(svm, lane, info)
+            except NotImplementedError:
+                # serial _exec_round drops the lane with no round
+                # record; match it
+                log.debug("Encountered unimplemented instruction")
+                continue
+            if outcome is None:
+                survivors.append(lane)
+            else:
+                rounds.append((lane, outcome[0], outcome[1]))
+        stepped += len(active)
+        last_op = info.op
+        if shadow is not None and not info.terminator:
+            shadow.step(info, len(survivors))
+        active = survivors
+        if info.terminator or not active:
+            active = [] if info.terminator else active
+            break
+        pc += 1
+    # lanes still live at a boundary (unsupported opcode or the op cap)
+    # return to the scheduler as their own successor: identical machine
+    # state, serial interpreter next round
+    for lane in active:
+        rounds.append((lane, last_op, [lane]))
+    if shadow is not None:
+        shadow.flush()
+    return stepped
+
+
+def run_lockstep(svm, batch, rounds, create: bool, track_gas: bool):
+    """Partition one scheduler round's batch into lockstep segment
+    groups and a serial remainder, execute the groups, and return
+    ``(serial_batch, timed_out)`` for ``LaserEVM._exec_round`` to
+    finish.  Declines (whole batch stays serial) behind the kill
+    switch and for create/track_gas/statespace rounds."""
+    if (not batch or create or track_gas or svm.requires_statespace
+            or not lockstep_enabled()):
+        return batch, None
+
+    serial: List = []
+    groups: Dict[Tuple[int, int], _Group] = {}
+    order: List[_Group] = []
+    for state in batch:
+        plan = plan_for(state.environment.code)
+        pc = state.mstate.pc
+        if plan is None or not plan.supported_at(pc):
+            serial.append(state)
+            continue
+        key = (id(plan), pc)
+        group = groups.get(key)
+        if group is None:
+            group = groups[key] = _Group(plan, pc)
+            order.append(group)
+        group.states.append(state)
+    if not order:
+        return serial, None
+
+    from mythril_tpu import autopilot
+    from mythril_tpu.autopilot.features import segment_features
+    from mythril_tpu.observability.ledger import get_ledger
+
+    min_lanes = env_int("MYTHRIL_TPU_SEG_MIN_LANES", 1, floor=1)
+    max_ops = env_int("MYTHRIL_TPU_SEG_MAX_OPS", _SEG_MAX_OPS_DEFAULT,
+                      floor=1)
+    deadline = svm.execution_timeout
+    ledger = get_ledger()
+
+    for index, group in enumerate(order):
+        if (deadline
+                and svm.time + timedelta(seconds=deadline)
+                <= datetime.now()):
+            # _exec_round's timeout contract: the state at the cursor
+            # unwinds the run, everything not yet executed returns to
+            # the work list
+            log.debug("Hit execution timeout inside lockstep round.")
+            leftover = group.states[1:]
+            for later in order[index + 1:]:
+                leftover += later.states
+            svm.work_list += leftover + serial
+            return [], group.states[0]
+        if len(group.states) < min_lanes:
+            serial.extend(group.states)
+            continue
+        features = segment_features(
+            len(group.states),
+            group.plan.run_length(group.pc, max_ops),
+            entry_coherence(group.states),
+        )
+        if not autopilot.route_segment(features):
+            serial.extend(group.states)
+            continue
+        ledger.count_transition("lockstep", len(group.states))
+        began = time.monotonic()
+        with obs.span("svm.segment", cat="svm",
+                      sink=(dispatch_stats, "segment_s"),
+                      lanes=len(group.states), pc=group.pc):
+            stepped = _run_group(svm, group, rounds, max_ops)
+        dispatch_stats.states_stepped += stepped
+        autopilot.note_segment(features, len(group.states),
+                               time.monotonic() - began)
+    return serial, None

@@ -81,7 +81,7 @@ class MythrilAnalyzer:
         shard_corpus: bool = True,
         batched_solving: bool = True,
         device_force_dispatch: bool = False,
-        lockstep_dispatch: bool = False,
+        lockstep_dispatch: bool = True,
         proof_log: bool = False,
         async_dispatch: bool = True,
         checkpoint_dir: Optional[str] = None,

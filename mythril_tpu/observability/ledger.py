@@ -57,19 +57,27 @@ from typing import Dict, List, Optional
 #: is ``lanes_total == sum(decided[tier] for tier in TERMINAL_TIERS)``
 TERMINAL_TIERS = ("structural", "probe", "word", "frontier", "sweep",
                   "tail")
-#: non-terminal lifecycle states
+#: non-terminal lifecycle states; ``lockstep`` counts interpreter lanes
+#: stepped through batched segments (symbolic_lockstep.py) — recorded
+#: via count_transition only, so the conservation invariant over solver
+#: lanes is untouched (a segment lane is not a solver query)
 TRANSITIONS = ("opened", "deferred", "dispatched", "quarantined",
-               "opaque", "dropped")
+               "opaque", "dropped", "lockstep")
 #: tier-transition legality (validated by scripts/trace_lint.py):
 #: state -> the set of states a lane may move to next
 LEGAL_NEXT = {
-    "opened": {"deferred", "dispatched", "opaque", "dropped",
+    "opened": {"deferred", "dispatched", "opaque", "dropped", "lockstep",
                *TERMINAL_TIERS},
     "deferred": {"tail"},
     "dispatched": {"frontier", "sweep", "tail", "quarantined"},
     "quarantined": {"tail"},
     "opaque": {"tail"},
     "dropped": {"tail"},
+    # a segment lane whose successors reach the solver funnel re-enters
+    # as a fresh "opened" lane; within one path a lockstep step may only
+    # hand off to the funnel's entry states
+    "lockstep": {"deferred", "dispatched", "opaque", "dropped",
+                 *TERMINAL_TIERS},
 }
 VERDICTS = ("sat", "unsat", "undecided")
 

@@ -60,8 +60,10 @@ PHASE_PREFIXES = (
     ("cdcl.solve", "tail"),
     ("word.", "word"),
     ("frontier.round", "frontier"),
+    ("svm.segment", "lockstep"),
 )
-PHASE_KEYS = ("cone", "upload", "sweep", "tail", "word", "frontier")
+PHASE_KEYS = ("cone", "upload", "sweep", "tail", "word", "frontier",
+              "lockstep")
 
 
 def _kill_switched() -> bool:

@@ -224,6 +224,19 @@ class DispatchStats:
         # delta uploads on the next dispatch)
         self.frontier_steps = 0
         self.learned_clauses = 0
+        # symbolic lockstep tier (laser/ethereum/symbolic_lockstep.py):
+        # interpreter (state, opcode) steps executed inside batched
+        # segments, and the wall-clock of those segments (the
+        # `svm.segment` span's sink) — their ratio is the states_per_s
+        # headline
+        self.states_stepped = 0
+        self.segment_s = 0.0
+        # limb-plane carriage inside those segments: known bits over
+        # total bits across every shadowed stack push — the density
+        # number that says how much of the symbolic traffic the
+        # word_prop transfers could pin
+        self.plane_known_bits = 0
+        self.plane_total_bits = 0
 
     def as_dict(self):
         from mythril_tpu.parallel.fleet import fleet_stats

@@ -274,6 +274,64 @@ def f_mul(a: Word, b: Word, width: int, wm, xp=np):
     return refine(lo, hi, km, kv, wm, xp)
 
 
+def f_udiv(a: Word, b: Word, width: int, wm, xp=np):
+    """SMT-LIB bvudiv: floor(a / b) with the total definition
+    a / 0 = 2^width - 1 (the EVM's DIV-by-zero-is-zero lives in the
+    ``If`` wrapper instructions.py builds around the raw node).
+
+    Division-free — ops/u256.udivmod is jax-only, and a transfer only
+    needs bounds: b >= 2^(bl(lo_b)-1) gives a/b <= hi_a >> (bl(lo_b)-1)
+    and b < 2^bl(hi_b) gives a/b >= lo_a >> bl(hi_b).  A singleton
+    power-of-two divisor makes the op exactly a right shift, known
+    bits included."""
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, _km_b, _kv_b = b
+    batch = lo_a.shape[:-1]
+    bz = ~any_bit(hi_b, xp)  # divisor identically zero
+    nz = any_bit(lo_b, xp)  # divisor never zero
+    amt = xp.maximum(bit_length(lo_b, xp) - 1, 0)
+    lo = u256.lshr(lo_a, bit_length(hi_b, xp), xp)
+    lo = xp.where(bz[..., None], wm, lo)
+    hi = xp.where(nz[..., None], u256.lshr(hi_a, amt, xp), wm)
+    pow2 = nz & u256.eq(lo_b, hi_b, xp) & (popcount(lo_b, xp) == 1)
+    vacated = u256.bit_not(u256.lshr(ones_plane(batch, xp), amt, xp), xp)
+    km_s = u256.lshr(km_a, amt, xp) | vacated
+    km = (xp.where(pow2[..., None], km_s, xp.uint32(0))
+          | u256.bit_not(wm, xp))
+    kv = (xp.where(pow2[..., None], u256.lshr(kv_a, amt, xp),
+                   xp.uint32(0)) & km & wm)
+    lo = xp.where(pow2[..., None], u256.lshr(lo_a, amt, xp), lo)
+    return refine(lo, hi, km, kv, wm, xp)
+
+
+def f_urem(a: Word, b: Word, width: int, wm, xp=np):
+    """SMT-LIB bvurem: a mod b with a mod 0 = a.  Division-free like
+    :func:`f_udiv`: the result is <= a always and < b once the divisor
+    is provably nonzero; a singleton power-of-two divisor is exactly an
+    and-mask, and hi_a < lo_b (or b == 0) pins the identity result."""
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, _km_b, _kv_b = b
+    batch = lo_a.shape[:-1]
+    one = width_mask(1, batch, xp)
+    nz = any_bit(lo_b, xp)  # divisor never zero
+    bound = umin(hi_a, u256.sub(hi_b, one, xp), xp)
+    hi = xp.where(nz[..., None], bound, hi_a)
+    lo = zeros_plane(batch, xp)
+    pow2 = nz & u256.eq(lo_b, hi_b, xp) & (popcount(lo_b, xp) == 1)
+    mask = u256.sub(lo_b, one, xp)
+    km = (xp.where(pow2[..., None], km_a | u256.bit_not(mask, xp),
+                   xp.uint32(0)) | u256.bit_not(wm, xp))
+    kv = xp.where(pow2[..., None], kv_a & mask, xp.uint32(0)) & km & wm
+    hi = xp.where(pow2[..., None], umin(hi_a, mask, xp), hi)
+    ident = ~any_bit(hi_b, xp) | (nz & u256.ult(hi_a, lo_b, xp))
+    m = ident[..., None]
+    lo = xp.where(m, lo_a, lo)
+    hi = xp.where(m, hi_a, hi)
+    km = xp.where(m, km_a, km)
+    kv = xp.where(m, kv_a, kv)
+    return refine(lo, hi, km, kv, wm, xp)
+
+
 def f_and(a: Word, b: Word, wm, xp=np):
     lo_a, hi_a, km_a, kv_a = a
     lo_b, hi_b, km_b, kv_b = b
@@ -618,6 +676,43 @@ def s_mul(a, b, width, wm):
     else:
         lo, hi = 0, wm
     return s_refine(lo, hi, km, kv, wm)
+
+
+def s_udiv(a, b, width, wm):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, _km_b, _kv_b = b
+    km, kv = FULL ^ wm, 0
+    if hi_b == 0:  # b == 0: SMT-LIB total definition, a / 0 = wm
+        lo = hi = wm
+    else:
+        lo = lo_a >> hi_b.bit_length()  # b < 2^bl(hi_b)
+        if lo_b == 0:  # a zero divisor stays feasible: wm reachable
+            hi = wm
+        else:
+            amt = lo_b.bit_length() - 1  # b >= 2^amt
+            hi = hi_a >> amt
+            if lo_b == hi_b and lo_b & (lo_b - 1) == 0:
+                vacated = FULL ^ (FULL >> amt)
+                km = (km_a >> amt) | vacated | (FULL ^ wm)
+                kv = (kv_a >> amt) & km & wm
+                lo = lo_a >> amt
+    return s_refine(lo, hi, km, kv, wm)
+
+
+def s_urem(a, b, width, wm):
+    lo_a, hi_a, km_a, kv_a = a
+    lo_b, hi_b, _km_b, _kv_b = b
+    if hi_b == 0 or (lo_b and hi_a < lo_b):
+        # b == 0 (SMT-LIB: a mod 0 = a) or a provably < b: identity
+        return s_refine(lo_a, hi_a, km_a, kv_a, wm)
+    hi = min(hi_a, hi_b - 1) if lo_b else hi_a
+    km, kv = FULL ^ wm, 0
+    if lo_b and lo_b == hi_b and lo_b & (lo_b - 1) == 0:
+        mask = lo_b - 1
+        km = km_a | (FULL ^ mask)
+        kv = kv_a & mask & km
+        hi = min(hi_a, mask)
+    return s_refine(0, hi, km, kv, wm)
 
 
 def s_and(a, b, wm):

@@ -68,8 +68,9 @@ WORD_MEMO_CAP = 8192
 PROGRAM_CACHE_CAP = 64
 
 _BV_OPS = frozenset((
-    "const", "var", "add", "sub", "mul", "and", "or", "xor", "not",
-    "shl", "lshr", "ashr", "concat", "extract", "zext", "sext", "ite",
+    "const", "var", "add", "sub", "mul", "udiv", "urem", "and", "or",
+    "xor", "not", "shl", "lshr", "ashr", "concat", "extract", "zext",
+    "sext", "ite",
 ))
 _BOOL_OPS = frozenset((
     "bconst", "bvar", "band", "bor", "bnot", "bxor",
@@ -681,6 +682,10 @@ class WordTier:
             return W.s_sub(args[0], args[1], node.width, wm)
         if op == "mul":
             return W.s_mul(args[0], args[1], node.width, wm)
+        if op == "udiv":
+            return W.s_udiv(args[0], args[1], node.width, wm)
+        if op == "urem":
+            return W.s_urem(args[0], args[1], node.width, wm)
         if op == "and":
             return W.s_and(args[0], args[1], wm)
         if op == "or":
@@ -935,6 +940,10 @@ class WordTier:
             return W.f_sub(args[0], args[1], node.width, wm, xp)
         if op == "mul":
             return W.f_mul(args[0], args[1], node.width, wm, xp)
+        if op == "udiv":
+            return W.f_udiv(args[0], args[1], node.width, wm, xp)
+        if op == "urem":
+            return W.f_urem(args[0], args[1], node.width, wm, xp)
         if op == "and":
             return W.f_and(args[0], args[1], wm, xp)
         if op == "or":

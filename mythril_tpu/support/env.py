@@ -56,6 +56,9 @@ KNOWN_SPECS: Dict[str, Tuple[str, Optional[float], Optional[float]]] = {
     "MYTHRIL_TPU_AUTOPILOT_MIN_SAMPLES": ("int", 1, None),
     "MYTHRIL_TPU_AUTOPILOT_LADDER": ("int", 1, None),
     "MYTHRIL_TPU_AUTOPILOT_EVAL_EVERY": ("int", 1, None),
+    "MYTHRIL_TPU_SEG_MIN_LANES": ("int", 1, None),
+    "MYTHRIL_TPU_SEG_MAX_OPS": ("int", 1, None),
+    "MYTHRIL_TPU_SEG_CEIL_MS": ("float", 0.0, None),
 }
 
 _registered: Dict[str, Tuple[str, Optional[float], Optional[float]]] = {}

@@ -77,13 +77,15 @@ class Args(object, metaclass=Singleton):
         # concrete-prefix dispatcher pre-split (SoA-validated): replace
         # each transaction seed with per-selector states at the
         # function entries (laser/ethereum/lockstep_dispatch.py).
-        # Measured on batchtoken -t 2 (3 alternating reps, pinned CPU):
-        # findings identical, median wall 47.2 s off vs 51.8 s on — the
-        # dispatcher prefix is too cheap for the skip to pay and the
-        # substituted selector constraints probe slightly worse, so the
-        # pre-split stays opt-in until the SoA stepper displaces more
-        # than the prefix.
-        self.lockstep_dispatch = False
+        # Default-on since the symbolic lockstep tier landed: the
+        # pre-split is what hands that tier same-pc sibling frontiers
+        # (one lane batch per selector) instead of one mega-state that
+        # only forks apart inside the dispatcher prefix.  Non-canonical
+        # dispatchers (fallback-only, hand-rolled dispatch) auto-
+        # decline during the static shape match and execute the exact
+        # serial prefix; --no-lockstep-dispatch pins that path for
+        # every contract.
+        self.lockstep_dispatch = True
 
 
 args = Args()

@@ -65,9 +65,14 @@ GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
 #: must not collapse, and the fleet's sharding win (--workers 2 vs 1
 #: on the shardable workload, parallel/fleet.py) must not erode —
 #: coordinator overhead, gossip cost, or lease churn creeping into the
-#: hot path shows up here first
+#: hot path shows up here first.
+#: states_per_s gates the symbolic lockstep tier's interpreter-
+#: attributed throughput ((state, opcode) steps per second inside
+#: batched segments): per-opcode overhead creeping into the segment
+#: loop, or the autopilot declining shapes it used to run, shows up
+#: here before t3_wall_s moves
 GATED_HIGHER_BETTER = ("serve_cpm", "microbench_device_vs_host",
-                       "fleet_speedup")
+                       "fleet_speedup", "states_per_s")
 #: floor below which a baseline is noise and ratios are meaningless
 MIN_BASE = 0.05
 

@@ -107,6 +107,19 @@ def main() -> None:
         frontier_s / row["total_wall_s"], 4
     ) if row["total_wall_s"] else 0.0
     row["frontier_learned_clauses"] = row.get("learned_clauses", 0)
+    # lockstep-tier share: wall spent executing batched straight-line
+    # segments over sibling states (svm.segment spans) — the row
+    # already carries states_stepped / segment_s / plane_*_bits via
+    # DispatchStats, so steps-per-second here is cross-checkable
+    # against the bench headline's states_per_s
+    lockstep_s = sum(
+        seconds for name, seconds in totals.items()
+        if name.startswith("svm.segment")
+    )
+    row["span_lockstep_s"] = round(lockstep_s, 3)
+    row["lockstep_span_share"] = round(
+        lockstep_s / row["total_wall_s"], 4
+    ) if row["total_wall_s"] else 0.0
     # fleet-worker shares (populated when the run shards via
     # MYTHRIL_TPU_FLEET_WORKERS / --workers: each lease's wall lands
     # under fleet.worker:<id> via Tracer.add_external_total, so the

@@ -1,0 +1,488 @@
+"""Symbolic lockstep tier tests (laser/ethereum/symbolic_lockstep.py).
+
+The tier's contract is *indistinguishability*: executing a straight-line
+segment in lockstep over sibling states must leave every lane with
+exactly the machine state, successor shape, hook traffic and fault
+behavior the per-state interpreter would have produced.  The anchor
+here is a per-opcode differential fuzz — every supported opcode, 500+
+randomized symbolic stacks, zero divergence against ``execute_state`` —
+plus targeted pins for the seams: JUMPI fork splits, NEEDS_HOST
+mid-segment bailouts, stack/gas fault ordering, mid-block (checkpoint
+resume) entry, the kill switch, hook parity on the chaos-tree
+workload, and ledger conservation with the new ``lockstep`` transition.
+"""
+
+import random
+from copy import copy
+from datetime import datetime
+
+import pytest
+
+from mythril_tpu.disassembler.disassembly import Disassembly
+from mythril_tpu.laser.ethereum import symbolic_lockstep as sl
+from mythril_tpu.laser.ethereum.state.calldata import ConcreteCalldata
+from mythril_tpu.laser.ethereum.state.environment import Environment
+from mythril_tpu.laser.ethereum.state.global_state import GlobalState
+from mythril_tpu.laser.ethereum.state.machine_state import MachineState
+from mythril_tpu.laser.ethereum.state.world_state import WorldState
+from mythril_tpu.laser.ethereum.svm import LaserEVM
+from mythril_tpu.laser.ethereum.transaction.transaction_models import (
+    MessageCallTransaction,
+)
+from mythril_tpu.smt import symbol_factory
+from mythril_tpu.support.opcodes import BY_NAME
+
+pytestmark = pytest.mark.lockstep
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+
+def make_state(code_hex: str, stack=None, pc: int = 0,
+               gas_limit: int = 8_000_000) -> GlobalState:
+    world_state = WorldState()
+    account = world_state.create_account(
+        balance=10, address=0x0A, concrete_storage=True,
+        code=Disassembly(code_hex),
+    )
+    environment = Environment(
+        account,
+        sender=symbol_factory.BitVecVal(0xB0B, 256),
+        calldata=ConcreteCalldata("1", []),
+        gasprice=symbol_factory.BitVecVal(1, 256),
+        callvalue=symbol_factory.BitVecVal(0, 256),
+        origin=symbol_factory.BitVecVal(0xB0B, 256),
+    )
+    state = GlobalState(world_state, environment, None,
+                        MachineState(gas_limit))
+    state.transaction_stack.append(
+        (
+            MessageCallTransaction(
+                world_state=world_state,
+                callee_account=account,
+                caller=environment.sender,
+                gas_limit=8_000_000,
+            ),
+            None,
+        )
+    )
+    state.mstate.pc = pc
+    for item in stack or []:
+        state.mstate.stack.append(
+            symbol_factory.BitVecVal(item, 256)
+            if isinstance(item, int) else item
+        )
+    return state
+
+
+def make_svm() -> LaserEVM:
+    svm = LaserEVM(requires_statespace=False, execution_timeout=600)
+    svm.time = datetime.now()
+    return svm
+
+
+def fingerprint(state: GlobalState):
+    """Everything an opcode step can legally change, stringified (the
+    two paths run the same mutator functions, so matching term trees
+    stringify identically)."""
+    return (
+        state.mstate.pc,
+        state.mstate.depth,
+        state.mstate.min_gas_used,
+        state.mstate.max_gas_used,
+        tuple(str(x) for x in state.mstate.stack),
+        tuple(str(c) for c in state.world_state.constraints),
+    )
+
+
+def lockstep_once(svm, states, max_ops=None, monkeypatch=None):
+    """Run one scheduler round's lockstep pass over ``states`` and
+    return its round records."""
+    if max_ops is not None:
+        monkeypatch.setenv("MYTHRIL_TPU_SEG_MAX_OPS", str(max_ops))
+    rounds = []
+    serial, timed_out = sl.run_lockstep(svm, states, rounds, False, False)
+    assert timed_out is None
+    return serial, rounds
+
+
+def serial_once(svm, state):
+    return svm.execute_state(state)
+
+
+def differential_step(code_hex, stack, monkeypatch, pc=0,
+                      gas_limit=8_000_000):
+    """Execute ONE opcode through both paths on identical twins and
+    assert successor-for-successor equality."""
+    base = make_state(code_hex, stack, pc=pc, gas_limit=gas_limit)
+    twin = copy(base)
+    twin.mstate.pc = base.mstate.pc
+
+    serial_new, serial_op = serial_once(make_svm(), base)
+
+    svm = make_svm()
+    serial_left, rounds = lockstep_once(
+        svm, [twin], max_ops=1, monkeypatch=monkeypatch
+    )
+    assert serial_left == [], "supported op must group, not fall through"
+    assert len(rounds) == 1
+    lane, lock_op, lock_new = rounds[0]
+    assert lock_op == serial_op
+    got = sorted(fingerprint(s) for s in lock_new)
+    want = sorted(fingerprint(s) for s in serial_new)
+    assert got == want, (
+        f"divergence on {lock_op}: lockstep={got} serial={want}"
+    )
+    return lock_op
+
+
+# ---------------------------------------------------------------------------
+# per-opcode differential fuzz (the tier's acceptance anchor)
+# ---------------------------------------------------------------------------
+
+FUZZ_OPS = [
+    "POP", "ADD", "SUB", "MUL", "DIV", "SDIV", "MOD", "SMOD",
+    "ADDMOD", "MULMOD", "EXP", "SIGNEXTEND",
+    "LT", "GT", "SLT", "SGT", "EQ", "ISZERO",
+    "AND", "OR", "XOR", "NOT", "BYTE", "SHL", "SHR", "SAR",
+    "JUMPDEST", "PC", "MSIZE", "GAS",
+    "ADDRESS", "ORIGIN", "CALLER", "CALLVALUE", "GASPRICE",
+    "CHAINID", "CALLDATASIZE", "CALLDATALOAD",
+    "PUSH1", "PUSH2", "PUSH32",
+    "DUP1", "DUP2", "DUP16",
+    "SWAP1", "SWAP2", "SWAP16",
+]
+
+_INTERESTING = (0, 1, 2, 3, 31, 32, 255, 256, 0xFFFF, 2**128,
+                2**255, 2**256 - 1, 0x6D2B)
+
+
+def _random_stack(rng, depth):
+    stack = []
+    for i in range(depth):
+        kind = rng.random()
+        if kind < 0.45:
+            stack.append(rng.choice(_INTERESTING))
+        elif kind < 0.8:
+            stack.append(
+                symbol_factory.BitVecSym(f"s{i}_{rng.randrange(9999)}", 256)
+            )
+        else:
+            stack.append(
+                symbol_factory.BitVecSym(f"t{i}_{rng.randrange(9999)}", 256)
+                + symbol_factory.BitVecVal(rng.choice(_INTERESTING), 256)
+            )
+    return stack
+
+
+def _code_for(op, rng):
+    info = BY_NAME[op]
+    code = f"{info.byte:02x}"
+    if op.startswith("PUSH"):
+        n = int(op[4:])
+        code += "".join(f"{rng.randrange(256):02x}" for _ in range(n))
+    return code
+
+
+def test_differential_fuzz_per_opcode(monkeypatch):
+    """>=500 randomized symbolic stacks across every supported interior
+    opcode: zero divergence from the per-state interpreter, including
+    the stack-underflow arm (short stacks are drawn on purpose)."""
+    rng = random.Random(0xC0FFEE)
+    trials_per_op = 11  # 47 ops x 11 = 517 stacks
+    total = 0
+    for op in FUZZ_OPS:
+        pops = BY_NAME[op].pops
+        for trial in range(trials_per_op):
+            if trial == 0:
+                depth = max(pops - 1, 0)  # underflow arm, deterministic
+            else:
+                depth = rng.randrange(0, max(pops + 3, 4))
+            differential_step(
+                _code_for(op, rng), _random_stack(rng, depth), monkeypatch
+            )
+            total += 1
+    assert total >= 500
+
+
+def test_differential_fuzz_jumps(monkeypatch):
+    """JUMP/JUMPI terminators: valid dests, invalid dests, symbolic
+    dests and symbolic conditions all shape successors identically."""
+    rng = random.Random(0x1A2B)
+    # code: JUMP/JUMPI at 0, then a run of JUMPDESTs (addresses 1..4)
+    for op, extra in (("JUMP", 1), ("JUMPI", 2)):
+        code = f"{BY_NAME[op].byte:02x}" + "5b" * 4
+        for trial in range(12):
+            dest = rng.choice(
+                [1, 2, 3, 4, 0, 9, 2**200,
+                 symbol_factory.BitVecSym(f"d{trial}", 256)]
+            )
+            cond = rng.choice(
+                [0, 1, symbol_factory.BitVecSym(f"c{trial}", 256)]
+            )
+            stack = [cond, dest] if op == "JUMPI" else [dest]
+            if trial == 0:
+                stack = stack[:extra - 1]  # underflow arm
+            differential_step(code, stack, monkeypatch)
+
+
+# ---------------------------------------------------------------------------
+# fault-ordering pins
+# ---------------------------------------------------------------------------
+
+
+def test_stack_overflow_parity(monkeypatch):
+    """A full 1024-deep stack faults PUSH/DUP identically through both
+    paths (lockstep prechecks BEFORE mutating; serial faults on the
+    decorator's throwaway copy)."""
+    for op_code in ("PUSH1", "DUP1"):
+        code = _code_for(op_code, random.Random(1))
+        stack = [7] * 1024
+        differential_step(code, stack, monkeypatch)
+
+
+def test_out_of_gas_parity(monkeypatch):
+    """An exhausted gas interval faults identically (lockstep's
+    preflight replays check_gas_usage_limit before the mutator)."""
+    for gas_limit in (0, 2, 3):
+        differential_step("01", [3, 4], monkeypatch, gas_limit=gas_limit)
+
+
+# ---------------------------------------------------------------------------
+# segment seams
+# ---------------------------------------------------------------------------
+
+# PUSH1 1; PUSH1 2; ADD; PUSH1 0; SSTORE — four interior ops, then a
+# NEEDS_HOST boundary the segment must stop in front of
+_SEG_CODE = "6001600201600055"
+
+
+def test_needs_host_mid_segment_bailout(monkeypatch):
+    """The segment halts AT the unsupported opcode with identical
+    machine state, and the serial interpreter finishes the opcode from
+    there exactly as an all-serial run would."""
+    base = make_state(_SEG_CODE)
+    twin = copy(base)
+
+    # all-serial reference: the four interior steps before SSTORE
+    svm_s = make_svm()
+    serial = base
+    for _ in range(4):
+        (serial,), _op = serial_once(svm_s, serial)
+    ref_mid = fingerprint(serial)
+
+    svm_l = make_svm()
+    left, rounds = lockstep_once(svm_l, [twin], monkeypatch=monkeypatch)
+    assert left == []
+    assert len(rounds) == 1
+    lane, last_op, succ = rounds[0]
+    assert last_op == "PUSH1"        # last interior op actually run
+    assert succ == [lane]            # lane returns as its own successor
+    assert fingerprint(lane) == ref_mid
+    assert lane.mstate.pc == 4       # parked ON the SSTORE boundary
+    assert sl.plan_for(lane.environment.code).info[4] is None
+
+
+def test_mid_block_entry_resume(monkeypatch):
+    """A state entering mid-basic-block (a checkpoint-resumed or
+    handed-off frontier) locksteps from its pc with full parity."""
+    sym = symbol_factory.BitVecSym("resume", 256)
+    differential_step(_SEG_CODE, [sym], monkeypatch, pc=1)
+    differential_step(_SEG_CODE, [3, 4], monkeypatch, pc=2)
+
+
+def test_jumpi_fork_mask_split(monkeypatch):
+    """Symbolic JUMPI in-segment: each lane splits into both branches
+    with the same path constraints the serial interpreter attaches, and
+    every successor flows back through the round records (whose union
+    _exec_round hands to one prune_infeasible pass)."""
+    # PUSH1 4; JUMPI; STOP; JUMPDEST; STOP — layout from the serial
+    # interpreter tests
+    code = "600457005b00"
+    conds = [symbol_factory.BitVecSym(f"fork{i}", 256) for i in range(2)]
+
+    lanes = [make_state(code, [c]) for c in conds]
+    twins = [copy(s) for s in lanes]
+
+    svm_l = make_svm()
+    left, rounds = lockstep_once(svm_l, lanes, monkeypatch=monkeypatch)
+    assert left == []
+    assert len(rounds) == 2  # one record per lane, each a JUMPI fork
+
+    svm_s = make_svm()
+    for (lane, op_code, succ), twin in zip(rounds, twins):
+        assert op_code == "JUMPI"
+        (mid,), _ = serial_once(svm_s, twin)       # PUSH1 4
+        serial_succ, _ = serial_once(svm_s, mid)    # JUMPI fork
+        assert sorted(fingerprint(s) for s in succ) == sorted(
+            fingerprint(s) for s in serial_succ
+        )
+        assert len(succ) == 2  # both branches of the symbolic cond
+
+
+def test_sibling_group_batches_and_matches_serial(monkeypatch):
+    """Three sibling lanes at one pc run as one lane batch (the batched
+    f_* plane path) and every lane's machine state matches its serial
+    twin after the whole straight-line run."""
+    code = "6001600201600055"  # 4 interior ops, then SSTORE boundary
+    stacks = (
+        [symbol_factory.BitVecSym("a", 256)],
+        [symbol_factory.BitVecSym("b", 256), 5],
+        [0xFFFF],
+    )
+    lanes = [make_state(code, list(s)) for s in stacks]
+    twins = [copy(s) for s in lanes]
+
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+    stepped0 = dispatch_stats.states_stepped
+    svm_l = make_svm()
+    left, rounds = lockstep_once(svm_l, lanes, monkeypatch=monkeypatch)
+    assert left == []
+    assert dispatch_stats.states_stepped - stepped0 == 12  # 3 lanes x 4 ops
+    assert len(rounds) == 3
+
+    svm_s = make_svm()
+    for (lane, _op, succ), twin in zip(rounds, twins):
+        assert succ == [lane]
+        serial = twin
+        for _ in range(4):
+            (serial,), _ = serial_once(svm_s, serial)
+        assert fingerprint(lane) == fingerprint(serial)
+
+
+# ---------------------------------------------------------------------------
+# kill switch / gates
+# ---------------------------------------------------------------------------
+
+
+def test_kill_switch_leaves_batch_untouched(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_SYM_LOCKSTEP", "0")
+    lanes = [make_state("6001600201")]
+    rounds = []
+    serial, timed_out = sl.run_lockstep(
+        make_svm(), lanes, rounds, False, False
+    )
+    assert serial == lanes and rounds == [] and timed_out is None
+
+
+def test_statespace_and_gas_rounds_stay_serial():
+    lanes = [make_state("6001600201")]
+    svm = make_svm()
+    svm.requires_statespace = True
+    serial, _ = sl.run_lockstep(svm, lanes, [], False, False)
+    assert serial == lanes
+    svm.requires_statespace = False
+    serial, _ = sl.run_lockstep(svm, lanes, [], False, True)  # track_gas
+    assert serial == lanes
+    serial, _ = sl.run_lockstep(svm, lanes, [], True, False)  # create
+    assert serial == lanes
+
+
+def test_unsupported_entry_pc_falls_through():
+    """A lane parked ON a NEEDS_HOST opcode goes straight to the serial
+    remainder — no empty segment, no round record."""
+    lanes = [make_state(_SEG_CODE, [1, 0], pc=4)]  # ON the SSTORE
+    rounds = []
+    serial, _ = sl.run_lockstep(make_svm(), lanes, rounds, False, False)
+    assert serial == lanes and rounds == []
+
+
+# ---------------------------------------------------------------------------
+# full-pipeline pins: findings parity, hook parity, ledger conservation
+# ---------------------------------------------------------------------------
+
+
+def _chaos_analyze(name):
+    import bench
+
+    return bench._analyze_one(
+        name, bench.chaos_tree_contract(), 2,
+        execution_timeout=120, max_depth=128,
+    )
+
+
+def test_full_pipeline_kill_switch_findings_parity(monkeypatch):
+    """Chaos-tree workload end to end: identical findings with the tier
+    on vs pinned off, and the tier demonstrably engaged when on."""
+    import logging
+
+    logging.getLogger("mythril_tpu").setLevel(logging.CRITICAL)
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+
+    monkeypatch.setenv("MYTHRIL_TPU_SYM_LOCKSTEP", "1")
+    found_on, row_on = _chaos_analyze("lockstep_on")
+    assert row_on.get("states_stepped", 0) > 0, "tier never engaged"
+    monkeypatch.setenv("MYTHRIL_TPU_SYM_LOCKSTEP", "0")
+    found_off, row_off = _chaos_analyze("lockstep_off")
+    assert row_off.get("states_stepped", 0) == 0
+    assert found_on == found_off == {"106"}, (found_on, found_off)
+
+
+def test_hook_parity_on_chaos_tree(monkeypatch):
+    """execute_state hooks, laser pre/post hooks and instruction hooks
+    fire with the same call counts and (pc, opcode) arguments per lane
+    in batched segments as on the serial path (detection modules,
+    instruction_profiler and dependency_pruner all ride these)."""
+    import bench
+
+    code = bench.chaos_tree_contract()
+    hooked_ops = ("AND", "MUL", "JUMPI", "JUMPDEST", "PUSH2")
+
+    def run(lockstep):
+        monkeypatch.setenv(
+            "MYTHRIL_TPU_SYM_LOCKSTEP", "1" if lockstep else "0"
+        )
+        calls = {"state": [], "pre": [], "post": [],
+                 "ipre": [], "ipost": []}
+        svm = LaserEVM(requires_statespace=False, execution_timeout=120,
+                       transaction_count=1)
+        svm.register_laser_hooks(
+            "execute_state",
+            lambda gs: calls["state"].append(gs.mstate.pc),
+        )
+        for op in hooked_ops:
+            svm.pre_hooks[op].append(
+                lambda gs, op=op: calls["pre"].append((op, gs.mstate.pc))
+            )
+            svm.post_hooks[op].append(
+                lambda gs, op=op: calls["post"].append((op, gs.mstate.pc))
+            )
+            svm.instr_pre_hook[op].append(
+                lambda gs, op=op: calls["ipre"].append((op, gs.mstate.pc))
+            )
+            svm.instr_post_hook[op].append(
+                lambda gs, op=op: calls["ipost"].append((op, gs.mstate.pc))
+            )
+        world_state = WorldState()
+        world_state.create_account(
+            balance=10, address=0xABCD, concrete_storage=True,
+            code=Disassembly(code),
+        )
+        svm.sym_exec(world_state=world_state, target_address=0xABCD)
+        return {k: sorted(v) for k, v in calls.items()}
+
+    serial_calls = run(lockstep=False)
+    lockstep_calls = run(lockstep=True)
+    assert sum(len(v) for v in serial_calls.values()) > 0
+    assert lockstep_calls == serial_calls
+
+
+def test_ledger_conservation_with_lockstep_transition(monkeypatch):
+    """The aggregate-only ``lockstep`` transition tally moves with the
+    tier while the solver-lane conservation invariant (every ledgered
+    lane decided exactly once) stays intact."""
+    import logging
+
+    logging.getLogger("mythril_tpu").setLevel(logging.CRITICAL)
+    from mythril_tpu.observability.ledger import get_ledger
+
+    monkeypatch.setenv("MYTHRIL_TPU_SYM_LOCKSTEP", "1")
+    ledger = get_ledger()
+    before = ledger.snapshot()["transitions"].get("lockstep", 0)
+    found, _row = _chaos_analyze("lockstep_ledger")
+    assert found == {"106"}
+    snap = ledger.snapshot()
+    assert snap["transitions"].get("lockstep", 0) > before
+    assert sum(snap["decided"].values()) == snap["lanes_total"]
