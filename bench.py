@@ -873,6 +873,113 @@ def _fleet_microbench():
         faults.reset_for_tests()
 
 
+def _fabric_microbench():
+    """Serving-fabric headline: an in-process ``AnalysisServer``
+    fronting one authenticated remote worker seat (loopback listener,
+    ephemeral port, a real ``myth worker`` subprocess) analyzes
+    killbilly through the fabric — a warm-up plus 6 timed requests
+    give ``fabric_cpm``, sustained contracts/min routed through remote
+    seats (gated higher-is-better in scripts/bench_compare.py).  Every
+    timed request must answer in fabric mode with the finding."""
+    import json as _json
+    import statistics
+    import subprocess
+    import tempfile as _tempfile
+    import urllib.request
+
+    from mythril_tpu.serve import AnalysisServer, ServeConfig
+
+    name, code, tx_count, _expected = _corpus()[0]  # killbilly
+    secret_fd, secret_path = _tempfile.mkstemp(
+        prefix="mtpu-bench-secret-"
+    )
+    with os.fdopen(secret_fd, "w") as fh:
+        fh.write(os.urandom(16).hex() + "\n")
+    worker = None
+    server = AnalysisServer(ServeConfig.from_env(
+        port=0, fleet_listen="127.0.0.1:0", secret_file=secret_path,
+    ))
+    try:
+        server.start()
+        if server.router is None:
+            return {"skipped": "fabric disabled (MYTHRIL_TPU_FLEET=0)"}
+        listen = server.router.summary()["listen"]
+        repo_root = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ)
+        env["MYTHRIL_TPU_FLEET_ROLE"] = "worker"
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+            "PYTHONPATH", ""
+        )
+        worker = subprocess.Popen(
+            [sys.executable, "-m", "mythril_tpu.parallel.fleet",
+             "--worker", "--connect", listen,
+             "--id", "bench-fabric-w1",
+             "--secret-file", secret_path, "--reconnect", "0"],
+            env=env, cwd=repo_root,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        deadline = time.monotonic() + 60.0
+        while (time.monotonic() < deadline
+               and server.router.seat_count() < 1):
+            time.sleep(0.2)
+        if server.router.seat_count() < 1:
+            return {"error": "no remote seat attached within 60s"}
+        payload = _json.dumps({
+            "code": code, "name": name, "tx_count": tx_count,
+            "deadline_s": 240, "source": "bench",
+        }).encode()
+
+        def post():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/analyze",
+                data=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            began = time.monotonic()
+            body = _json.loads(
+                urllib.request.urlopen(req, timeout=240).read()
+            )
+            return time.monotonic() - began, body
+
+        cold_s, body = post()
+        if body.get("mode") != "fabric" or not body.get("findings_swc"):
+            return {"error": "warm-up did not route through the fabric "
+                             f"(mode {body.get('mode')!r}, found "
+                             f"{body.get('findings_swc')})"}
+        latencies = []
+        began = time.monotonic()
+        for _ in range(6):
+            elapsed, body = post()
+            if body.get("mode") != "fabric":
+                return {"error": "timed request fell back in-process "
+                                 f"(mode {body.get('mode')!r})"}
+            latencies.append(elapsed)
+        total = time.monotonic() - began
+        return {
+            "requests": len(latencies),
+            "fabric_cold_s": round(cold_s, 3),
+            "warm_p50_s": round(statistics.median(latencies), 4),
+            "warm_max_s": round(max(latencies), 4),
+            "contracts_per_min": round(
+                60.0 * len(latencies) / total, 1
+            ),
+            "found": body["findings_swc"],
+            "routed": server.router.routed,
+        }
+    finally:
+        if worker is not None and worker.poll() is None:
+            worker.kill()
+            try:
+                worker.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        server.drain_and_stop("bench done")
+        try:
+            os.unlink(secret_path)
+        except OSError:
+            pass
+
+
 def _scale_summary(row):
     keys = (
         "wall_s", "dispatches", "lanes", "unsat", "sat_verified",
@@ -1023,12 +1130,18 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
         headline["worker_deaths_recovered"] = summary.get(
             "worker_deaths_recovered", 0
         )
+    if isinstance(summary.get("fabric_cpm"), (int, float)):
+        # serving fabric: sustained contracts/min through one
+        # authenticated remote seat (gated higher-is-better in
+        # bench_compare); absent when the microbench did not run
+        headline["fabric_cpm"] = summary["fabric_cpm"]
     if "error" in summary:
         headline["error"] = str(summary["error"])[:160]
     line = json.dumps(headline)
     if len(line) > 500:  # hard cap so the tail capture can never lose it
         for key in ("autopilot_tuned", "autopilot_ladder",
                     "autopilot_routed", "tier_decided_pct",
+                    "fabric_cpm",
                     "worker_deaths_recovered", "fleet_speedup",
                     "microbench_device_vs_host",
                     "microbench_device_warm_s",
@@ -1200,6 +1313,17 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001 — bench must not die here
             fleet_bench = {"error": str(exc)[:200]}
     print(json.dumps({"fleet_microbench": fleet_bench}), file=sys.stderr)
+    # serving-fabric microbench (serve/fabric.py): one authenticated
+    # remote seat behind an in-process server; same isolation ordering
+    if quick:
+        fabric_bench = {"skipped": "--quick run"}
+    else:
+        try:
+            fabric_bench = _fabric_microbench()
+        except Exception as exc:  # noqa: BLE001 — bench must not die here
+            fabric_bench = {"error": str(exc)[:200]}
+    print(json.dumps({"fabric_microbench": fabric_bench}),
+          file=sys.stderr)
     summary = {
         "metric": "analyze_corpus_wall_s",
         "value": round(wall, 2),
@@ -1356,6 +1480,9 @@ def main() -> None:
         summary["worker_deaths_recovered"] = fleet_bench.get(
             "worker_deaths_recovered", 0
         )
+    summary["fabric_microbench"] = fabric_bench
+    if isinstance(fabric_bench.get("contracts_per_min"), (int, float)):
+        summary["fabric_cpm"] = fabric_bench["contracts_per_min"]
     # headline sweep utilization: over the corpus pass AND the scale
     # scenarios (the corpus's narrow frontiers rarely dispatch, so the
     # scale rows are where the ratio carries signal)

@@ -37,6 +37,7 @@ COMMAND_LIST = (
     + (
         "pro",
         "serve",
+        "worker",
         "top",
         "list-detectors",
         "read-storage",
@@ -436,6 +437,51 @@ def create_serve_parser(parser: argparse.ArgumentParser) -> None:
         "drain (the live view is GET /debug/lanes)",
         metavar="FILE",
     )
+    parser.add_argument(
+        "--fleet-listen",
+        help="HOST:PORT the serving fabric's coordinator listens on "
+        "for `myth worker --connect` attach (non-loopback requires "
+        "--secret-file; env: MYTHRIL_TPU_FLEET_LISTEN)",
+        metavar="HOST:PORT",
+    )
+    parser.add_argument(
+        "--secret-file",
+        help="shared-secret file authenticating fabric workers "
+        "(env: MYTHRIL_TPU_FLEET_SECRET_FILE)",
+        metavar="FILE",
+    )
+
+
+def create_worker_parser(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--connect",
+        required=True,
+        help="HOST:PORT of the coordinator's fleet listener "
+        "(`myth serve --fleet-listen` or a --workers N coordinator)",
+        metavar="HOST:PORT",
+    )
+    parser.add_argument(
+        "--secret-file",
+        help="shared-secret file for the fabric handshake (env: "
+        "MYTHRIL_TPU_FLEET_SECRET_FILE; required when the "
+        "coordinator listens on a routable interface)",
+        metavar="FILE",
+    )
+    parser.add_argument(
+        "--id",
+        help="worker id announced in the hello (default "
+        "HOSTNAME-PID)",
+        metavar="ID",
+    )
+    parser.add_argument(
+        "--reconnect",
+        type=int,
+        default=None,
+        help="redial attempts after a lost coordinator connection "
+        "(default MYTHRIL_TPU_FLEET_RECONNECT or 5; 0 = exit on "
+        "first disconnect)",
+        metavar="N",
+    )
 
 
 def create_top_parser(parser: argparse.ArgumentParser) -> None:
@@ -583,6 +629,15 @@ def main() -> None:
         formatter_class=argparse.ArgumentDefaultsHelpFormatter,
     )
     create_serve_parser(serve_parser)
+    worker_parser = subparsers.add_parser(
+        "worker",
+        help="Attach this machine to a serving fabric as a worker "
+        "seat: connect to a coordinator's --fleet-listen endpoint, "
+        "authenticate with the shared secret, run leases until "
+        "drained (docs/scaling.md)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    create_worker_parser(worker_parser)
     top_parser = subparsers.add_parser(
         "top",
         help="Live one-screen status of a running serve daemon or "
@@ -963,7 +1018,11 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
         from mythril_tpu.serve import ServeConfigError, run_server
 
         try:
-            sys.exit(run_server(host=args.host, port=args.port))
+            sys.exit(run_server(
+                host=args.host, port=args.port,
+                fleet_listen=args.fleet_listen,
+                secret_file=args.secret_file,
+            ))
         except ServeConfigError as e:
             print(f"bad serve config: {e}", file=sys.stderr)
             sys.exit(2)
@@ -971,6 +1030,29 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
             print(f"cannot bind {args.host}:{args.port}: {e}",
                   file=sys.stderr)
             sys.exit(1)
+
+    if args.command == "worker":
+        # a worker seat must never recursively spawn its own fleet
+        os.environ["MYTHRIL_TPU_FLEET_ROLE"] = "worker"
+        import socket as socket_mod
+
+        from mythril_tpu.parallel.fleet import worker_main
+
+        worker_id = args.id or (
+            f"{socket_mod.gethostname()}-{os.getpid()}"
+        )
+        worker_argv = ["--worker", "--connect", args.connect,
+                       "--id", worker_id]
+        if args.secret_file:
+            worker_argv += ["--secret-file", args.secret_file]
+        reconnect = args.reconnect
+        if reconnect is None and not os.environ.get(
+            "MYTHRIL_TPU_FLEET_RECONNECT"
+        ):
+            reconnect = 5  # survive a coordinator restart by default
+        if reconnect is not None:
+            worker_argv += ["--reconnect", str(reconnect)]
+        sys.exit(worker_main(worker_argv))
 
     if args.command == "top":
         from mythril_tpu.interfaces.top import run_top

@@ -2,12 +2,13 @@
 daemon or fleet coordinator.
 
 Polls the live introspection endpoints the observability plane exposes
-(``/debug/requests``, ``/debug/lanes``, ``/debug/autopilot``, and — on
-a serve instance — ``/readyz``) and renders a compact terminal
-dashboard: server health, the in-flight request (phase, deadline
-budget remaining, lane counts by tier), recent requests, the
-lane-attribution funnel split, and the autopilot's routing/tuning
-activity.
+(``/debug/requests``, ``/debug/lanes``, ``/debug/autopilot``,
+``/debug/fleet``, and — on a serve instance — ``/readyz``) and renders
+a compact terminal dashboard: server health, the in-flight request
+(phase, deadline budget remaining, lane counts by tier), recent
+requests, the serving fabric's seat table and per-tenant quota
+consumption, the lane-attribution funnel split, and the autopilot's
+routing/tuning activity.
 Stdlib-only, read-only, and safe against a half-up server (connection
 errors render as a status line, not a traceback).
 
@@ -146,6 +147,43 @@ def _render_serve(ready: Optional[dict], requests: Optional[dict],
                   f"trace={row.get('trace_id')}{flags}", file=out)
 
 
+def _render_fabric(fleet_body: Optional[dict], out) -> None:
+    """The serving-fabric panel: listen endpoint, routing counters,
+    per-seat liveness, per-tenant quota consumption.  Absent fabric
+    (no ``--fleet-listen``) drops the panel entirely."""
+    if not fleet_body:
+        return
+    fabric = fleet_body.get("fabric")
+    if not fabric:
+        return
+    auth = "auth" if fabric.get("authenticated") else "open"
+    print(f"  fabric: {fabric.get('listen')} ({auth})  "
+          f"seats={fabric.get('seats', 0)}  "
+          f"routed={fabric.get('routed', 0)} "
+          f"fallbacks={fabric.get('fallbacks', 0)} "
+          f"revoked={fabric.get('revoked', 0)} "
+          f"in-flight={fabric.get('jobs_in_flight', 0)}", file=out)
+    coordinator = fabric.get("coordinator") or {}
+    for seat in coordinator.get("seats", []):
+        if seat.get("dead"):
+            status = "dead"
+        elif seat.get("lease"):
+            status = "busy"
+        else:
+            status = "idle"
+        where = "remote" if seat.get("remote") else "local"
+        print(f"    seat {seat.get('worker_id'):<16} {status:<5} "
+              f"{where}  lease={seat.get('lease') or '-'}", file=out)
+    tenants = fleet_body.get("tenants") or {}
+    if tenants:
+        quota = fleet_body.get("tenant_quota_s") or 0
+        cap = f"/{quota:g}s" if quota else "s"
+        print("    tenants: " + ", ".join(
+            f"{source}={spent}{cap}"
+            for source, spent in sorted(tenants.items())
+        ), file=out)
+
+
 def _render_fleet(requests: dict, out) -> None:
     print(f"  coordinator trace: {requests.get('trace_id')}", file=out)
     for lease in requests.get("leases", []):
@@ -172,6 +210,7 @@ def render_once(url: str, out=None) -> bool:
     lanes = _get_json(base + "/debug/lanes")
     pilot = _get_json(base + "/debug/autopilot")
     ready = _get_json(base + "/readyz")
+    fleet_body = _get_json(base + "/debug/fleet")
     print(f"myth top — {base}  "
           f"({time.strftime('%H:%M:%S')})", file=out)
     if requests is None and lanes is None:
@@ -183,6 +222,7 @@ def render_once(url: str, out=None) -> bool:
         _render_fleet(requests, out)
     else:
         _render_serve(ready, requests, out)
+        _render_fabric(fleet_body, out)
     _render_lanes(lanes, out)
     _render_autopilot(pilot, out)
     return True

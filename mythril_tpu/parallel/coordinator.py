@@ -35,27 +35,38 @@ design decision buys the whole failure matrix:
   analysis can lose its whole fleet and still complete.
 
 Workers are separate processes speaking the framed socket protocol of
-``parallel/gossip.py`` over localhost TCP (the serve-plane convention:
-validated frames, structured errors, fail at the edge) — multi-host is
-a listen-address change, not a redesign.
+``parallel/gossip.py`` — spawned children over localhost by default,
+or externally-launched remote workers (``myth worker --connect``) that
+attach through the authenticated fabric (``parallel/fabric.py``):
+shared-secret HMAC challenge/response on hello, per-frame MACs with
+monotonic sequence numbers, and journal-over-the-wire lease staging so
+no shared filesystem is ever assumed.  Unauthenticated or malformed
+peers get a structured reject and a strike at the boundary — never a
+traceback, never an unpickle.
 """
 
+import hmac
 import logging
 import os
 import queue
+import secrets
 import shutil
 import signal
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+from mythril_tpu.parallel import fabric
+from mythril_tpu.parallel.fabric import AuthedChannel, FleetAuthError
 from mythril_tpu.parallel.gossip import (
     FrameError, Stamp, recv_frame, send_frame,
 )
+from mythril_tpu.support.env import env_float, env_int
 
 log = logging.getLogger(__name__)
 
@@ -63,19 +74,8 @@ log = logging.getLogger(__name__)
 # death/split | FAILED past the retry budget, -> in-process fallback)
 PENDING, RUNNING, DONE, FAILED = "pending", "running", "done", "failed"
 
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
+#: how much of a dead worker's stderr survives into the post-mortem
+STDERR_TAIL_BYTES = 4096
 
 
 @dataclass
@@ -92,25 +92,52 @@ class FleetConfig:
     connect_timeout_s: float = 120.0
     hard_cap_s: float = 900.0      # absolute lease wall cap
     checkpoint_period_s: str = "5"  # worker journal refresh cadence
+    listen_host: str = "127.0.0.1"  # non-loopback requires a secret
+    listen_port: int = 0           # 0 = ephemeral
+    secret: Optional[bytes] = None  # shared fabric secret, or None
 
     @classmethod
     def from_env(cls, workers: int) -> "FleetConfig":
+        listen_host, listen_port = "127.0.0.1", 0
+        raw_listen = os.environ.get("MYTHRIL_TPU_FLEET_LISTEN",
+                                    "").strip()
+        if raw_listen:
+            try:
+                listen_host, listen_port = fabric.parse_listen(raw_listen)
+            except ValueError as exc:
+                # validate_env makes startup strict; mid-run reads stay
+                # lenient (the PR-11 split) — fall back to loopback
+                log.warning("fleet: bad MYTHRIL_TPU_FLEET_LISTEN (%s); "
+                            "listening on loopback", exc)
+        secret = None
+        try:
+            secret = fabric.resolve_secret()
+        except FleetAuthError as exc:
+            log.warning("fleet: %s; remote attach disabled", exc)
         return cls(
             workers=max(1, workers),
-            heartbeat_s=_env_float("MYTHRIL_TPU_FLEET_HEARTBEAT_S", 0.5),
-            lease_ttl_s=_env_float("MYTHRIL_TPU_FLEET_LEASE_TTL_S", 12.0),
-            split_after_s=_env_float(
-                "MYTHRIL_TPU_FLEET_SPLIT_AFTER_S", 20.0
+            heartbeat_s=env_float("MYTHRIL_TPU_FLEET_HEARTBEAT_S", 0.5,
+                                  floor=0.05),
+            lease_ttl_s=env_float("MYTHRIL_TPU_FLEET_LEASE_TTL_S", 12.0,
+                                  floor=0.1),
+            split_after_s=env_float(
+                "MYTHRIL_TPU_FLEET_SPLIT_AFTER_S", 20.0, floor=0.0
             ),
-            lease_retries=_env_int("MYTHRIL_TPU_FLEET_LEASE_RETRIES", 2),
-            spawn_retries=_env_int("MYTHRIL_TPU_FLEET_SPAWN_RETRIES", 2),
-            connect_timeout_s=_env_float(
-                "MYTHRIL_TPU_FLEET_CONNECT_TIMEOUT_S", 120.0
+            lease_retries=env_int("MYTHRIL_TPU_FLEET_LEASE_RETRIES", 2,
+                                  floor=0),
+            spawn_retries=env_int("MYTHRIL_TPU_FLEET_SPAWN_RETRIES", 2,
+                                  floor=0),
+            connect_timeout_s=env_float(
+                "MYTHRIL_TPU_FLEET_CONNECT_TIMEOUT_S", 120.0, floor=0.1
             ),
-            hard_cap_s=_env_float("MYTHRIL_TPU_FLEET_HARD_CAP_S", 900.0),
+            hard_cap_s=env_float("MYTHRIL_TPU_FLEET_HARD_CAP_S", 900.0,
+                                 floor=0.1),
             checkpoint_period_s=os.environ.get(
                 "MYTHRIL_TPU_FLEET_CHECKPOINT_PERIOD", "5"
             ),
+            listen_host=listen_host,
+            listen_port=listen_port,
+            secret=secret,
         )
 
 
@@ -134,6 +161,9 @@ class Lease:
     splitting: bool = False
     result: Optional[dict] = None
     result_body: Optional[bytes] = None
+    #: per-lease payload override (the serving fabric grants each
+    #: request its own contract); None = the coordinator-wide payload
+    payload: Optional[dict] = None
 
 
 @dataclass
@@ -150,14 +180,20 @@ class WorkerSeat:
 class WorkerProcess:
     """Real subprocess + connected socket for one worker."""
 
-    def __init__(self, worker_id: str, proc: subprocess.Popen):
+    remote = False
+
+    def __init__(self, worker_id: str, proc: subprocess.Popen,
+                 stderr_path: Optional[str] = None):
         self.worker_id = worker_id
         self.proc = proc
         self.conn: Optional[socket.socket] = None
+        self.channel: Optional[AuthedChannel] = None
+        self.stderr_path = stderr_path
         self._send_lock = threading.Lock()
 
-    def attach(self, conn: socket.socket) -> None:
+    def attach(self, conn: socket.socket, channel=None) -> None:
         self.conn = conn
+        self.channel = channel
 
     def alive(self) -> bool:
         return self.proc.poll() is None
@@ -167,9 +203,12 @@ class WorkerProcess:
             return False
         try:
             with self._send_lock:
-                send_frame(self.conn, header, body)
+                if self.channel is not None:
+                    self.channel.send(header, body)
+                else:
+                    send_frame(self.conn, header, body)
             return True
-        except OSError:
+        except (FrameError, OSError):
             return False
 
     def drain(self) -> None:
@@ -189,6 +228,76 @@ class WorkerProcess:
             self.proc.wait(timeout=10)
         except Exception:  # noqa: BLE001 — zombie reaping is best-effort
             pass
+        self.close()
+
+    def close(self) -> None:
+        if self.conn is not None:
+            try:
+                self.conn.close()
+            except OSError:
+                pass
+            self.conn = None
+
+    def read_stderr_tail(self,
+                         limit: int = STDERR_TAIL_BYTES) -> bytes:
+        """The last ``limit`` bytes the worker wrote to stderr — the
+        post-mortem :meth:`Coordinator._declare_dead` preserves."""
+        if not self.stderr_path:
+            return b""
+        try:
+            with open(self.stderr_path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                size = fh.tell()
+                fh.seek(max(0, size - limit))
+                return fh.read()
+        except OSError:
+            return b""
+
+    def discard_stderr(self) -> None:
+        if self.stderr_path:
+            try:
+                os.unlink(self.stderr_path)
+            except OSError:
+                pass
+            self.stderr_path = None
+
+
+class RemoteWorkerHandle:
+    """A worker some other host launched (``myth worker --connect``):
+    there is no subprocess to signal or reap — drain and revoke travel
+    as frames over the authenticated channel, and death is whatever
+    closes the socket."""
+
+    remote = True
+
+    def __init__(self, worker_id: str):
+        self.worker_id = worker_id
+        self.conn: Optional[socket.socket] = None
+        self.channel: Optional[AuthedChannel] = None
+
+    def attach(self, conn: socket.socket, channel=None) -> None:
+        self.conn = conn
+        self.channel = channel
+
+    def alive(self) -> bool:
+        return self.conn is not None
+
+    def send(self, header: dict, body: bytes = b"") -> bool:
+        if self.conn is None:
+            return False
+        try:
+            if self.channel is not None:
+                self.channel.send(header, body)
+            else:
+                send_frame(self.conn, header, body)
+            return True
+        except (FrameError, OSError):
+            return False
+
+    def drain(self) -> None:
+        self.send({"type": "drain"})
+
+    def kill(self) -> None:
         self.close()
 
     def close(self) -> None:
@@ -232,17 +341,31 @@ class Coordinator:
         self._spawn_failures = 0
         self._drained = False
         self.port: Optional[int] = None
+        #: peer host -> (strike count, last strike monotonic time):
+        #: the connection-level breaker for hostile remotes
+        self._strikes: Dict[str, tuple] = {}
+        #: bounded set of worker hello nonces (belt-and-braces on top
+        #: of the per-connection challenge freshness)
+        self._hello_nonces: set = set()
 
     # ------------------------------------------------------------------
     # socket plumbing (real mode only)
     # ------------------------------------------------------------------
 
     def open_listener(self) -> int:
+        host = self.config.listen_host
+        if self.config.secret is None and not fabric.is_loopback(host):
+            # secure-by-default: a routable listener without an auth
+            # secret would hand unpickle-as-code to the whole network
+            raise FleetAuthError(
+                f"refusing non-loopback fleet listen on {host!r} "
+                "without MYTHRIL_TPU_FLEET_SECRET_FILE"
+            )
         self._listener = socket.socket(socket.AF_INET,
                                        socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET,
                                   socket.SO_REUSEADDR, 1)
-        self._listener.bind(("127.0.0.1", 0))
+        self._listener.bind((host, self.config.listen_port))
         self._listener.listen(16)
         self.port = self._listener.getsockname()[1]
         thread = threading.Thread(
@@ -250,6 +373,15 @@ class Coordinator:
         )
         thread.start()
         return self.port
+
+    def connect_address(self) -> str:
+        """The address spawned local workers dial: loopback when the
+        listener is loopback or wildcard, the bound address itself
+        otherwise."""
+        host = self.config.listen_host
+        if host in ("0.0.0.0", "::", "") or fabric.is_loopback(host):
+            return "127.0.0.1"
+        return host
 
     def close_listener(self) -> None:
         if self._listener is not None:
@@ -263,42 +395,171 @@ class Coordinator:
         listener = self._listener
         while listener is not None and listener.fileno() >= 0:
             try:
-                conn, _addr = listener.accept()
+                conn, addr = listener.accept()
             except OSError:
                 return
             threading.Thread(
-                target=self._register_conn, args=(conn,),
+                target=self._register_conn, args=(conn, addr),
                 name="fleet-hello", daemon=True,
             ).start()
 
-    def _register_conn(self, conn: socket.socket) -> None:
-        """First frame must be the worker's hello; then the connection
-        gets a dedicated reader feeding the inbox."""
+    # connection-level breaker: a remote host that keeps failing auth
+    # or framing is dropped before the handshake for a cooldown.
+    # Loopback never blocks — a local fuzzer must not lock out the
+    # coordinator's own spawned workers.
+    _STRIKE_LIMIT = 3
+    _STRIKE_COOLDOWN_S = 30.0
+
+    def _strike(self, peer: str) -> None:
+        count, _when = self._strikes.get(peer, (0, 0.0))
+        self._strikes[peer] = (count + 1, time.monotonic())
+
+    def _peer_blocked(self, peer: str) -> bool:
+        if peer == "local" or fabric.is_loopback(peer):
+            return False
+        count, when = self._strikes.get(peer, (0, 0.0))
+        if count < self._STRIKE_LIMIT:
+            return False
+        if time.monotonic() - when > self._STRIKE_COOLDOWN_S:
+            self._strikes.pop(peer, None)
+            return False
+        return True
+
+    @staticmethod
+    def _reject(conn: socket.socket, code: str) -> None:
+        """Structured reject — the one frame an unauthenticated peer
+        ever gets back."""
         try:
-            conn.settimeout(self.config.connect_timeout_s)
+            send_frame(conn, {"type": "reject", "code": code})
+        except (FrameError, OSError):
+            pass
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _handshake(self, conn: socket.socket):
+        """Authn-before-unpickle: nothing a peer sends reaches
+        ``pickle.loads`` until this returns.  Without a secret it is
+        the legacy bare hello (loopback-only by ``open_listener``);
+        with one, challenge → MAC'd hello → MAC'd welcome, and every
+        further frame rides the derived session key."""
+        from mythril_tpu.resilience.faults import get_fault_plane
+
+        secret = self.config.secret
+        if secret is None:
             header, _body = recv_frame(conn)
             if header.get("type") != "hello":
                 raise FrameError("first frame was not hello")
-            worker_id = str(header.get("worker_id", ""))
+            return (str(header.get("worker_id", "")),
+                    AuthedChannel(conn, None), header)
+        challenge = secrets.token_hex(fabric.NONCE_BYTES)
+        send_frame(conn, {"type": "challenge", "nonce": challenge})
+        header, _body = recv_frame(conn)
+        if header.get("type") != "hello":
+            raise FleetAuthError("first frame was not hello")
+        worker_id = str(header.get("worker_id", ""))
+        nonce = str(header.get("nonce", ""))
+        if get_fault_plane().fire("remote_auth_fail") is not None:
+            raise FleetAuthError("injected remote auth failure")
+        if not nonce or nonce in self._hello_nonces:
+            raise FleetAuthError("replayed or missing hello nonce")
+        expected = fabric.hello_mac(secret, challenge, nonce, worker_id)
+        if not hmac.compare_digest(str(header.get("mac", "")), expected):
+            raise FleetAuthError("hello MAC mismatch")
+        self._hello_nonces.add(nonce)
+        while len(self._hello_nonces) > 4096:
+            self._hello_nonces.pop()
+        send_frame(conn, {
+            "type": "welcome",
+            "mac": fabric.welcome_mac(secret, challenge, nonce),
+        })
+        channel = AuthedChannel(
+            conn, fabric.session_key(secret, challenge, nonce),
+            send_label="c", recv_label="w",
+        )
+        return worker_id, channel, header
+
+    def _register_conn(self, conn: socket.socket, addr=None) -> None:
+        """First contact: authenticate, attach a known seat — or, for
+        an authenticated worker_id this coordinator never spawned,
+        create a remote seat (attach = new capacity, immediately).
+        Then the connection gets a dedicated reader feeding the
+        inbox."""
+        peer = addr[0] if addr else "local"
+        if self._peer_blocked(peer):
+            self._reject(conn, "peer_blocked")
+            return
+        try:
+            conn.settimeout(self.config.connect_timeout_s)
+            worker_id, channel, header = self._handshake(conn)
             seat = self.seats.get(worker_id)
             if seat is None or seat.handle is None:
-                raise FrameError(f"hello from unknown worker {worker_id!r}")
+                if channel.key is None:
+                    raise FrameError(
+                        f"hello from unknown worker {worker_id!r}"
+                    )
+                seat = self._attach_remote(worker_id, peer)
+            elif seat.dead and getattr(seat.handle, "remote", False):
+                # a remote worker rejoining after it was declared dead
+                # gets a fresh seat (the old one stays tombstoned)
+                seat = self._attach_remote(worker_id, peer)
             conn.settimeout(None)
-            seat.handle.attach(conn)
-            self.inbox.put((worker_id, header, b""))
-            self._reader_loop(worker_id, conn)
+            seat.handle.attach(conn, channel)
+            self.inbox.put((seat.worker_id, header, b""))
+            self._reader_loop(seat.worker_id, conn, channel)
+        except FleetAuthError as exc:
+            self.stats.auth_rejects += 1
+            self._strike(peer)
+            log.warning("fleet: attach from %s rejected (%s)",
+                        peer, exc)
+            self._reject(conn, "auth_failed")
         except (FrameError, OSError) as exc:
+            self.stats.frame_rejects += 1
+            self._strike(peer)
             log.debug("fleet: connection rejected (%s)", exc)
-            try:
-                conn.close()
-            except OSError:
-                pass
+            self._reject(conn, "bad_frame")
 
-    def _reader_loop(self, worker_id: str, conn: socket.socket) -> None:
+    def _attach_remote(self, worker_id: str, peer: str) -> WorkerSeat:
+        from mythril_tpu.observability import spans as obs
+
+        self.stats.remote_attaches += 1
+        seat = WorkerSeat(
+            worker_id=worker_id,
+            handle=RemoteWorkerHandle(worker_id),
+            spawned_at=self.clock(),
+        )
+        self.seats[worker_id] = seat
+        obs.instant("fleet.remote_attach", cat="fleet",
+                    worker=worker_id, peer=peer)
+        log.info("fleet: remote worker %s attached from %s",
+                 worker_id, peer)
+        return seat
+
+    def _reader_loop(self, worker_id: str, conn: socket.socket,
+                     channel=None) -> None:
+        from mythril_tpu.resilience.faults import get_fault_plane
+
         while True:
             try:
-                header, body = recv_frame(conn)
-            except (FrameError, OSError):
+                if get_fault_plane().fire("frame_corrupt") is not None:
+                    recv_frame(conn)  # consume, then strike
+                    raise FrameError("injected corrupt frame")
+                if channel is not None:
+                    header, body = channel.recv()
+                else:
+                    header, body = recv_frame(conn)
+            except FleetAuthError as exc:
+                self.stats.frame_rejects += 1
+                self.inbox.put((worker_id, {
+                    "type": "disconnect",
+                    "reason": f"tampered frame: {exc}",
+                }, b""))
+                return
+            except (FrameError, OSError) as exc:
+                if (isinstance(exc, FrameError)
+                        and "peer closed" not in str(exc)):
+                    self.stats.frame_rejects += 1
                 self.inbox.put(
                     (worker_id, {"type": "disconnect"}, b"")
                 )
@@ -343,19 +604,36 @@ class Coordinator:
             else:
                 env.pop("MYTHRIL_TPU_FAULT", None)
         debug = os.environ.get("MYTHRIL_TPU_FLEET_DEBUG") == "1"
+        stderr_fd = None
+        stderr_path = None
+        if not debug:
+            # stderr goes to a scratch file, not DEVNULL: its tail is
+            # the post-mortem _declare_dead preserves
+            stderr_fd, stderr_path = tempfile.mkstemp(
+                prefix=f"mtpu-{worker_id}-", suffix=".stderr"
+            )
         try:
             proc = subprocess.Popen(
                 [python, "-m", "mythril_tpu.parallel.fleet",
-                 "--worker", "--connect", f"127.0.0.1:{self.port}",
+                 "--worker", "--connect",
+                 f"{self.connect_address()}:{self.port}",
                  "--id", worker_id],
                 env=env, cwd=repo_root,
                 stdout=None if debug else subprocess.DEVNULL,
-                stderr=None if debug else subprocess.DEVNULL,
+                stderr=None if debug else stderr_fd,
             )
         except OSError as exc:
             log.warning("fleet: worker spawn failed: %s", exc)
+            if stderr_path is not None:
+                try:
+                    os.unlink(stderr_path)
+                except OSError:
+                    pass
             return None
-        return WorkerProcess(worker_id, proc)
+        finally:
+            if stderr_fd is not None:
+                os.close(stderr_fd)
+        return WorkerProcess(worker_id, proc, stderr_path=stderr_path)
 
     def _new_seat(self, respawn: bool = False) -> Optional[WorkerSeat]:
         self._seat_seq += 1
@@ -423,12 +701,16 @@ class Coordinator:
             return  # registration already attached the handle
         if kind == "disconnect":
             if not seat.dead:
-                self._declare_dead(seat, "connection lost")
+                self._declare_dead(
+                    seat, header.get("reason", "connection lost")
+                )
             return
         if kind == "heartbeat":
             self._on_heartbeat(seat, header)
         elif kind == "gossip":
             self._on_gossip(seat, header, body)
+        elif kind == "checkpoint":
+            self._on_checkpoint(seat, header, body)
         elif kind == "result":
             self._on_result(seat, header, body)
         elif kind == "error":
@@ -506,6 +788,23 @@ class Coordinator:
                 body,
             )
 
+    def _on_checkpoint(self, seat: WorkerSeat, header: dict,
+                       body: bytes) -> None:
+        """A remote worker shipped its boundary journal back
+        (journal-over-the-wire).  Unpacked into the lease's directory
+        so death → re-lease resumes from exactly this boundary, the
+        same guarantee the shared-filesystem path gives."""
+        lease = self._lease_of(seat)
+        if self._stale(lease, header):
+            self.stats.gossip_dropped_stale += 1
+            return
+        lease.last_heartbeat = self.clock()
+        try:
+            fabric.unpack_journal(body, lease.journal_dir)
+        except Exception as exc:  # noqa: BLE001 — bad blob, not fatal
+            log.warning("fleet: bad checkpoint from %s: %s",
+                        seat.worker_id, exc)
+
     def _on_result(self, seat: WorkerSeat, header: dict,
                    body: bytes) -> None:
         lease = self._lease_of(seat)
@@ -513,6 +812,11 @@ class Coordinator:
             # a zombie's late result: the re-leased worker's answer is
             # the authoritative one
             self.stats.gossip_dropped_stale += 1
+            if (lease is None or lease.state != RUNNING
+                    or lease.worker_id != seat.worker_id):
+                # the lease moved on (cancelled or re-leased) — free
+                # the seat instead of wedging it on a dead claim
+                seat.lease_id = None
             return
         partial = bool(header.get("partial"))
         if partial and lease.splitting:
@@ -551,13 +855,56 @@ class Coordinator:
         log.warning("fleet: worker %s declared dead (%s)",
                     seat.worker_id, reason)
         lease = self._lease_of(seat)
-        if lease is not None and lease.state == RUNNING:
-            self._revoke(lease, reason=reason)
-        seat.lease_id = None
         if reap and seat.handle is not None:
             try:
                 seat.handle.kill()
             except Exception:  # noqa: BLE001 — reaping is best-effort
+                pass
+        self._capture_postmortem(seat, lease, reason)
+        if lease is not None and lease.state == RUNNING:
+            self._revoke(lease, reason=reason)
+        seat.lease_id = None
+
+    def _capture_postmortem(self, seat: WorkerSeat,
+                            lease: Optional[Lease],
+                            reason: str) -> None:
+        """The last ~4KB of the dead worker's stderr: next to the
+        boundary journal it died at and into the flight recorder, so
+        remote/respawn failures are diagnosable instead of vanishing
+        into DEVNULL."""
+        handle = seat.handle
+        if handle is None or not hasattr(handle, "read_stderr_tail"):
+            return
+        tail = handle.read_stderr_tail()
+        if hasattr(handle, "discard_stderr"):
+            handle.discard_stderr()
+        if not tail:
+            return
+        text = tail.decode("utf-8", "replace")
+        try:
+            from mythril_tpu.observability.flight import (
+                get_flight_recorder,
+            )
+
+            get_flight_recorder().record({
+                "kind": "worker_postmortem",
+                "worker": seat.worker_id,
+                "reason": reason,
+                "stderr_tail": text[-2048:],
+            })
+        except Exception:  # noqa: BLE001 — diagnostics never raise
+            pass
+        if lease is not None and os.path.isdir(lease.journal_dir):
+            path = os.path.join(
+                lease.journal_dir, f"postmortem-{seat.worker_id}.txt"
+            )
+            try:
+                with open(path, "w", encoding="utf-8") as fh:
+                    fh.write(
+                        f"worker {seat.worker_id} declared dead: "
+                        f"{reason}\n\n{text}"
+                    )
+            except OSError:
                 pass
 
     def _revoke(self, lease: Lease, reason: str) -> None:
@@ -711,13 +1058,53 @@ class Coordinator:
             "stamp": Stamp(lease_epoch=lease.epoch).as_dict(),
             "journal_dir": lease.journal_dir,
             "tx_index": lease.tx_index,
-            "payload": self.lease_payload,
+            "payload": (lease.payload if lease.payload is not None
+                        else self.lease_payload),
             "heartbeat_s": self.config.heartbeat_s,
         }
-        if not seat.handle.send(header):
+        body = b""
+        if getattr(seat.handle, "remote", False):
+            # a remote worker shares no filesystem: the grant carries
+            # the frozen journal itself, and boundary journals ride
+            # the results/checkpoint frames back
+            header["journal_wire"] = True
+            body = fabric.pack_journal(lease.journal_dir)
+        if not seat.handle.send(header, body):
             # the connection died between accept and grant: declare the
             # seat dead; the lease goes back to PENDING via revoke
             self._declare_dead(seat, "grant send failed")
+
+    def cancel_lease(self, lease_id: str,
+                     reason: str = "cancelled") -> bool:
+        """Request-scoped revocation (serve-plane client abort): fence
+        the epoch so any in-flight result is dropped, tell the holder
+        to stop at its next boundary, and retire the lease as DONE
+        with a cancelled marker so the run loop can finish."""
+        from mythril_tpu.observability import spans as obs
+
+        lease = self.leases.get(lease_id)
+        if lease is None or lease.state in (DONE, FAILED):
+            return False
+        holder = (self.seats.get(lease.worker_id)
+                  if lease.worker_id else None)
+        if holder is not None and holder.handle is not None:
+            holder.handle.send({
+                "type": "revoke",
+                "lease_id": lease.lease_id,
+                "stamp": Stamp(lease_epoch=lease.epoch).as_dict(),
+                "reason": reason,
+            })
+            holder.lease_id = None
+        lease.epoch += 1  # fence every in-flight frame from the holder
+        lease.worker_id = None
+        lease.state = DONE
+        lease.result = {"type": "result", "lease_id": lease.lease_id,
+                        "cancelled": True, "found_swcs": [],
+                        "partial": True}
+        lease.result_body = None
+        obs.instant("fleet.lease_cancel", cat="fleet",
+                    lease=lease.lease_id, reason=reason)
+        return True
 
     # ------------------------------------------------------------------
     # live introspection
@@ -755,10 +1142,15 @@ class Coordinator:
                     "dead": seat.dead,
                     "lease": seat.lease_id,
                     "connected": self._connected(seat),
+                    "remote": bool(getattr(seat.handle, "remote",
+                                           False)),
                 }
                 for seat in sorted(self.seats.values(),
                                    key=lambda s: s.worker_id)
             ],
+            "listen": f"{self.config.listen_host}:{self.port or 0}",
+            "authenticated": self.config.secret is not None,
+            "struck_peers": len(self._strikes),
         }
 
     def open_debug_listener(self) -> Optional[int]:
@@ -918,3 +1310,5 @@ class Coordinator:
                 handle.kill()
             except Exception:  # noqa: BLE001
                 pass
+            if hasattr(handle, "discard_stderr"):
+                handle.discard_stderr()

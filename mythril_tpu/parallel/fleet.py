@@ -51,6 +51,9 @@ _FIELDS = {
     "worker_deaths": "workers declared dead (TTL, crash, disconnect)",
     "gossip_sent": "knowledge messages accepted and routed",
     "gossip_dropped_stale": "messages fenced for a stale lease epoch",
+    "auth_rejects": "connections rejected by the fabric handshake",
+    "frame_rejects": "malformed/tampered/oversized frames struck",
+    "remote_attaches": "externally-launched workers attached",
 }
 
 
@@ -502,27 +505,37 @@ class _WorkerSession:
     """State shared between the worker's comms threads and its analysis
     thread: the active lease, the gossip inbox, and the send lock."""
 
-    def __init__(self, worker_id: str, conn: socket.socket):
+    def __init__(self, worker_id: str, conn: socket.socket,
+                 channel=None):
         self.worker_id = worker_id
         self.conn = conn
+        #: authenticated frame channel (``fabric.AuthedChannel``), or
+        #: None for the legacy bare-frame localhost path
+        self.channel = channel
         self.send_lock = threading.Lock()
         self.lease_header: Optional[dict] = None
         self.lease_lock = threading.Lock()
         self.gossip_in: "queue.Queue" = queue.Queue()
         self.lease_queue: "queue.Queue" = queue.Queue()
         self.closed = False
+        #: local journal directory for a journal-over-the-wire lease
+        #: (remote attach: no filesystem shared with the coordinator)
+        self.wire_dir: Optional[str] = None
 
     # -- comms ----------------------------------------------------------
 
     def send(self, header: dict, body: bytes = b"") -> None:
-        from mythril_tpu.parallel.gossip import send_frame
+        from mythril_tpu.parallel.gossip import FrameError, send_frame
 
         if self.closed:
             return
         try:
             with self.send_lock:
-                send_frame(self.conn, header, body)
-        except OSError:
+                if self.channel is not None:
+                    self.channel.send(header, body)
+                else:
+                    send_frame(self.conn, header, body)
+        except (FrameError, OSError):
             self.closed = True
 
     def reader_loop(self) -> None:
@@ -530,9 +543,13 @@ class _WorkerSession:
 
         while True:
             try:
-                header, body = recv_frame(self.conn)
+                if self.channel is not None:
+                    header, body = self.channel.recv()
+                else:
+                    header, body = recv_frame(self.conn)
             except (FrameError, OSError):
                 self.closed = True
+                self._abort_active_lease()
                 self.lease_queue.put(None)
                 return
             kind = header.get("type")
@@ -540,8 +557,50 @@ class _WorkerSession:
                 self.lease_queue.put((header, body))
             elif kind == "gossip":
                 self.gossip_in.put((header, body))
+            elif kind == "revoke":
+                self._on_revoke(header)
+            elif kind == "drain":
+                # the frame twin of SIGTERM for remote workers:
+                # checkpoint at the next boundary, report partial, exit
+                from mythril_tpu.resilience.checkpoint import (
+                    request_drain,
+                )
+
+                request_drain("coordinator drain frame")
             elif kind == "shutdown":
+                # a graceful coordinator stop: for a spawned worker
+                # this is the end (redial budget 0); for a remote
+                # ``--reconnect`` worker it is a pause — worker_main's
+                # redial budget decides which
+                self.closed = True
+                self._abort_active_lease()
                 self.lease_queue.put(None)
+                return
+
+    def _abort_active_lease(self) -> None:
+        """The coordinator is gone mid-lease: expire the running
+        analysis's budget so it drains at its next boundary instead of
+        finishing a result nobody will read — the seat must get back
+        to redialing in seconds, not after the full execution
+        timeout."""
+        from mythril_tpu.resilience.budget import install_budget
+
+        with self.lease_lock:
+            header = self.lease_header
+        if header is not None:
+            install_budget(0.0, label="coordinator lost")
+
+    def _on_revoke(self, header: dict) -> None:
+        """Request-scoped revocation (serve client abort): expire the
+        active lease's budget so the analysis drains at its next
+        boundary.  Non-sticky — this worker stays leasable."""
+        from mythril_tpu.resilience.budget import install_budget
+
+        with self.lease_lock:
+            current = self.lease_header
+        if (current is not None
+                and header.get("lease_id") == current["lease_id"]):
+            install_budget(0.0, label="lease revoked")
 
     def heartbeat_loop(self, interval_holder: dict) -> None:
         while not self.closed:
@@ -599,6 +658,29 @@ class _WorkerSession:
             )
         except Exception:  # noqa: BLE001
             log.debug("worker: gossip send failed", exc_info=True)
+        self.ship_checkpoint(header)
+
+    def ship_checkpoint(self, header: dict) -> None:
+        """Journal-over-the-wire: ship the local boundary journal back
+        so the coordinator can re-lease from this exact boundary if we
+        die — the remote twin of writing into a shared directory."""
+        if self.wire_dir is None:
+            return
+        try:
+            from mythril_tpu.parallel import fabric as fabric_mod
+
+            self.send(
+                {
+                    "type": "checkpoint",
+                    "lease_id": header["lease_id"],
+                    "stamp": header["stamp"],
+                    "worker_id": self.worker_id,
+                },
+                fabric_mod.pack_journal(self.wire_dir),
+            )
+        except Exception:  # noqa: BLE001 — a lost checkpoint costs
+            #               repeated work on re-lease, never the result
+            log.debug("worker: checkpoint ship failed", exc_info=True)
 
 
 _worker_session: Optional[_WorkerSession] = None
@@ -657,7 +739,17 @@ def _worker_reset_scope(journal_dir: str, knobs: dict) -> None:
     args.resume_from = journal_dir
 
 
-def _worker_run_lease(session: _WorkerSession, header: dict) -> None:
+def _worker_lease_cleanup(session: _WorkerSession) -> None:
+    from mythril_tpu.resilience.budget import clear_budget
+
+    clear_budget()  # a revoke-expired budget must not leak forward
+    if session.wire_dir is not None:
+        shutil.rmtree(session.wire_dir, ignore_errors=True)
+        session.wire_dir = None
+
+
+def _worker_run_lease(session: _WorkerSession, header: dict,
+                      body: bytes = b"") -> None:
     """Execute one lease end to end and report the result."""
     from mythril_tpu.observability import spans as obs
     from mythril_tpu.resilience.checkpoint import (
@@ -666,6 +758,14 @@ def _worker_run_lease(session: _WorkerSession, header: dict) -> None:
 
     payload = header["payload"]
     journal_dir = header["journal_dir"]
+    if header.get("journal_wire"):
+        # remote attach: the grant body IS the journal — materialize
+        # it locally and run from there (no shared filesystem)
+        from mythril_tpu.parallel import fabric as fabric_mod
+
+        journal_dir = tempfile.mkdtemp(prefix="mtpu-wire-")
+        fabric_mod.unpack_journal(body, journal_dir)
+        session.wire_dir = journal_dir
     tracer = obs.get_tracer()
     if payload.get("trace"):
         tracer.enable(record_events=True)
@@ -718,6 +818,7 @@ def _worker_run_lease(session: _WorkerSession, header: dict) -> None:
             "worker_id": session.worker_id,
             "message": error,
         })
+        _worker_lease_cleanup(session)
         return
     from mythril_tpu.resilience.checkpoint import CheckpointPlane
 
@@ -729,9 +830,14 @@ def _worker_run_lease(session: _WorkerSession, header: dict) -> None:
     partial = bool(
         drain_requested() or get_checkpoint_plane().partial
     )
+    if partial:
+        # a drained/split remote lease: the coordinator re-leases from
+        # the boundary journal, which only exists on its side if we
+        # ship it one last time before the result
+        session.ship_checkpoint(header)
     from mythril_tpu.observability.ledger import get_ledger
 
-    body = pickle.dumps({
+    result_body = pickle.dumps({
         "findings": findings,
         "spans": tracer.events() if payload.get("trace") else None,
         # lane-ledger aggregates ride home with the result so the
@@ -752,49 +858,124 @@ def _worker_run_lease(session: _WorkerSession, header: dict) -> None:
             ),
             "wall_s": round(time.time() - began, 3),
         },
-        body,
+        result_body,
     )
+    _worker_lease_cleanup(session)
 
 
-def worker_main(argv: Optional[List[str]] = None) -> int:
-    """Entry point of ``python -m mythril_tpu.parallel.fleet --worker``:
-    connect, say hello, heartbeat, and run leases until shutdown."""
+#: sentinel from _worker_connect_once: the connection died in a way a
+#: redial could fix (coordinator restart, network blip)
+_RECONNECT = -1
+
+
+def _worker_connect_once(host: str, port: int, worker_id: str,
+                         secret: Optional[bytes]) -> int:
+    """One connect → handshake → lease-serving session.  Returns an
+    exit code, or :data:`_RECONNECT` when redialing makes sense."""
     global _worker_session
-    import argparse
+    from mythril_tpu.parallel import fabric as fabric_mod
+    from mythril_tpu.resilience import checkpoint
 
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--worker", action="store_true")
-    parser.add_argument("--connect", required=True)
-    parser.add_argument("--id", required=True)
-    opts = parser.parse_args(argv)
-    host, _, port = opts.connect.rpartition(":")
-    conn = socket.create_connection((host or "127.0.0.1", int(port)),
-                                    timeout=30)
+    try:
+        conn = socket.create_connection((host, port), timeout=30)
+    except OSError as exc:
+        log.warning("worker: connect to %s:%d failed: %s",
+                    host, port, exc)
+        return _RECONNECT
     conn.settimeout(None)
-    session = _WorkerSession(opts.id, conn)
+    try:
+        channel = fabric_mod.client_handshake(conn, secret, worker_id)
+    except fabric_mod.FleetAuthError as exc:
+        # wrong secret will not fix itself — structured exit, the
+        # PR-11 bad-configuration contract
+        log.error("worker: authentication failed: %s", exc)
+        print(f"myth worker: authentication failed: {exc}",
+              file=sys.stderr)
+        try:
+            conn.close()
+        except OSError:
+            pass
+        return 2
+    except (fabric_mod.FrameError, OSError) as exc:
+        log.warning("worker: handshake failed: %s", exc)
+        try:
+            conn.close()
+        except OSError:
+            pass
+        return _RECONNECT
+    session = _WorkerSession(worker_id, conn, channel=channel)
     _worker_session = session
-    session.send({"type": "hello", "worker_id": opts.id,
-                  "pid": os.getpid()})
     interval = {"s": 0.5}
     threading.Thread(target=session.reader_loop, name="fleet-reader",
                      daemon=True).start()
     threading.Thread(target=session.heartbeat_loop, args=(interval,),
                      name="fleet-heartbeat", daemon=True).start()
-    from mythril_tpu.resilience import checkpoint
-
-    checkpoint.install_signal_handlers()
     while True:
         item = session.lease_queue.get()
-        if item is None or session.closed:
-            return 0
-        header, _body = item
+        if item is None:
+            return _RECONNECT if session.closed else 0
+        if session.closed:
+            return _RECONNECT
+        header, body = item
         interval["s"] = float(header.get("heartbeat_s", 0.5))
-        _worker_run_lease(session, header)
+        _worker_run_lease(session, header, body)
         if checkpoint._drain_event.is_set():
             # a signal drain is sticky by design (PR-3): this process
             # reported its partial result and must be replaced, not
             # reused with a poisoned drain flag
             return 0
+
+
+def worker_main(argv: Optional[List[str]] = None) -> int:
+    """Entry point of ``python -m mythril_tpu.parallel.fleet --worker``
+    and of the ``myth worker`` CLI: connect (authenticated when a
+    secret is configured), heartbeat, run leases until shutdown — and
+    redial up to ``--reconnect`` times so a coordinator restart is a
+    pause, not a death."""
+    import argparse
+
+    from mythril_tpu.parallel import fabric as fabric_mod
+    from mythril_tpu.resilience import checkpoint
+    from mythril_tpu.support.env import env_int
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--worker", action="store_true")
+    parser.add_argument("--connect", required=True)
+    parser.add_argument("--id", required=True)
+    parser.add_argument("--secret-file", default=None)
+    parser.add_argument("--reconnect", type=int, default=None)
+    opts = parser.parse_args(argv)
+    host, _, port = opts.connect.rpartition(":")
+    try:
+        if opts.secret_file:
+            secret = fabric_mod.load_secret(opts.secret_file)
+        else:
+            secret = fabric_mod.resolve_secret()
+    except fabric_mod.FleetAuthError as exc:
+        print(f"myth worker: {exc}", file=sys.stderr)
+        return 2
+    retries = (opts.reconnect if opts.reconnect is not None
+               else env_int("MYTHRIL_TPU_FLEET_RECONNECT", 0, floor=0))
+    checkpoint.install_signal_handlers()
+    global _worker_session
+    attempt = 0
+    while True:
+        _worker_session = None
+        code = _worker_connect_once(host or "127.0.0.1", int(port),
+                                    opts.id, secret)
+        if code != _RECONNECT:
+            return code
+        if checkpoint._drain_event.is_set():
+            return 0
+        if _worker_session is not None:
+            # an authenticated session was established and then lost
+            # (coordinator restart): that is progress, not a dead
+            # endpoint — the redial budget meters consecutive failures
+            attempt = 0
+        attempt += 1
+        if attempt > retries:
+            return 0
+        time.sleep(min(5.0, 0.5 * attempt))
 
 
 def reset_fleet_for_tests() -> None:

@@ -46,6 +46,22 @@ from dataclasses import dataclass
 MAX_HEADER_BYTES = 1 << 20
 MAX_BODY_BYTES = 1 << 30
 
+#: default for MYTHRIL_TPU_FLEET_MAX_FRAME — the hard cap a receiver
+#: enforces on the length prefix BEFORE allocating or unpickling
+#: anything.  The prefix arrives from the socket, i.e. from a peer that
+#: may be unauthenticated garbage; trusting it up to MAX_BODY_BYTES is
+#: how a coordinator gets OOMed by one hostile connection.
+DEFAULT_MAX_FRAME = 1 << 27
+
+
+def max_frame_bytes() -> int:
+    """The operator-tunable receive cap (``MYTHRIL_TPU_FLEET_MAX_FRAME``,
+    floor 4096 so the knob cannot brick the control frames)."""
+    from mythril_tpu.support.env import env_int
+
+    return env_int("MYTHRIL_TPU_FLEET_MAX_FRAME", DEFAULT_MAX_FRAME,
+                   floor=4096)
+
 _HEADER_LEN = struct.Struct("!I")
 _BODY_LEN = struct.Struct("!Q")
 
@@ -97,12 +113,18 @@ def stamp_for(ctx, lease_epoch: int) -> Stamp:
 def send_frame(sock, header: dict, body: bytes = b"") -> None:
     """Write one frame.  The caller serializes concurrent senders (the
     worker's heartbeat thread and its analysis thread share one socket
-    under a lock)."""
+    under a lock).  The sender honors the same MAX_FRAME cap the
+    receiver enforces, so an oversized journal fails loudly HERE with a
+    nameable knob instead of striking the peer's seat."""
+    cap = min(MAX_BODY_BYTES, max_frame_bytes())
     head = json.dumps(header).encode("utf-8")
     if len(head) > MAX_HEADER_BYTES:
         raise FrameError(f"header too large ({len(head)} bytes)")
-    if len(body) > MAX_BODY_BYTES:
-        raise FrameError(f"body too large ({len(body)} bytes)")
+    if len(body) > cap:
+        raise FrameError(
+            f"body too large ({len(body)} bytes; "
+            f"MYTHRIL_TPU_FLEET_MAX_FRAME is {cap})"
+        )
     sock.sendall(
         _HEADER_LEN.pack(len(head)) + head + _BODY_LEN.pack(len(body))
         + body
@@ -121,12 +143,16 @@ def _recv_exact(sock, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def recv_frame(sock):
+def recv_frame(sock, max_frame: int = None):
     """Read one frame; returns ``(header_dict, body_bytes)``.  Raises
     :class:`FrameError` on truncation, caps, or a header that is not a
-    JSON object."""
+    JSON object.  Both length prefixes are checked against the
+    MAX_FRAME cap *before any allocation* — the prefix is untrusted
+    input until the peer has authenticated, and stays length-capped
+    even after."""
+    cap = max_frame_bytes() if max_frame is None else max_frame
     (head_len,) = _HEADER_LEN.unpack(_recv_exact(sock, _HEADER_LEN.size))
-    if head_len > MAX_HEADER_BYTES:
+    if head_len > min(MAX_HEADER_BYTES, cap):
         raise FrameError(f"header length {head_len} exceeds cap")
     head = _recv_exact(sock, head_len)
     try:
@@ -136,8 +162,11 @@ def recv_frame(sock):
     if not isinstance(header, dict) or "type" not in header:
         raise FrameError("frame header must be an object with a 'type'")
     (body_len,) = _BODY_LEN.unpack(_recv_exact(sock, _BODY_LEN.size))
-    if body_len > MAX_BODY_BYTES:
-        raise FrameError(f"body length {body_len} exceeds cap")
+    if body_len > min(MAX_BODY_BYTES, cap):
+        raise FrameError(
+            f"body length {body_len} exceeds cap "
+            f"(MYTHRIL_TPU_FLEET_MAX_FRAME={cap})"
+        )
     body = _recv_exact(sock, body_len) if body_len else b""
     return header, body
 

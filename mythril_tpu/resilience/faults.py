@@ -115,6 +115,8 @@ FAULT_POINTS = (
     "worker_kill",
     "gossip_drop",
     "lease_partition",
+    "remote_auth_fail",
+    "frame_corrupt",
 )
 
 DEFAULT_HANG_S = 30.0

@@ -37,7 +37,8 @@ class Ticket:
     """One queued request: the parsed body plus the rendezvous the
     HTTP handler thread blocks on."""
 
-    __slots__ = ("request", "enqueued_at", "done", "response", "status")
+    __slots__ = ("request", "enqueued_at", "done", "response", "status",
+                 "abandoned")
 
     def __init__(self, request: AnalyzeRequest):
         self.request = request
@@ -45,6 +46,9 @@ class Ticket:
         self.done = threading.Event()
         self.response: Optional[dict] = None
         self.status: int = 500
+        #: set by the HTTP handler when the client hangs up — the
+        #: engine skips it, the fabric revokes its lease
+        self.abandoned = threading.Event()
 
     def resolve(self, status: int, response: dict) -> None:
         self.status = status
@@ -168,8 +172,16 @@ class AdmissionQueue:
                 ("overloaded_rss", "resident set above the watermark"),
                 ("breaker_open", "per-source circuit breaker open"),
                 ("draining", "server draining for shutdown"),
+                ("tenant_quota", "per-source analysis-seconds quota "
+                                 "exhausted"),
             )
         }
+        #: fair-share state: requests served per source (halved on
+        #: overflow so ancient history cannot starve a new tenant)
+        self._served = {}
+        #: rolling per-source analysis-seconds: source -> deque of
+        #: (monotonic time, cost_s) inside the quota window
+        self._usage = {}
         _set_active_queue(self, registry)
 
     # -- metrics --------------------------------------------------------
@@ -190,15 +202,47 @@ class AdmissionQueue:
     # -- producer side --------------------------------------------------
 
     def _shed_error(self, reason: str, message: str,
-                    retry_after: Optional[int] = None) -> RequestError:
+                    retry_after: Optional[int] = None,
+                    status: int = 503) -> RequestError:
         self._shed[reason].inc()
         return RequestError(
-            reason, message, status=503,
+            reason, message, status=status,
             retry_after_s=(
                 self.config.retry_after_s
                 if retry_after is None else retry_after
             ),
         )
+
+    #: rolling window the tenant quota is metered over
+    QUOTA_WINDOW_S = 60.0
+
+    def note_usage(self, source: str, cost_s: float) -> None:
+        """Engine-side: charge ``cost_s`` analysis-seconds to a tenant
+        (fed by the ledger-backed per-request wall accounting)."""
+        if not self.config.tenant_quota_s:
+            return
+        with self._lock:
+            window = self._usage.setdefault(source, deque())
+            window.append((time.monotonic(), float(cost_s)))
+
+    def _tenant_spent_s(self, source: str) -> float:
+        """Seconds this source consumed inside the rolling window
+        (caller holds the lock)."""
+        window = self._usage.get(source)
+        if not window:
+            return 0.0
+        horizon = time.monotonic() - self.QUOTA_WINDOW_S
+        while window and window[0][0] < horizon:
+            window.popleft()
+        return sum(cost for _t, cost in window)
+
+    def tenant_usage(self) -> dict:
+        """Per-source window consumption for ``/debug/fleet``."""
+        with self._lock:
+            return {
+                source: round(self._tenant_spent_s(source), 3)
+                for source in list(self._usage)
+            }
 
     def submit(self, request: AnalyzeRequest) -> Ticket:
         """Admit or shed.  Raises :class:`RequestError` (503 + a
@@ -215,6 +259,16 @@ class AdmissionQueue:
                     f"circuit breaker open for source "
                     f"{request.source!r} (consecutive failures)",
                     retry_after=breaker.retry_after_s(),
+                )
+            quota = self.config.tenant_quota_s
+            if quota and self._tenant_spent_s(request.source) >= quota:
+                raise self._shed_error(
+                    "tenant_quota",
+                    f"source {request.source!r} spent its "
+                    f"{quota:g} analysis-seconds for this "
+                    f"{self.QUOTA_WINDOW_S:.0f}s window",
+                    status=429,
+                    retry_after=int(self.QUOTA_WINDOW_S),
                 )
             watermark = self.config.rss_watermark_mb
             if watermark and current_rss_mb() > watermark:
@@ -264,9 +318,35 @@ class AdmissionQueue:
 
     # -- consumer side --------------------------------------------------
 
+    def _pop_fair(self, queue: deque) -> Ticket:
+        """Pop the oldest ticket of the least-served source (caller
+        holds the lock).  With one source queued this is exactly FIFO;
+        with several, a burst tenant cannot starve the others — the
+        per-tenant fair share the fabric's admission edge promises."""
+        first_source = queue[0].request.source
+        if all(t.request.source == first_source for t in queue):
+            ticket = queue.popleft()
+        else:
+            best_index, best_key = 0, None
+            for index, candidate in enumerate(queue):
+                key = (self._served.get(candidate.request.source, 0),
+                       index)
+                if best_key is None or key < best_key:
+                    best_index, best_key = index, key
+            ticket = queue[best_index]
+            del queue[best_index]
+        source = ticket.request.source
+        self._served[source] = self._served.get(source, 0) + 1
+        if self._served[source] > (1 << 20):
+            self._served = {
+                s: count // 2 for s, count in self._served.items()
+            }
+        return ticket
+
     def pop(self, timeout: Optional[float] = None) -> Optional[Ticket]:
-        """Next ticket, interactive class first; None on timeout or
-        when the queue is closed and empty."""
+        """Next ticket, interactive class first, fair-shared across
+        sources within a class; None on timeout or when the queue is
+        closed and empty."""
         with self._ready:
             deadline = (
                 None if timeout is None else time.monotonic() + timeout
@@ -274,7 +354,7 @@ class AdmissionQueue:
             while True:
                 for cls in ("interactive", "batch"):
                     if self._queues[cls]:
-                        return self._queues[cls].popleft()
+                        return self._pop_fair(self._queues[cls])
                 if self._closed:
                     return None
                 remaining = (

@@ -38,10 +38,22 @@ Knobs::
                                       request (parity debugging; the
                                       warm amortization is the point of
                                       the daemon, so default 0)
+    MYTHRIL_TPU_SERVE_TENANT_QUOTA    analysis-seconds one source may
+                                      consume per rolling 60s window
+                                      (429 beyond it; 0 = off, the
+                                      default)
+    MYTHRIL_TPU_FLEET_LISTEN          HOST:PORT the serving fabric's
+                                      coordinator listens on for
+                                      worker attach (``--fleet-listen``
+                                      wins; unset = no fabric)
+    MYTHRIL_TPU_FLEET_SECRET_FILE     shared-secret file for the
+                                      fabric handshake (required for a
+                                      non-loopback listen)
 """
 
 import os
 from dataclasses import dataclass
+from typing import Optional
 
 DEFAULT_PORT = 8551
 
@@ -94,9 +106,13 @@ class ServeConfig:
     breaker_threshold: int = 3
     breaker_cooldown_s: float = 30.0
     cold_per_request: bool = False
+    tenant_quota_s: float = 0.0
+    fleet_listen: Optional[str] = None
+    fleet_secret_file: Optional[str] = None
 
     @classmethod
-    def from_env(cls, host=None, port=None) -> "ServeConfig":
+    def from_env(cls, host=None, port=None, fleet_listen=None,
+                 secret_file=None) -> "ServeConfig":
         config = cls(
             host=host or "127.0.0.1",
             port=DEFAULT_PORT if port is None else int(port),
@@ -124,6 +140,19 @@ class ServeConfig:
             cold_per_request=os.environ.get(
                 "MYTHRIL_TPU_SERVE_COLD", ""
             ).lower() in ("1", "on", "true"),
+            tenant_quota_s=_env_float(
+                "MYTHRIL_TPU_SERVE_TENANT_QUOTA", 0.0
+            ),
+            fleet_listen=(
+                fleet_listen
+                or os.environ.get("MYTHRIL_TPU_FLEET_LISTEN",
+                                  "").strip() or None
+            ),
+            fleet_secret_file=(
+                secret_file
+                or os.environ.get("MYTHRIL_TPU_FLEET_SECRET_FILE",
+                                  "").strip() or None
+            ),
         )
         if config.default_deadline_s > config.max_deadline_s:
             raise ServeConfigError(
@@ -131,7 +160,35 @@ class ServeConfig:
                 f"({config.default_deadline_s}) exceeds "
                 f"MYTHRIL_TPU_SERVE_MAX_DEADLINE ({config.max_deadline_s})"
             )
+        config._validate_fabric()
         return config
+
+    def _validate_fabric(self) -> None:
+        """The serving fabric's startup contract: a parseable listen
+        spec, a readable non-empty secret, and never a routable
+        listener without one (secure-by-default) — all exit 2, before
+        a socket is bound."""
+        from mythril_tpu.parallel import fabric
+
+        if self.fleet_listen is not None:
+            try:
+                host, _port = fabric.parse_listen(self.fleet_listen)
+            except ValueError as exc:
+                raise ServeConfigError(
+                    f"--fleet-listen/MYTHRIL_TPU_FLEET_LISTEN: {exc}"
+                ) from None
+            if (self.fleet_secret_file is None
+                    and not fabric.is_loopback(host)):
+                raise ServeConfigError(
+                    f"fleet listen {self.fleet_listen!r} is not "
+                    "loopback: a secret file is required "
+                    "(--secret-file / MYTHRIL_TPU_FLEET_SECRET_FILE)"
+                )
+        if self.fleet_secret_file is not None:
+            try:
+                fabric.load_secret(self.fleet_secret_file)
+            except fabric.FleetAuthError as exc:
+                raise ServeConfigError(str(exc)) from None
 
 
 def current_rss_mb() -> float:

@@ -57,6 +57,10 @@ class AnalysisEngine:
     def __init__(self, queue: AdmissionQueue, config: ServeConfig):
         self.queue = queue
         self.config = config
+        #: serving-fabric router (serve/fabric.py), attached by the
+        #: server when --fleet-listen is configured; None = every
+        #: request runs in-process
+        self.router = None
         self.requests_done = 0
         self.requests_failed = 0
         self.requests_partial = 0
@@ -200,6 +204,14 @@ class AnalysisEngine:
 
     def _execute(self, ticket: Ticket) -> None:
         request = ticket.request
+        if ticket.abandoned.is_set():
+            # the client hung up while this sat in the queue: spending
+            # engine (or fabric seat) time on it starves live callers
+            ticket.resolve(499, {"error": {
+                "code": "client_gone",
+                "message": "client disconnected while queued",
+            }})
+            return
         rid = uuid.uuid4().hex[:12]
         deadline_s = request.deadline_s or self.config.default_deadline_s
         budget_s = deadline_s - ticket.queued_s()
@@ -245,6 +257,9 @@ class AnalysisEngine:
         self.requests_done += 1
         ok = status < 500
         self.queue.record_outcome(request.source, ok)
+        # charge the tenant's rolling quota window with the wall time
+        # this request actually consumed (in-process or fabric seat)
+        self.queue.note_usage(request.source, elapsed)
         if not ok:
             self.requests_failed += 1
             self._m_failed.inc()
@@ -288,6 +303,21 @@ class AnalysisEngine:
                     budget_s, label=f"{request.source}/{rid}"
                 )
                 try:
+                    if self.router is not None:
+                        # fabric first: a connected seat answers the
+                        # request off-box; None walks the degradation
+                        # ladder down to in-process execution
+                        routed = self.router.execute(
+                            ticket, request, rid, trace_id, budget_s
+                        )
+                        if routed is not None:
+                            status, body = routed
+                            if isinstance(body, dict) and body.get(
+                                "partial"
+                            ):
+                                self.requests_partial += 1
+                                self._m_partial.inc()
+                            return status, body
                     return 200, self._fire(request, rid, budget_s)
                 finally:
                     request_budget.clear_budget()

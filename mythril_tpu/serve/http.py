@@ -30,6 +30,11 @@ Endpoints::
                      counters, cost-model signature buckets, tuner
                      EWMAs/overrides (mythril_tpu/autopilot; what the
                      ``myth top`` autopilot panel renders)
+    GET  /debug/fleet
+                     the serving fabric: coordinator seat/lease table
+                     (serve/fabric.py) plus per-tenant rolling quota
+                     consumption — null fabric when --fleet-listen is
+                     not configured
 
 Shutdown: SIGTERM/SIGINT ride the resilience plane's cooperative drain
 (``install_signal_handlers``).  The serve loop notices, closes
@@ -42,6 +47,8 @@ signal force-exits, as in the CLI.
 
 import json
 import logging
+import select
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -123,6 +130,14 @@ class _Handler(BaseHTTPRequestHandler):
             from mythril_tpu.autopilot import get_autopilot
 
             self._send_json(200, get_autopilot().debug_state())
+        elif path == "/debug/fleet":
+            router = self._srv.router
+            self._send_json(200, {
+                "fabric": (router.debug_status()
+                           if router is not None else None),
+                "tenants": self._srv.queue.tenant_usage(),
+                "tenant_quota_s": self._srv.config.tenant_quota_s,
+            })
         else:
             self._send_json(404, {"error": {
                 "code": "not_found", "message": f"no route {path!r}",
@@ -147,14 +162,37 @@ class _Handler(BaseHTTPRequestHandler):
         deadline_s = (
             request.deadline_s or self._srv.config.default_deadline_s
         )
-        if not ticket.done.wait(deadline_s + _RESPONSE_MARGIN_S):
-            self._send_json(504, {"error": {
-                "code": "engine_timeout",
-                "message": "the analysis engine did not answer within "
-                           "the budget plus margin",
-            }})
-            return
+        deadline = time.monotonic() + deadline_s + _RESPONSE_MARGIN_S
+        # wait in slices so a client hangup is noticed while the
+        # request is queued or executing: the engine skips an
+        # abandoned ticket, the fabric revokes its lease
+        while not ticket.done.wait(1.0):
+            if time.monotonic() >= deadline:
+                self._send_json(504, {"error": {
+                    "code": "engine_timeout",
+                    "message": "the analysis engine did not answer "
+                               "within the budget plus margin",
+                }})
+                return
+            if self._client_gone():
+                ticket.abandoned.set()
+                self.close_connection = True
+                return
         self._send_json(ticket.status, ticket.response)
+
+    def _client_gone(self) -> bool:
+        """True when the client closed its end: a readable socket
+        whose peek returns EOF.  Pipelined bytes (readable, non-empty
+        peek) mean the client is very much alive."""
+        try:
+            readable, _w, _x = select.select(
+                [self.connection], [], [], 0
+            )
+            if not readable:
+                return False
+            return self.connection.recv(1, socket.MSG_PEEK) == b""
+        except (OSError, ValueError):
+            return True
 
     def _read_body(self) -> bytes:
         length = self.headers.get("Content-Length")
@@ -189,6 +227,20 @@ class AnalysisServer:
         self.config = config
         self.queue = AdmissionQueue(config)
         self.engine = AnalysisEngine(self.queue, config)
+        self.router = None
+        if config.fleet_listen is not None:
+            from mythril_tpu.parallel.fleet import _killed
+
+            if _killed():
+                # MYTHRIL_TPU_FLEET=0 is the whole-fabric kill switch:
+                # exactly the single-process serve path, no listener
+                log.warning("serve fabric disabled by "
+                            "MYTHRIL_TPU_FLEET=0; running in-process")
+            else:
+                from mythril_tpu.serve.fabric import FleetRouter
+
+                self.router = FleetRouter(config)
+                self.engine.router = self.router
         self.started_at = time.time()
         self._httpd = ThreadingHTTPServer(
             (config.host, config.port), _Handler
@@ -231,12 +283,16 @@ class AnalysisServer:
                 "failed": self.engine.requests_failed,
                 "partial": self.engine.requests_partial,
             },
+            "fabric": (self.router.summary()
+                       if self.router is not None else None),
         }
         return ready, body
 
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> None:
+        if self.router is not None:
+            self.router.start()
         self.engine.start()
         self._http_thread.start()
         log.info(
@@ -259,6 +315,8 @@ class AnalysisServer:
                 "message": "server is draining for shutdown",
             }})
         self.engine.join(timeout=self.config.max_deadline_s)
+        if self.router is not None:
+            self.router.shutdown()
         from mythril_tpu.observability import finalize_outputs
 
         finalize_outputs()
@@ -283,12 +341,15 @@ class AnalysisServer:
             )
 
 
-def run_server(host: str, port: int) -> int:
+def run_server(host: str, port: int, fleet_listen=None,
+               secret_file=None) -> int:
     """CLI entry (``myth serve``): validate config, start, block until
     drained.  Returns the process exit code."""
     from mythril_tpu.resilience.checkpoint import install_signal_handlers
 
-    config = ServeConfig.from_env(host=host, port=port)
+    config = ServeConfig.from_env(host=host, port=port,
+                                  fleet_listen=fleet_listen,
+                                  secret_file=secret_file)
     install_signal_handlers()
     server = AnalysisServer(config)
     server.serve_until_drained()

@@ -39,7 +39,9 @@ class EnvSpecError(RuntimeError):
     dies loudly instead of silently running a default mid-analysis."""
 
 
-#: name -> (kind, floor, ceil); kind in {"int", "float"}.  Static
+#: name -> (kind, floor, ceil); kind in {"int", "float", "listen",
+#: "file"}.  "listen" validates a HOST:PORT spec and "file" an
+#: existing non-empty file (floor/ceil unused for both).  Static
 #: entries cover knobs whose owning module may not have imported by
 #: validation time; env_int/env_float self-register the rest.
 KNOWN_SPECS: Dict[str, Tuple[str, Optional[float], Optional[float]]] = {
@@ -59,6 +61,18 @@ KNOWN_SPECS: Dict[str, Tuple[str, Optional[float], Optional[float]]] = {
     "MYTHRIL_TPU_SEG_MIN_LANES": ("int", 1, None),
     "MYTHRIL_TPU_SEG_MAX_OPS": ("int", 1, None),
     "MYTHRIL_TPU_SEG_CEIL_MS": ("float", 0.0, None),
+    "MYTHRIL_TPU_FLEET_HEARTBEAT_S": ("float", 0.05, None),
+    "MYTHRIL_TPU_FLEET_LEASE_TTL_S": ("float", 0.1, None),
+    "MYTHRIL_TPU_FLEET_SPLIT_AFTER_S": ("float", 0.0, None),
+    "MYTHRIL_TPU_FLEET_LEASE_RETRIES": ("int", 0, None),
+    "MYTHRIL_TPU_FLEET_SPAWN_RETRIES": ("int", 0, None),
+    "MYTHRIL_TPU_FLEET_CONNECT_TIMEOUT_S": ("float", 0.1, None),
+    "MYTHRIL_TPU_FLEET_HARD_CAP_S": ("float", 0.1, None),
+    "MYTHRIL_TPU_FLEET_MAX_FRAME": ("int", 4096, None),
+    "MYTHRIL_TPU_FLEET_RECONNECT": ("int", 0, None),
+    "MYTHRIL_TPU_FLEET_LISTEN": ("listen", None, None),
+    "MYTHRIL_TPU_FLEET_SECRET_FILE": ("file", None, None),
+    "MYTHRIL_TPU_SERVE_TENANT_QUOTA": ("float", 0.0, None),
 }
 
 _registered: Dict[str, Tuple[str, Optional[float], Optional[float]]] = {}
@@ -132,6 +146,22 @@ def validate_env(environ=None) -> None:
         if raw is None or raw.strip() == "":
             continue
         kind, floor, ceil = specs[name]
+        if kind == "listen":
+            from mythril_tpu.parallel.fabric import parse_listen
+
+            try:
+                parse_listen(raw)
+            except ValueError as exc:
+                raise EnvSpecError(f"{name}={raw!r}: {exc}") from None
+            continue
+        if kind == "file":
+            if not os.path.isfile(raw):
+                raise EnvSpecError(
+                    f"{name}={raw!r}: file does not exist"
+                )
+            if os.path.getsize(raw) == 0:
+                raise EnvSpecError(f"{name}={raw!r}: file is empty")
+            continue
         try:
             value = int(raw) if kind == "int" else float(raw)
         except ValueError:

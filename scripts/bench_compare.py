@@ -71,8 +71,12 @@ GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
 #: batched segments): per-opcode overhead creeping into the segment
 #: loop, or the autopilot declining shapes it used to run, shows up
 #: here before t3_wall_s moves
+#: fabric_cpm gates the serving fabric's sustained contracts/min
+#: through one authenticated remote seat (serve/fabric.py): handshake,
+#: per-frame MAC, journal-over-the-wire, or router overhead creeping
+#: into the request path shows up here first
 GATED_HIGHER_BETTER = ("serve_cpm", "microbench_device_vs_host",
-                       "fleet_speedup", "states_per_s")
+                       "fleet_speedup", "states_per_s", "fabric_cpm")
 #: floor below which a baseline is noise and ratios are meaningless
 MIN_BASE = 0.05
 

@@ -46,6 +46,17 @@ request unaffected, and (5) queue-overflow shedding (depth cap 1) with
 subprocess with scenario-specific env; exit status is nonzero when any
 scenario failed.
 
+``--multihost`` soaks the serving fabric (serve/fabric.py): one
+``myth serve --fleet-listen`` endpoint on a non-loopback interface
+fronting two authenticated ``myth worker --connect`` processes.  The
+corpus must answer with findings parity THROUGH the fabric (``mode:
+fabric``, routed >= 1), a worker SIGKILL mid-request must be invisible
+to the HTTP client (re-lease from the boundary journal), a hostile
+unauthenticated peer must bounce off the handshake while service
+continues, a coordinator SIGKILL+restart must be healed by the
+workers' ``--reconnect`` redial, and ``MYTHRIL_TPU_FLEET=0`` must
+yield the exact single-process serve path.
+
 Exit status is nonzero when any round broke findings parity, so the
 script doubles as a soak gate before hardware rounds.
 """
@@ -316,8 +327,8 @@ def _http(method, url, payload=None, timeout=240):
 class _ServeChild:
     """One ``myth serve`` subprocess on an ephemeral port."""
 
-    def __init__(self, extra_env=None):
-        self.port = _free_port()
+    def __init__(self, extra_env=None, extra_args=None, port=None):
+        self.port = port or _free_port()
         self.base = f"http://127.0.0.1:{self.port}"
         env = dict(os.environ)
         env.pop("MYTHRIL_TPU_FAULT", None)
@@ -328,7 +339,8 @@ class _ServeChild:
             "myth",
         )
         self.proc = subprocess.Popen(
-            [sys.executable, myth, "serve", "--port", str(self.port)],
+            [sys.executable, myth, "serve", "--port", str(self.port)]
+            + list(extra_args or ()),
             env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         )
 
@@ -545,6 +557,269 @@ def serve_soak_main() -> int:
 
 
 # ---------------------------------------------------------------------------
+# --multihost: soak the serving fabric (serve + remote workers)
+# ---------------------------------------------------------------------------
+
+
+def _routable_ip():
+    """A non-loopback address of this host (the fabric listen target),
+    or None when the host has only loopback."""
+    import socket
+
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as sock:
+            sock.connect(("10.255.255.255", 1))  # no packet is sent
+            ip = sock.getsockname()[0]
+        return None if ip.startswith("127.") else ip
+    except OSError:
+        return None
+
+
+class _WorkerChild:
+    """One ``myth worker --connect`` subprocess."""
+
+    def __init__(self, connect, secret_file, reconnect=60):
+        myth = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "myth",
+        )
+        env = dict(os.environ)
+        env.pop("MYTHRIL_TPU_FAULT", None)
+        env.pop("MYTHRIL_TPU_KILL_AT", None)
+        self.proc = subprocess.Popen(
+            [sys.executable, myth, "worker", "--connect", connect,
+             "--secret-file", secret_file,
+             "--reconnect", str(reconnect)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+
+    def sigkill(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=30)
+
+    def stop(self):
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+
+
+def _wait_seats(base, want, timeout_s=SERVE_READY_TIMEOUT_S):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        status, body, _ = _http("GET", base + "/debug/fleet", timeout=5)
+        fabric = (body or {}).get("fabric") or {}
+        if status == 200 and fabric.get("seats", 0) >= want:
+            return True
+        time.sleep(0.5)
+    return False
+
+
+def multihost_soak_main() -> int:
+    """The --multihost driver: one ``myth serve`` endpoint fronting a
+    >=2-process fleet on a non-loopback listener with an authenticated
+    handshake.  Worker SIGKILL mid-request, a hostile unauthenticated
+    peer, and a coordinator SIGKILL+restart must all be invisible to
+    clients at findings parity; ``MYTHRIL_TPU_FLEET=0`` must yield the
+    exact single-process path."""
+    import threading
+
+    import bench
+
+    failures = []
+
+    def check(scenario, ok, **detail):
+        row = {"scenario": scenario, "ok": bool(ok), **detail}
+        print(json.dumps(row))
+        if not ok:
+            failures.append(row)
+
+    ip = _routable_ip()
+    if ip is None:
+        # loopback-only host: the fabric still runs authenticated, the
+        # non-loopback bind refusal is covered by tests/test_fabric.py
+        print(json.dumps({"note": "no routable interface; running the "
+                          "fabric on loopback"}), file=sys.stderr)
+        ip = "127.0.0.1"
+    secret_path = tempfile.mktemp(prefix="mtpu-secret-")
+    with open(secret_path, "w") as fh:
+        fh.write("%032x\n" % random.SystemRandom().getrandbits(128))
+
+    print("multihost soak: computing in-process CLI reference ...",
+          file=sys.stderr)
+    reference = _serve_reference()
+    print(json.dumps({"reference": reference}), file=sys.stderr)
+    corpus = {name: (code, tx) for name, code, tx, _ in bench._corpus()}
+
+    fleet_port = _free_port()
+    connect = f"{ip}:{fleet_port}"
+    serve_args = ["--fleet-listen", connect,
+                  "--secret-file", secret_path]
+    child = _ServeChild(extra_args=serve_args)
+    workers = [_WorkerChild(connect, secret_path) for _ in range(2)]
+    try:
+        check("fabric_server_ready", child.wait_ready())
+        check("two_remote_seats_attached",
+              _wait_seats(child.base, want=2), listen=connect)
+
+        # -- scenario 1: findings parity through the fabric ------------
+        parity = {}
+        modes = {}
+        for name, (code, tx_count) in corpus.items():
+            status, body, _ = child.analyze({
+                "code": code, "name": name, "tx_count": tx_count,
+                "deadline_s": 240, "source": "soak",
+            })
+            parity[name] = (
+                status == 200
+                and body.get("findings_swc") == reference[name]
+            )
+            modes[name] = body.get("mode") if body else None
+        _s, fleet_body, _h = _http("GET", child.base + "/debug/fleet")
+        routed = ((fleet_body or {}).get("fabric") or {}).get("routed", 0)
+        check("fabric_findings_parity",
+              all(parity.values()) and routed >= 1,
+              per_contract=parity, modes=modes, routed=routed)
+
+        # -- scenario 2: SIGKILL a worker mid-request ------------------
+        tree = bench.chaos_tree_contract()
+        result = {}
+
+        def _fire():
+            result["resp"] = child.analyze({
+                "code": tree, "name": "chaos_tree", "tx_count": 2,
+                "deadline_s": 240, "source": "soak",
+            })
+
+        thread = threading.Thread(target=_fire)
+        thread.start()
+        time.sleep(2.0)  # let the lease land on a seat
+        workers[0].sigkill()
+        thread.join(timeout=300)
+        status, body, _ = result.get("resp", (0, None, None))
+        check(
+            "worker_sigkill_invisible_to_client",
+            status == 200 and body is not None
+            and body.get("findings_swc") is not None,
+            status=status,
+            found=body.get("findings_swc") if body else None,
+            mode=body.get("mode") if body else None,
+        )
+
+        # -- scenario 3: hostile unauthenticated peer ------------------
+        import socket as socket_mod
+
+        for payload in (b"\x00" * 64, b"GET / HTTP/1.1\r\n\r\n",
+                        b"\xff" * 4096):
+            try:
+                with socket_mod.create_connection(
+                    (ip, fleet_port), timeout=5
+                ) as hostile:
+                    hostile.sendall(payload)
+                    hostile.settimeout(5)
+                    try:
+                        hostile.recv(4096)
+                    except OSError:
+                        pass
+            except OSError:
+                pass
+        status, ready, _ = _http("GET", child.base + "/readyz")
+        code, tx_count = corpus["killbilly"]
+        astatus, abody, _ = child.analyze({
+            "code": code, "name": "killbilly", "tx_count": tx_count,
+            "deadline_s": 240, "source": "soak",
+        })
+        check(
+            "hostile_peer_rejected_service_continues",
+            status == 200 and ready.get("ready") is True
+            and astatus == 200
+            and abody.get("findings_swc") == reference["killbilly"],
+            ready=status,
+        )
+    finally:
+        child.stop()
+
+    # -- scenario 4: coordinator SIGKILL mid-request, restart, workers
+    # redial (--reconnect), parity re-asserted on the same ports ------
+    serve_port = _free_port()
+    child = _ServeChild(extra_args=serve_args, port=serve_port)
+    try:
+        check("restart_fabric_ready", child.wait_ready())
+        _wait_seats(child.base, want=1)
+        result = {}
+        tree = bench.chaos_tree_contract()
+
+        def _doomed():
+            result["resp"] = _http(
+                "POST", child.base + "/analyze",
+                {"code": tree, "name": "chaos_tree", "tx_count": 2,
+                 "deadline_s": 240, "source": "soak"},
+                timeout=60,
+            )
+
+        thread = threading.Thread(target=_doomed)
+        thread.start()
+        time.sleep(1.0)
+        child.sigkill()  # the coordinator dies mid-request
+        thread.join(timeout=90)
+    finally:
+        child.stop()
+    child = _ServeChild(extra_args=serve_args, port=serve_port)
+    try:
+        ready_again = child.wait_ready()
+        seats_again = _wait_seats(child.base, want=1)
+        code, tx_count = corpus["killbilly"]
+        status, body, _ = child.analyze({
+            "code": code, "name": "killbilly", "tx_count": tx_count,
+            "deadline_s": 240, "source": "soak",
+        })
+        check(
+            "coordinator_restart_workers_redial_parity",
+            ready_again and seats_again and status == 200
+            and body.get("findings_swc") == reference["killbilly"],
+            ready=ready_again, seats=seats_again, status=status,
+        )
+    finally:
+        child.stop()
+        for worker in workers:
+            worker.stop()
+
+    # -- scenario 5: kill switch => exact single-process serve path ---
+    child = _ServeChild(extra_args=serve_args,
+                        extra_env={"MYTHRIL_TPU_FLEET": "0"})
+    try:
+        ready = child.wait_ready()
+        _s, rbody, _h = _http("GET", child.base + "/readyz")
+        code, tx_count = corpus["killbilly"]
+        status, body, _ = child.analyze({
+            "code": code, "name": "killbilly", "tx_count": tx_count,
+            "deadline_s": 240, "source": "soak",
+        })
+        check(
+            "kill_switch_single_process_path",
+            ready and (rbody or {}).get("fabric") is None
+            and status == 200
+            and body.get("findings_swc") == reference["killbilly"]
+            and body.get("mode") != "fabric",
+            mode=body.get("mode") if body else None,
+        )
+    finally:
+        child.stop()
+        os.unlink(secret_path)
+
+    if failures:
+        print(json.dumps({"multihost_soak_failures": failures}))
+        return 1
+    print(json.dumps({"multihost_soak_ok": True, "scenarios": 6}))
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # --fleet: soak the frontier fleet
 # ---------------------------------------------------------------------------
 
@@ -698,6 +973,13 @@ def main() -> int:
                         "partition => stale-epoch fencing, gossip "
                         "loss, and the single-process kill switch — "
                         "findings parity asserted every round")
+    parser.add_argument("--multihost", action="store_true",
+                        help="soak the serving fabric: `myth serve` "
+                        "fronting >=2 authenticated `myth worker` "
+                        "processes on a non-loopback listener — "
+                        "worker SIGKILL, hostile peer, coordinator "
+                        "SIGKILL+restart, and the fleet kill switch, "
+                        "all at findings parity")
     parser.add_argument("--kr-child", action="store_true",
                         help=argparse.SUPPRESS)
     parser.add_argument("--kr-dir", default=None, help=argparse.SUPPRESS)
@@ -712,6 +994,8 @@ def main() -> int:
         return serve_soak_main()
     if args_ns.fleet:
         return fleet_soak_main()
+    if args_ns.multihost:
+        return multihost_soak_main()
     rng = random.Random(args_ns.seed)
 
     import logging

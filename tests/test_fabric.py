@@ -1,0 +1,561 @@
+"""Serving-fabric tests: the authenticated multi-host boundary
+(``parallel/fabric.py``), the coordinator's handshake/strike path, the
+MAX_FRAME receive cap, journal-over-the-wire, request-scoped lease
+revocation, and the serve plane's tenant fairness + quota.
+
+Marker ``fleet`` (tier-1, CPU-only).  Handshake tests run a real
+coordinator listener on loopback and dial it with raw sockets — no
+subprocesses; everything else drives the primitives directly.
+"""
+
+import hashlib
+import pickle
+import socket
+import struct
+import time
+
+import pytest
+
+from mythril_tpu.parallel import fabric, fleet
+from mythril_tpu.parallel.coordinator import (
+    DONE, RUNNING, Coordinator, FleetConfig,
+)
+from mythril_tpu.parallel.fabric import (
+    AuthedChannel, FleetAuthError, client_handshake, frame_mac,
+    hello_mac, pack_journal, unpack_journal,
+)
+from mythril_tpu.parallel.gossip import (
+    FrameError, recv_frame, send_frame,
+)
+
+pytestmark = pytest.mark.fleet
+
+SECRET = b"fabric-test-secret-0123456789abcdef"
+
+
+@pytest.fixture(autouse=True)
+def _clean_stats():
+    from mythril_tpu.resilience import faults
+
+    faults.reset_for_tests()
+    fleet.fleet_stats.reset()
+    yield
+    faults.reset_for_tests()
+    fleet.fleet_stats.reset()
+
+
+def _wait(predicate, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return predicate()
+
+
+@pytest.fixture
+def listening(request):
+    """A real coordinator listener on loopback with the shared test
+    secret; yields ``(coordinator, port)``."""
+    config = FleetConfig(workers=0, listen_host="127.0.0.1",
+                         listen_port=0, secret=SECRET,
+                         connect_timeout_s=5.0)
+    coordinator = Coordinator(config, {"name": "fabric-test"},
+                              spawner=lambda *a, **k: None)
+    port = coordinator.open_listener()
+    yield coordinator, port
+    coordinator.close_listener()
+
+
+def _dial(port):
+    conn = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+    conn.settimeout(5.0)
+    return conn
+
+
+# ---------------------------------------------------------------------------
+# configuration primitives
+# ---------------------------------------------------------------------------
+
+
+def test_parse_listen_and_loopback():
+    assert fabric.parse_listen("10.0.0.1:4900") == ("10.0.0.1", 4900)
+    assert fabric.parse_listen("[::1]:80") == ("[::1]", 80)
+    for bad in ("nocolon", ":4900", "h:notaport", "h:70000"):
+        with pytest.raises(ValueError):
+            fabric.parse_listen(bad)
+    assert fabric.is_loopback("127.0.0.1")
+    assert fabric.is_loopback("localhost")
+    assert not fabric.is_loopback("10.1.2.3")
+    # an unresolvable hostname is assumed routable: secure-by-default
+    assert not fabric.is_loopback("fleet.internal")
+
+
+def test_load_secret_rules(tmp_path):
+    with pytest.raises(FleetAuthError):
+        fabric.load_secret(str(tmp_path / "missing"))
+    empty = tmp_path / "empty"
+    empty.write_bytes(b"  \n")
+    with pytest.raises(FleetAuthError):
+        fabric.load_secret(str(empty))
+    good = tmp_path / "good"
+    good.write_bytes(b"  s3cret\n")
+    assert fabric.load_secret(str(good)) == b"s3cret"
+
+
+def test_non_loopback_listen_refused_without_secret():
+    config = FleetConfig(workers=0, listen_host="203.0.113.7",
+                         listen_port=0, secret=None)
+    coordinator = Coordinator(config, {"name": "t"},
+                              spawner=lambda *a, **k: None)
+    with pytest.raises(FleetAuthError):
+        coordinator.open_listener()
+
+
+def test_serve_config_fabric_validation(tmp_path, monkeypatch):
+    from mythril_tpu.serve.config import ServeConfig, ServeConfigError
+
+    monkeypatch.delenv("MYTHRIL_TPU_FLEET_SECRET_FILE", raising=False)
+    monkeypatch.delenv("MYTHRIL_TPU_FLEET_LISTEN", raising=False)
+    # routable listen without a secret: refused before any bind
+    with pytest.raises(ServeConfigError):
+        ServeConfig.from_env(fleet_listen="203.0.113.7:4900")
+    # malformed listen spec: refused
+    with pytest.raises(ServeConfigError):
+        ServeConfig.from_env(fleet_listen="not-a-spec")
+    # empty secret file: refused
+    empty = tmp_path / "empty"
+    empty.write_bytes(b"")
+    with pytest.raises(ServeConfigError):
+        ServeConfig.from_env(fleet_listen="127.0.0.1:0",
+                             secret_file=str(empty))
+    # routable + a real secret: accepted
+    good = tmp_path / "secret"
+    good.write_bytes(b"s3cret\n")
+    config = ServeConfig.from_env(fleet_listen="203.0.113.7:4900",
+                                  secret_file=str(good))
+    assert config.fleet_listen == "203.0.113.7:4900"
+
+
+def test_validate_env_fabric_kinds(tmp_path, monkeypatch):
+    from mythril_tpu.support.env import EnvSpecError, validate_env
+
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET_LISTEN", "nocolon")
+    with pytest.raises(EnvSpecError):
+        validate_env()
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET_LISTEN", "10.0.0.1:4900")
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET_SECRET_FILE",
+                       str(tmp_path / "missing"))
+    with pytest.raises(EnvSpecError):
+        validate_env()
+    empty = tmp_path / "empty"
+    empty.write_bytes(b"")
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET_SECRET_FILE", str(empty))
+    with pytest.raises(EnvSpecError):
+        validate_env()
+    good = tmp_path / "secret"
+    good.write_bytes(b"s3cret\n")
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET_SECRET_FILE", str(good))
+    validate_env()  # both knobs well-formed: no raise
+
+
+# ---------------------------------------------------------------------------
+# handshake against a live listener
+# ---------------------------------------------------------------------------
+
+
+def test_handshake_mutual_auth_attaches_remote_seat(listening):
+    coordinator, port = listening
+    conn = _dial(port)
+    try:
+        channel = client_handshake(conn, SECRET, "remote-w1")
+        assert channel.key is not None
+        assert _wait(lambda: "remote-w1" in coordinator.seats)
+        assert fleet.fleet_stats.remote_attaches == 1
+        assert fleet.fleet_stats.auth_rejects == 0
+    finally:
+        conn.close()
+
+
+def test_wrong_secret_rejected(listening):
+    coordinator, port = listening
+    conn = _dial(port)
+    try:
+        with pytest.raises(FleetAuthError):
+            client_handshake(conn, b"the-wrong-secret", "intruder")
+    finally:
+        conn.close()
+    assert _wait(lambda: fleet.fleet_stats.auth_rejects == 1)
+    assert "intruder" not in coordinator.seats
+
+
+def test_unauthenticated_hello_rejected(listening):
+    """A legacy bare hello (no secret configured client-side) against a
+    secreted coordinator authenticates nothing and attaches nothing."""
+    coordinator, port = listening
+    conn = _dial(port)
+    try:
+        client_handshake(conn, None, "legacy")  # fire-and-forget hello
+        assert _wait(lambda: fleet.fleet_stats.auth_rejects == 1)
+    finally:
+        conn.close()
+    assert "legacy" not in coordinator.seats
+
+
+def test_replayed_hello_nonce_rejected(listening):
+    """A captured hello nonce must not authenticate twice, even under a
+    fresh challenge with a valid MAC (belt-and-braces on top of
+    challenge freshness)."""
+    import secrets as secrets_mod
+
+    coordinator, port = listening
+    nonce = secrets_mod.token_hex(fabric.NONCE_BYTES)
+    conn1 = _dial(port)
+    try:
+        header, _ = recv_frame(conn1)
+        assert header["type"] == "challenge"
+        send_frame(conn1, {
+            "type": "hello", "worker_id": "w1", "nonce": nonce,
+            "mac": hello_mac(SECRET, header["nonce"], nonce, "w1"),
+        })
+        answer, _ = recv_frame(conn1)
+        assert answer["type"] == "welcome"
+    finally:
+        conn1.close()
+    conn2 = _dial(port)
+    try:
+        header, _ = recv_frame(conn2)
+        send_frame(conn2, {
+            "type": "hello", "worker_id": "w2", "nonce": nonce,
+            "mac": hello_mac(SECRET, header["nonce"], nonce, "w2"),
+        })
+        answer, _ = recv_frame(conn2)
+        assert answer["type"] == "reject"
+        assert answer["code"] == "auth_failed"
+    finally:
+        conn2.close()
+    assert _wait(lambda: fleet.fleet_stats.auth_rejects == 1)
+    assert "w2" not in coordinator.seats
+
+
+def test_tampered_frame_strikes_seat(listening):
+    coordinator, port = listening
+    conn = _dial(port)
+    try:
+        client_handshake(conn, SECRET, "w-tamper")
+        assert _wait(lambda: "w-tamper" in coordinator.seats)
+        # bypass the channel: a frame whose MAC does not verify
+        send_frame(conn, {"type": "heartbeat", "seq": 1,
+                          "mac": "deadbeef"})
+        assert _wait(lambda: fleet.fleet_stats.frame_rejects >= 1)
+        # the reader loop queued a disconnect for the state machine
+        assert _wait(lambda: any(
+            h.get("type") == "disconnect"
+            for _w, h, _b in list(coordinator.inbox.queue)
+        ))
+    finally:
+        conn.close()
+
+
+def test_frame_fuzz_then_good_connection(listening):
+    """Garbage, an HTTP probe, and a truncated frame each strike and
+    reject without crashing the accept loop; a well-formed
+    authenticated attach afterwards still succeeds."""
+    coordinator, port = listening
+    for payload in (b"\x00" * 64,
+                    b"GET / HTTP/1.1\r\nHost: x\r\n\r\n",
+                    struct.pack("!I", 1 << 28)):
+        conn = _dial(port)
+        try:
+            recv_frame(conn)  # drain the challenge
+            conn.sendall(payload)
+            try:
+                conn.shutdown(socket.SHUT_WR)
+                recv_frame(conn)  # reject frame or EOF
+            except (FrameError, OSError):
+                pass  # peer may already have struck and closed
+        finally:
+            conn.close()
+    assert _wait(
+        lambda: (fleet.fleet_stats.frame_rejects
+                 + fleet.fleet_stats.auth_rejects) >= 3
+    )
+    conn = _dial(port)
+    try:
+        client_handshake(conn, SECRET, "w-after-fuzz")
+        assert _wait(lambda: "w-after-fuzz" in coordinator.seats)
+    finally:
+        conn.close()
+
+
+# ---------------------------------------------------------------------------
+# the authenticated channel itself (no sockets beyond a socketpair)
+# ---------------------------------------------------------------------------
+
+
+def _channel_pair():
+    a, b = socket.socketpair()
+    key = hashlib.sha256(b"chan").digest()
+    sender = AuthedChannel(a, key, send_label="w", recv_label="c")
+    receiver = AuthedChannel(b, key, send_label="c", recv_label="w")
+    return a, b, key, sender, receiver
+
+
+def test_authed_channel_roundtrip_and_replay():
+    a, b, key, sender, receiver = _channel_pair()
+    try:
+        sender.send({"type": "x"}, b"body")
+        header, body = receiver.recv()
+        assert header["type"] == "x" and body == b"body"
+        # replay: a re-sent copy of frame seq=1 (valid MAC) must not
+        # land a second time
+        replay = {"type": "x", "seq": 1}
+        replay["mac"] = frame_mac(key, "w", 1, replay, b"body")
+        send_frame(a, replay, b"body")
+        with pytest.raises(FleetAuthError):
+            receiver.recv()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_authed_channel_rejects_tamper_and_reflection():
+    a, b, key, sender, receiver = _channel_pair()
+    try:
+        # tampered body: MAC computed over different bytes
+        forged = {"type": "x", "seq": 1}
+        forged["mac"] = frame_mac(key, "w", 1, forged, b"good")
+        send_frame(a, forged, b"evil")
+        with pytest.raises(FleetAuthError):
+            receiver.recv()
+    finally:
+        a.close()
+        b.close()
+    # reflection: a frame MAC'd with the receiver's own send label
+    # must not verify (direction labels are part of the MAC)
+    a, b, key, sender, receiver = _channel_pair()
+    try:
+        reflected = {"type": "x", "seq": 1}
+        reflected["mac"] = frame_mac(key, "c", 1, reflected, b"")
+        send_frame(a, reflected)
+        with pytest.raises(FleetAuthError):
+            receiver.recv()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_max_frame_cap_enforced_before_allocation(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET_MAX_FRAME", "4096")
+    a, b = socket.socketpair()
+    try:
+        # a body length prefix past the cap raises BEFORE any body
+        # bytes exist to read — nothing is allocated or unpickled
+        head = b'{"type": "x"}'
+        a.sendall(struct.pack("!I", len(head)) + head
+                  + struct.pack("!Q", 10_000_000))
+        with pytest.raises(FrameError, match="MAX_FRAME"):
+            recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+    a, b = socket.socketpair()
+    try:
+        # an oversized header length prefix likewise
+        a.sendall(struct.pack("!I", 1 << 28))
+        with pytest.raises(FrameError, match="header length"):
+            recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+    # the sender enforces the same cap, naming the knob
+    a, b = socket.socketpair()
+    try:
+        with pytest.raises(FrameError, match="MAX_FRAME"):
+            send_frame(a, {"type": "x"}, b"\x00" * 5000)
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# journal-over-the-wire
+# ---------------------------------------------------------------------------
+
+
+def _real_states(n):
+    from mythril_tpu.laser.ethereum.state.world_state import WorldState
+
+    return [WorldState() for _ in range(n)]
+
+
+def test_pack_unpack_journal_roundtrip(tmp_path):
+    from mythril_tpu.resilience.checkpoint import load_journal
+
+    source = str(tmp_path / "src")
+    fleet._write_lease_journal(source, address=0xABC, tx_index=1,
+                               transaction_count=2,
+                               states=_real_states(2))
+    blob = pack_journal(source)
+    target = str(tmp_path / "dst")
+    assert unpack_journal(blob, target) >= 1
+    payload = load_journal(target)
+    assert payload is not None
+    assert payload["tx_index"] == 1
+    assert len(payload["open_states"]) == 2
+    # an empty/missing dir packs to an empty mapping: fresh start
+    assert unpack_journal(pack_journal(str(tmp_path / "nowhere")),
+                          str(tmp_path / "fresh")) == 0
+
+
+def test_unpack_journal_sanitizes_names(tmp_path):
+    target = tmp_path / "jail"
+    blob = pickle.dumps({
+        "../escape.bin": b"evil",
+        "ok.bin": b"fine",
+        "": b"dropped",
+        "notbytes": "dropped too",
+    })
+    assert unpack_journal(blob, str(target)) == 2
+    assert sorted(p.name for p in target.iterdir()) == [
+        "escape.bin", "ok.bin",
+    ]
+    assert not (tmp_path / "escape.bin").exists()
+    with pytest.raises(FrameError):
+        unpack_journal(pickle.dumps([1, 2]), str(target))
+
+
+# ---------------------------------------------------------------------------
+# request-scoped revocation (the serve plane's client-abort path)
+# ---------------------------------------------------------------------------
+
+
+class _FakeHandle:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, header, body=b""):
+        self.sent.append((header, body))
+        return True
+
+    def drain(self):
+        pass
+
+    def kill(self):
+        pass
+
+
+def test_cancel_lease_fences_epoch(tmp_path):
+    config = FleetConfig(workers=1)
+    handles = []
+
+    def spawner(worker_id, respawn):
+        handle = _FakeHandle()
+        handles.append(handle)
+        return handle
+
+    coordinator = Coordinator(config, {"name": "t"}, spawner=spawner)
+    directory = str(tmp_path / "lease")
+    fleet._write_lease_journal(directory, address=1, tx_index=0,
+                               transaction_count=1,
+                               states=_real_states(1))
+    lease = coordinator.add_lease(directory, tx_index=0, n_states=1)
+    coordinator._new_seat()
+    coordinator.assign()
+    assert lease.state == RUNNING
+    holder = lease.worker_id
+    assert coordinator.cancel_lease(lease.lease_id,
+                                    reason="client abandoned")
+    assert lease.state == DONE and lease.result["cancelled"]
+    assert lease.epoch == 1
+    revokes = [h for h, _ in handles[0].sent if h["type"] == "revoke"]
+    assert revokes and revokes[0]["lease_id"] == lease.lease_id
+    # the holder's seat is free for the next request immediately
+    assert coordinator.seats[holder].lease_id is None
+    # an in-flight result from the revoked holder is fenced, not merged
+    coordinator.handle_message(
+        holder,
+        {"type": "result", "lease_id": lease.lease_id,
+         "stamp": {"lease_epoch": 0}, "found_swcs": ["999"]}, b"",
+    )
+    assert lease.result["found_swcs"] == []
+    assert fleet.fleet_stats.gossip_dropped_stale == 1
+    # cancelling a settled lease is a no-op
+    assert not coordinator.cancel_lease(lease.lease_id)
+
+
+# ---------------------------------------------------------------------------
+# tenant fairness + quota at the admission edge
+# ---------------------------------------------------------------------------
+
+
+def _submit(queue, source):
+    from mythril_tpu.serve.protocol import AnalyzeRequest
+
+    return queue.submit(AnalyzeRequest(code="6080", source=source))
+
+
+def test_fair_share_pop_interleaves_tenants():
+    from mythril_tpu.serve.admission import AdmissionQueue
+    from mythril_tpu.serve.config import ServeConfig
+
+    queue = AdmissionQueue(ServeConfig())
+    for source in ("A", "A", "A", "B"):
+        _submit(queue, source)
+    order = [queue.pop(timeout=0).request.source for _ in range(4)]
+    # the burst tenant cannot starve the late one...
+    assert order == ["A", "B", "A", "A"]
+    # ...and a single-tenant queue is exactly FIFO
+    tickets = [_submit(queue, "solo") for _ in range(3)]
+    popped = [queue.pop(timeout=0) for _ in range(3)]
+    assert popped == tickets
+
+
+def test_tenant_quota_sheds_429():
+    from mythril_tpu.serve.admission import AdmissionQueue
+    from mythril_tpu.serve.config import ServeConfig
+    from mythril_tpu.serve.protocol import RequestError
+
+    queue = AdmissionQueue(ServeConfig(tenant_quota_s=1.0))
+    queue.note_usage("greedy", 5.0)
+    with pytest.raises(RequestError) as excinfo:
+        _submit(queue, "greedy")
+    assert excinfo.value.status == 429
+    assert excinfo.value.code == "tenant_quota"
+    # other tenants are untouched; the spent window is introspectable
+    _submit(queue, "modest")
+    assert queue.tenant_usage()["greedy"] == pytest.approx(5.0)
+
+
+# ---------------------------------------------------------------------------
+# serve-plane kill switch
+# ---------------------------------------------------------------------------
+
+
+def test_serve_kill_switch_disables_fabric(tmp_path, monkeypatch):
+    from mythril_tpu.serve.config import ServeConfig
+    from mythril_tpu.serve.http import AnalysisServer
+
+    secret = tmp_path / "secret"
+    secret.write_bytes(b"s3cret\n")
+    config = ServeConfig(host="127.0.0.1", port=0,
+                         fleet_listen="127.0.0.1:0",
+                         fleet_secret_file=str(secret))
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET", "0")
+    server = AnalysisServer(config)
+    try:
+        # the exact single-process path: no router, no listener
+        assert server.router is None
+        assert server.engine.router is None
+    finally:
+        server._httpd.server_close()
+    monkeypatch.delenv("MYTHRIL_TPU_FLEET")
+    server = AnalysisServer(config)
+    try:
+        assert server.router is not None
+        assert server.engine.router is server.router
+        server.router.start()
+        assert server.router.seat_count() == 0
+    finally:
+        server.router.shutdown()
+        server._httpd.server_close()
