@@ -478,6 +478,13 @@ def _analyze_one(name, code, tx_count, execution_timeout, max_depth):
         **split,
         "other_s": round(max(0.0, wall - accounted), 2),
         **dd,
+        # resident-solver headline: device kernel invocations this
+        # analysis (dispatch_stats resets per contract, so the row-
+        # level ratio IS the raw counter; the summary divides the
+        # run-wide total by the analysis count).  The resident kernel
+        # collapses the whole round ladder into one dispatch, so this
+        # is the number its >=10x claim is judged on
+        "dispatches_per_analysis": dd.get("device_dispatch_calls", 0),
         **{k: round(v, 3) if isinstance(v, float) else v
            for k, v in async_stats.as_dict().items()},
         # per-phase wall breakdown derived from the observability
@@ -1007,6 +1014,13 @@ def _scale_summary(row):
         # symbolic lockstep tier (interpreter steps inside batched
         # segments + their wall, the states_per_s numerator/denominator)
         "states_stepped", "segment_s",
+        # resident solver (ops/resident.py): raw device kernel
+        # invocations, persistent dispatches, their exit taxonomy,
+        # and dense rows delegated into the shared state layout
+        "device_dispatch_calls", "dispatches_per_analysis",
+        "resident_dispatches", "resident_exit_all_decided",
+        "resident_exit_budget", "resident_exit_watchdog",
+        "resident_delegations",
     )
     out = {k: row[k] for k in keys if k in row}
     total = out.get("lane_sweeps_total", 0)
@@ -1093,6 +1107,15 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
         # pair, so the cap headroom is untouched on quiet rounds
         headline["sweeps_per_lane"] = summary["sweeps_per_lane"]
         headline["learned_clauses"] = summary.get("learned_clauses", 0)
+    if summary.get("dispatches_per_analysis") is not None:
+        # resident solver: device kernel invocations per analysis —
+        # THE persistent-kernel success metric (the round ladder
+        # collapsing to ~1 dispatch per solve), gated lower-is-better
+        # in scripts/bench_compare.py.  Absent (not null) when nothing
+        # dispatched, so quiet rounds keep their cap headroom
+        headline["dispatches_per_analysis"] = summary[
+            "dispatches_per_analysis"
+        ]
     if summary.get("states_per_s") is not None:
         # symbolic lockstep tier: interpreter steps per second inside
         # batched segments (gated higher-is-better in bench_compare).
@@ -1150,6 +1173,7 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
                     "blast_s", "sweep_util", "learned_clauses",
                     "sweeps_per_lane",
                     "h2d_bytes", "device_sweeps", "states_per_s",
+                    "dispatches_per_analysis",
                     "checkpoint_overhead_s", "t3_wall_s", "error",
                     "watchdog_trips", "demotions"):
             headline.pop(key, None)
@@ -1521,6 +1545,27 @@ def main() -> None:
     summary["learned_clauses"] = sum(
         r.get("learned_clauses", 0) for r in rows
     ) + sum(r.get("learned_clauses", 0) for r in scale_rows.values())
+    # resident-solver headline: device kernel invocations per analysis
+    # across every pass that ran one (corpus + t3 + scale scenarios —
+    # each row is exactly one analysis because dispatch_stats resets
+    # per contract).  The resident kernel's whole point is collapsing
+    # the multi-dispatch round ladder to ~1 invocation per solve, so
+    # this is gated lower-is-better in scripts/bench_compare.py.
+    # None (and absent from the headline) when nothing dispatched
+    all_analysis_rows = (
+        list(rows) + list(t3_rows) + list(scale_rows.values())
+    )
+    total_kernel_calls = sum(
+        r.get("device_dispatch_calls", 0) for r in all_analysis_rows
+    )
+    summary["device_dispatch_calls"] = total_kernel_calls
+    summary["resident_dispatches"] = sum(
+        r.get("resident_dispatches", 0) for r in all_analysis_rows
+    )
+    summary["dispatches_per_analysis"] = (
+        round(total_kernel_calls / len(all_analysis_rows), 2)
+        if total_kernel_calls else None
+    )
     # symbolic lockstep tier headline: interpreter-attributed
     # throughput — (state, opcode) steps executed inside batched
     # segments over the svm.segment span wall, across the corpus and

@@ -224,6 +224,26 @@ class DispatchStats:
         # delta uploads on the next dispatch)
         self.frontier_steps = 0
         self.learned_clauses = 0
+        # resident solver (ops/resident.py; this PR): every REAL device
+        # kernel invocation — ladder rounds, bisection sub-dispatches,
+        # resident solves, mesh solves, dense pallas rounds, one-shot
+        # prefetch solves — counts here; bench divides by analyses for
+        # the dispatches_per_analysis headline (the host round-trip
+        # cost the resident kernel exists to kill)
+        self.device_dispatch_calls = 0
+        # resident-kernel dispatches and their exit taxonomy (device-
+        # decided: all lanes retired / iteration budget exhausted /
+        # device-side stall watchdog tripped) — profile_t3 reports the
+        # split, and a nonzero watchdog count is the chaos signal
+        self.resident_dispatches = 0
+        self.resident_exit_all_decided = 0
+        self.resident_exit_budget = 0
+        self.resident_exit_watchdog = 0
+        # dense dispatches the Pallas tier declined in favor of the
+        # resident kernel (satellite: both ladders share one state
+        # layout) — explains a dense-tier quiet round under the
+        # resident default
+        self.resident_delegations = 0
         # symbolic lockstep tier (laser/ethereum/symbolic_lockstep.py):
         # interpreter (state, opcode) steps executed inside batched
         # segments, and the wall-clock of those segments (the
@@ -826,6 +846,7 @@ class BatchedSatBackend:
 
             def _solve_mesh():
                 faults.maybe_fault_dispatch()
+                dispatch_stats.device_dispatch_calls += 1
                 fa, st = sharded_frontier_solve(
                     get_mesh(), pool_lits_np, assign,
                 )
@@ -939,6 +960,21 @@ class BatchedSatBackend:
                                              GATHER_DECISIONS),
         )
 
+    def _cached_resident(self, bucket: int):
+        """Jitted resident solve (ops/resident.py) — every knob that
+        bakes into the trace rides the cache key, so tests re-pinning
+        budget/watchdog/extra env never get a stale compilation."""
+        from mythril_tpu.ops import resident as RK
+        from mythril_tpu.ops.frontier import frontier_fan, frontier_period
+
+        key = ("resident", bucket, RK.resident_budget(),
+               RK.resident_watchdog_limit(), RK.resident_extra_cap(),
+               frontier_fan(), frontier_period())
+        return self._cached(
+            key,
+            lambda: RK.make_resident_step(bucket, GATHER_DECISIONS),
+        )
+
     def _harvest_round_learnts(self, state, live, frontier) -> None:
         """Pull the round's first-UIP clauses off the lane buffers and
         feed them to the blast context's nogood channel
@@ -973,6 +1009,131 @@ class BatchedSatBackend:
                     if stale != key and len(self._step_cache) > 12:
                         del self._step_cache[stale]
         return step
+
+    def _solve_resident(self, key_base: str, lits, assign, pref=None,
+                        frontier=None):
+        """Thin supervisor over the persistent resident kernel
+        (ops/resident.py): ONE dispatch in, a verdict/trail/learned-
+        clause bundle out.  The entire round ladder — frontier queues,
+        DLIS decisions, first-UIP learning with mid-dispatch append of
+        learned rows to the shared extra pool, mask-level lane
+        retirement, and the device-side budget/stall-watchdog exit —
+        runs inside the kernel; the host's job shrinks to seeding
+        state, supervising the dispatch, and harvesting.
+
+        What the multi-dispatch ladder guaranteed is preserved:
+
+        - **EWMA watchdog**: the dispatch runs under ONE
+          ``resident:{lane bucket}`` key family (satellite: no more
+          key-per-round-budget proliferation) with the same deadline
+          model; a cold key gets the full cap (jit compile dominates).
+        - **retry -> bisect -> quarantine**: dispatch escalation goes
+          through the SAME :meth:`_dispatch_round` rungs.  Only the
+          per-lane fields ride bisection slicing; the shared extra
+          pool / counters are re-seeded zero for every attempt (an
+          empty learned pool is always a sound start), and the
+          exit-reason telemetry is recorded per completed kernel
+          invocation.
+        - **drain seam**: honored at the dispatch boundary — a drain
+          requested before launch returns every lane undecided so the
+          analysis can land its final checkpoint; one in flight is
+          bounded by the EWMA deadline.
+        - **kill switch**: ``MYTHRIL_TPU_RESIDENT_KERNEL=0`` keeps the
+          exact multi-dispatch ladders (see ``_solve_gather_ladder``).
+
+        Returns (status[batch] int32 with bails mapped to undecided,
+        final assign[batch, V1] int8) — the ladder's exact contract.
+        """
+        from mythril_tpu.ops import resident as RK
+        from mythril_tpu.resilience.checkpoint import drain_requested
+
+        _, jnp = _require_jax()
+        assign = np.asarray(assign, dtype=np.int8)
+        batch, V1 = assign.shape
+        B = lane_bucket(batch)
+        dispatch_stats.lane_slots_filled += batch
+        dispatch_stats.lane_slots_total += B
+
+        if drain_requested():
+            # the resident solve is one indivisible dispatch, so the
+            # drain seam sits at its boundary: bail before launching
+            # and every lane retires undecided (CDCL tail / resumed
+            # run finishes them, findings unchanged)
+            obs.instant("dispatch.drain", cat="sweep", lanes=batch,
+                        bucket=B)
+            return np.zeros(batch, np.int32), np.array(assign, copy=True)
+
+        pref_row = None
+        if pref is not None:
+            pref_row = np.zeros(V1, np.int8)
+            n = min(V1, len(pref))
+            pref_row[:n] = np.asarray(pref[:n], np.int8)
+        seed = np.ones((B, V1), np.int8)
+        seed[:batch] = assign
+        state = RK.resident_state0(
+            seed, batch, GATHER_DECISIONS, width=MAX_CLAUSE_WIDTH,
+            pref_row=pref_row,
+        )
+        adj_dev = frontier["adj"]
+        raw = self._cached_resident(V1 - 1)
+        budget = RK.resident_budget()
+        watchdog_limit = RK.resident_watchdog_limit()
+        n_lane = len(RK.RESIDENT_LANE_FIELDS)
+        shared0 = [
+            jnp.asarray(state[k]) for k in RK.RESIDENT_SHARED_FIELDS
+        ]
+        status_idx = RK.RESIDENT_LANE_FIELDS.index("status")
+
+        def step_fn(lits_, *lane_vals):
+            out = raw(lits_, adj_dev, *lane_vals, *shared0)
+            lane_out, shared_out = out[:n_lane], out[n_lane:]
+            # exit-reason telemetry per completed kernel invocation
+            # (np.asarray blocks until the kernel finished — the wedge
+            # point, so it stays inside the supervised region)
+            reason = RK.exit_reason(
+                np.asarray(lane_out[status_idx]),
+                int(np.asarray(shared_out[2])[0]),
+                int(np.asarray(shared_out[3])[0]),
+                watchdog_limit, budget,
+            )
+            dispatch_stats.resident_dispatches += 1
+            counter = f"resident_exit_{reason}"
+            setattr(dispatch_stats, counter,
+                    getattr(dispatch_stats, counter) + 1)
+            return lane_out
+
+        live = np.arange(batch)
+        key = f"resident:{B}"
+        if obs.get_tracer().enabled:
+            obs.counter("lanes.live", live=batch, bucket=B)
+        lane_state = {k: state[k] for k in RK.RESIDENT_LANE_FIELDS}
+        with obs.span("resident.solve", cat="sweep", key=key,
+                      lanes=batch, bucket=B):
+            lane_state, quarantined = self._dispatch_round(
+                key, step_fn, lits, lane_state,
+                RK.RESIDENT_LANE_FIELDS, live, frontier=True,
+            )
+        for local in quarantined:
+            lane_state["status"][local] = 3  # undecided -> CDCL tail
+        if quarantined:
+            from mythril_tpu.observability.ledger import get_ledger
+
+            get_ledger().count_transition("quarantined",
+                                          len(quarantined))
+        dispatch_stats.rounds += 1
+        full_live = lane_state["fullsw"][:batch]
+        steps_used = int(full_live.max()) if batch else 0
+        dispatch_stats.device_sweeps += steps_used
+        dispatch_stats.lane_sweeps_total += steps_used * B
+        dispatch_stats.lane_sweeps_active += int(full_live.sum())
+        dispatch_stats.frontier_steps += int(
+            lane_state["fsteps"][:batch].sum()
+        )
+        self._harvest_round_learnts(lane_state, live, frontier)
+        statuses_out = lane_state["status"][:batch].astype(np.int32)
+        assign_out = lane_state["assign"][:batch].astype(np.int8)
+        return (np.where(statuses_out == 3, 0, statuses_out),
+                assign_out)
 
     def _solve_gather_ladder(self, key_base: str, lits, assign,
                              pref=None, frontier=None):
@@ -1024,7 +1185,18 @@ class BatchedSatBackend:
         final assign[batch, V1] int8).
         """
         from mythril_tpu.ops import frontier as FR
+        from mythril_tpu.ops.resident import resident_kernel_enabled
         from mythril_tpu.resilience.checkpoint import drain_requested
+
+        if frontier is not None and resident_kernel_enabled():
+            # the persistent kernel subsumes the whole ladder below:
+            # one dispatch, device-decided exit.  The multi-dispatch
+            # code path stays byte-identical under the
+            # MYTHRIL_TPU_RESIDENT_KERNEL=0 kill switch (and is the
+            # only path with the frontier tier off — the resident
+            # kernel is built from the frontier state layout).
+            return self._solve_resident(key_base, lits, assign,
+                                        pref=pref, frontier=frontier)
 
         _, jnp = _require_jax()
         assign = np.asarray(assign, dtype=np.int8)
@@ -1224,6 +1396,7 @@ class BatchedSatBackend:
                     # so the chaos suite covers the new dispatch shape
                     # (retry/bisect/demote rungs all reachable from it)
                     faults.maybe_fault_frontier()
+                dispatch_stats.device_dispatch_calls += 1
                 out = step_fn(lits, *vals)
                 # the host copy blocks until the round finished — the
                 # wedge point, so it belongs inside the supervision
@@ -1417,6 +1590,7 @@ class BatchedSatBackend:
 
             def _solve_mesh_cone():
                 faults.maybe_fault_dispatch()
+                dispatch_stats.device_dispatch_calls += 1
                 fa, st = sharded_frontier_solve(get_mesh(), rows, assign)
                 return np.asarray(st), np.asarray(fa)
 
@@ -1631,6 +1805,7 @@ class BatchedSatBackend:
                 # worker-thread upload (never through the shared memo:
                 # the host could be mutating it concurrently)
                 dispatch_stats.h2d_bytes += int(rows.nbytes)
+                dispatch_stats.device_dispatch_calls += 1
                 assign_dev, status_dev = step(
                     jnp.asarray(rows), jnp.asarray(assign)
                 )
@@ -1655,6 +1830,7 @@ class BatchedSatBackend:
             # first compile for this bucket happens on the worker
             # thread — the host's only budget here is idle time
             step = self._cached_step(bucket)
+            dispatch_stats.device_dispatch_calls += 1
             assign_dev, status_dev = step(lits, jnp.asarray(assign))
             return {"status": status_dev, "assign": assign_dev}
 

@@ -254,35 +254,15 @@ def frontier_state0(assign: np.ndarray, n_real: int, max_decisions: int,
     return state
 
 
-def build_frontier_rounds(num_vars: int, budget: int,
-                          max_decisions: int, fan: int, period: int,
-                          learn_cap: int = LEARN_CAP,
-                          uip_iters: int = UIP_ITERS):
-    """Jittable batched frontier round over the FRONTIER_STATE_FIELDS
-    tuple: ``rounds(lits[C,K], adj[V1,deg], *state) -> state'``.
-
-    Status is RAW (0 live, 1 SAT candidate, 2 sound UNSAT, 3
-    retired-undecided); ``fullsw``/``fsteps`` count per-lane active
-    full sweeps / frontier-gather steps this round, and ``learned`` /
-    ``nlearn`` carry the round's first-UIP clauses for the host
-    harvest.  The iteration budget is ``budget * FRONTIER_BUDGET_MULT``
-    (gather steps advance at most ``fan`` queue vars each).
-
-    The search rules match ops/batched_sat.build_round_lane — dynamic
-    DLIS decisions with warm-start phase preference, don't-care
-    cascade, chronological backtracking, exhaustion-UNSAT — so the
-    verdicts agree with the dense kernel; only the sweep *schedule*
-    and the learned-clause side channel differ.
-    """
+def make_scan_rows(V1: int):
+    """Build the shared BCP row-scan used by BOTH event-driven kernels
+    (the per-round frontier ladder below and the persistent resident
+    kernel in ops/resident.py) — one implementation so their unit/
+    conflict semantics can never drift apart."""
     from mythril_tpu.ops.batched_sat import _require_jax
 
-    jax, jnp = _require_jax()
+    _, jnp = _require_jax()
     from jax import lax
-
-    V1 = num_vars + 1
-    D = max(1, min(max_decisions, V1))
-    fan = max(1, min(fan, V1))  # top_k cannot exceed the var axis
-    iters = budget * FRONTIER_BUDGET_MULT
 
     def scan_rows(rows, row_ids, valid, assign, scores: bool):
         """One BCP evaluation over gathered clause rows.
@@ -345,6 +325,40 @@ def build_frontier_rounds(num_vars: int, budget: int,
             spos = zeros
             sneg = zeros
         return fpos, fneg, rpos, rneg, conflict, conflict_row, spos, sneg
+
+    return scan_rows
+
+
+def build_frontier_rounds(num_vars: int, budget: int,
+                          max_decisions: int, fan: int, period: int,
+                          learn_cap: int = LEARN_CAP,
+                          uip_iters: int = UIP_ITERS):
+    """Jittable batched frontier round over the FRONTIER_STATE_FIELDS
+    tuple: ``rounds(lits[C,K], adj[V1,deg], *state) -> state'``.
+
+    Status is RAW (0 live, 1 SAT candidate, 2 sound UNSAT, 3
+    retired-undecided); ``fullsw``/``fsteps`` count per-lane active
+    full sweeps / frontier-gather steps this round, and ``learned`` /
+    ``nlearn`` carry the round's first-UIP clauses for the host
+    harvest.  The iteration budget is ``budget * FRONTIER_BUDGET_MULT``
+    (gather steps advance at most ``fan`` queue vars each).
+
+    The search rules match ops/batched_sat.build_round_lane — dynamic
+    DLIS decisions with warm-start phase preference, don't-care
+    cascade, chronological backtracking, exhaustion-UNSAT — so the
+    verdicts agree with the dense kernel; only the sweep *schedule*
+    and the learned-clause side channel differ.
+    """
+    from mythril_tpu.ops.batched_sat import _require_jax
+
+    jax, jnp = _require_jax()
+    from jax import lax
+
+    V1 = num_vars + 1
+    D = max(1, min(max_decisions, V1))
+    fan = max(1, min(fan, V1))  # top_k cannot exceed the var axis
+    iters = budget * FRONTIER_BUDGET_MULT
+    scan_rows = make_scan_rows(V1)
 
     def rounds(lits, adj, assign0, lvl0, reason0, tpos0, dvar0, dphase0,
                dflip0, depth0, status0, stamp0, recent0, cspos0,

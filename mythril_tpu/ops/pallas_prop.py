@@ -1107,6 +1107,7 @@ def _run_dense_ladder(
         with obs.span("pallas.round", cat="sweep", budget=budget,
                       bucket=B, lanes=int(live.size)):
             fn = round_fn(B, budget, hot_c)
+            dispatch_stats.device_dispatch_calls += 1
             out = fn(*planes, *state)
             state, steps_used = list(out[:-1]), int(out[-1])
         dispatch_stats.rounds += 1
@@ -1218,6 +1219,32 @@ class PallasSatBackend:
         )) if lane_cones else np.empty(0, np.int64)
         union_C = _bucket(max(1, len(union_ci)))
         union_V = _bucket(len(union_cv) + 2)
+        # Resident-solver unification (the last PR-8 remainder): when
+        # the persistent kernel is on and the union cone fits the
+        # cone-gather caps, the dense tier DECLINES so the dispatch
+        # routes through the gather/cone rows path into the resident
+        # kernel — both ladders enter it through ONE state layout
+        # (frontier fields + shared extra pool) instead of the dense
+        # tier keeping its own host-driven round loop.  Sound: the
+        # rows path drops clauses wider than the width cap, which
+        # weakens BCP but never verdicts (UNSAT stays a subset
+        # refutation, SAT candidates are host-verified).  Oversized
+        # cones keep the dense Pallas ladder — it has no width cap and
+        # its [C, V] incidence layout is the only one that fits them.
+        if search:
+            from mythril_tpu.ops.batched_sat import (
+                MAX_CONE_GATHER_CLAUSES, MAX_CONE_GATHER_VARS,
+                dispatch_stats,
+            )
+            from mythril_tpu.ops.resident import resident_kernel_enabled
+
+            if (
+                resident_kernel_enabled()
+                and 0 < len(union_ci) <= MAX_CONE_GATHER_CLAUSES
+                and len(union_cv) <= MAX_CONE_GATHER_VARS
+            ):
+                dispatch_stats.resident_delegations += 1
+                return None
         max_C = _bucket(max(1, max(len(ci) for ci, _ in lane_cones)))
         max_V = _bucket(2 + max(len(cv) for _, cv in lane_cones))
         B_bucket = max(8, _bucket(batch, floor=8))

@@ -75,6 +75,10 @@ EWMA_ALPHA = 0.3
 # multiply the key space per bucket, and a long soak over many pool
 # shapes would otherwise grow the table without bound.  LRU like
 # PROBE_MEMO_CAP: hits refresh recency, the stale quarter is evicted.
+# The resident solver deliberately collapses its key space to ONE
+# family per lane bucket ("resident:8", "resident:64") — a persistent
+# dispatch has no per-round budget axis, so keying on one would be
+# pure table pressure; the bucket is the only latency-relevant shape.
 EWMA_CAP = 64
 
 

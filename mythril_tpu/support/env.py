@@ -40,10 +40,12 @@ class EnvSpecError(RuntimeError):
 
 
 #: name -> (kind, floor, ceil); kind in {"int", "float", "listen",
-#: "file"}.  "listen" validates a HOST:PORT spec and "file" an
-#: existing non-empty file (floor/ceil unused for both).  Static
-#: entries cover knobs whose owning module may not have imported by
-#: validation time; env_int/env_float self-register the rest.
+#: "file", "flag"}.  "listen" validates a HOST:PORT spec, "file" an
+#: existing non-empty file, and "flag" a kill-switch boolean (the
+#: :func:`env_flag` vocabulary — floor/ceil unused for all three).
+#: Static entries cover knobs whose owning module may not have
+#: imported by validation time; env_int/env_float self-register the
+#: rest.
 KNOWN_SPECS: Dict[str, Tuple[str, Optional[float], Optional[float]]] = {
     "MYTHRIL_TPU_FRONTIER_PERIOD": ("int", 1, None),
     "MYTHRIL_TPU_FRONTIER_FAN": ("int", 1, None),
@@ -73,7 +75,18 @@ KNOWN_SPECS: Dict[str, Tuple[str, Optional[float], Optional[float]]] = {
     "MYTHRIL_TPU_FLEET_LISTEN": ("listen", None, None),
     "MYTHRIL_TPU_FLEET_SECRET_FILE": ("file", None, None),
     "MYTHRIL_TPU_SERVE_TENANT_QUOTA": ("float", 0.0, None),
+    # resident solver (ops/resident.py): kill switch + the in-kernel
+    # budget / stall-watchdog / learned-row-pool counters
+    "MYTHRIL_TPU_RESIDENT_KERNEL": ("flag", None, None),
+    "MYTHRIL_TPU_RESIDENT_BUDGET": ("int", 1, None),
+    "MYTHRIL_TPU_RESIDENT_WATCHDOG": ("int", 1, None),
+    "MYTHRIL_TPU_RESIDENT_EXTRA": ("int", 1, None),
 }
+
+#: raw values :func:`env_flag` understands; anything else set on a
+#: "flag"-kind knob is a typo that silently runs the default, so
+#: validate_env rejects it at startup like every other malformed knob
+FLAG_VALUES = ("0", "off", "false", "1", "on", "true", "force")
 
 _registered: Dict[str, Tuple[str, Optional[float], Optional[float]]] = {}
 
@@ -161,6 +174,13 @@ def validate_env(environ=None) -> None:
                 )
             if os.path.getsize(raw) == 0:
                 raise EnvSpecError(f"{name}={raw!r}: file is empty")
+            continue
+        if kind == "flag":
+            if raw.strip().lower() not in FLAG_VALUES:
+                raise EnvSpecError(
+                    f"{name}={raw!r}: not a flag "
+                    f"(expected one of {'/'.join(FLAG_VALUES)})"
+                )
             continue
         try:
             value = int(raw) if kind == "int" else float(raw)

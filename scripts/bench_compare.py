@@ -57,7 +57,12 @@ import sys
 GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
          "device_sweeps", "h2d_bytes", "trace_overhead_s",
          "blast_s", "word_prop_s", "serve_warm_p50_s",
-         "sweeps_per_lane", "tier_tail_pct")
+         "sweeps_per_lane", "tier_tail_pct",
+         # resident solver: device kernel invocations per analysis —
+         # the persistent kernel collapses the round ladder to ~1
+         # dispatch per solve, so this creeping back UP means the
+         # ladder is escaping to the host again
+         "dispatches_per_analysis")
 #: gated metrics where LARGER is better (delta sign inverted):
 #: sustained warm-server throughput must not fall, the microbench
 #: device-vs-host ratio (both sides measured in the same run since the

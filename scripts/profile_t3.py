@@ -107,6 +107,24 @@ def main() -> None:
         frontier_s / row["total_wall_s"], 4
     ) if row["total_wall_s"] else 0.0
     row["frontier_learned_clauses"] = row.get("learned_clauses", 0)
+    # resident-solver exit taxonomy: how each persistent dispatch
+    # ended (all lanes retired / iteration budget / device-side stall
+    # watchdog) plus the wall spent inside resident.solve spans — the
+    # row already carries the counters via DispatchStats, this block
+    # makes the split legible next to the other tier shares
+    resident_s = sum(
+        seconds for name, seconds in totals.items()
+        if name.startswith("resident.")
+    )
+    row["span_resident_s"] = round(resident_s, 3)
+    row["resident_span_share"] = round(
+        resident_s / row["total_wall_s"], 4
+    ) if row["total_wall_s"] else 0.0
+    row["resident_exits"] = {
+        "all_decided": row.get("resident_exit_all_decided", 0),
+        "budget": row.get("resident_exit_budget", 0),
+        "watchdog": row.get("resident_exit_watchdog", 0),
+    }
     # lockstep-tier share: wall spent executing batched straight-line
     # segments over sibling states (svm.segment spans) — the row
     # already carries states_stepped / segment_s / plane_*_bits via

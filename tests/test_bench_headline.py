@@ -137,6 +137,56 @@ def test_trace_overhead_is_gated_in_bench_compare():
     assert "trace_overhead_s" in module.GATED
 
 
+def test_headline_carries_dispatches_per_analysis():
+    """The resident-solver round is judged on device kernel
+    invocations per analysis: absent (not null) when nothing
+    dispatched, riding the line when set, droppable under the 500-char
+    cap, and gated lower-is-better in scripts/bench_compare.py."""
+    import importlib.util
+
+    payload = json.loads(
+        bench.build_headline_line(dict(BASE_SUMMARY), None, None)
+    )
+    assert "dispatches_per_analysis" not in payload  # nothing dispatched
+
+    summary = dict(BASE_SUMMARY, dispatches_per_analysis=1.12)
+    payload = json.loads(bench.build_headline_line(summary, None, None))
+    assert payload["dispatches_per_analysis"] == 1.12
+
+    summary = dict(BASE_SUMMARY, dispatches_per_analysis=1.12,
+                   error="missed findings: " + "x" * 1000)
+    line = bench.build_headline_line(summary, None, None)
+    assert len(line) <= 500
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare_resident",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "bench_compare.py"),
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    assert "dispatches_per_analysis" in module.GATED
+
+
+def test_scale_summary_reports_resident_telemetry():
+    """The per-scenario summary must expose the resident solver's
+    dispatch counter and exit taxonomy when present."""
+    row = {
+        "wall_s": 1.0, "dispatches": 3, "lanes": 24, "unsat": 2,
+        "sat_verified": 20, "undecided": 2, "found": ["106"],
+        "device_dispatch_calls": 4, "dispatches_per_analysis": 4,
+        "resident_dispatches": 3, "resident_exit_all_decided": 2,
+        "resident_exit_budget": 1, "resident_exit_watchdog": 0,
+        "resident_delegations": 1,
+    }
+    out = bench._scale_summary(row)
+    assert out["device_dispatch_calls"] == 4
+    assert out["resident_dispatches"] == 3
+    assert out["resident_exit_all_decided"] == 2
+    assert out["resident_exit_budget"] == 1
+    assert out["resident_delegations"] == 1
+
+
 def test_headline_carries_degradation_counters():
     """Chaos/flaky-hardware rounds are judged on the headline alone, so
     the ladder counters must ride it (and default to 0 when a summary

@@ -468,3 +468,29 @@ def test_ewma_table_is_bounded_with_lru_eviction(monkeypatch):
     assert watchdog.ewma_cap() == watchdog.EWMA_CAP
     monkeypatch.setenv("MYTHRIL_TPU_EWMA_CAP", "2")
     assert watchdog.ewma_cap() == 8  # floored: eviction quarter >= 2
+
+
+def test_ewma_table_covers_resident_key_family(monkeypatch):
+    """The resident solver's `resident:{bucket}` keys live in the same
+    LRU-bounded table as the ladder's per-budget keys: one key per
+    lane bucket (no per-round proliferation), recency-kept under
+    pressure from ladder-key churn, and subject to the same cap."""
+    monkeypatch.setenv("MYTHRIL_TPU_EWMA_CAP", "16")
+    dog = watchdog.DispatchWatchdog()
+    # the whole resident family a real run can produce: one key per
+    # power-of-two lane bucket — this NEVER grows with pool shape or
+    # round budget, which is the point of the satellite
+    for bucket in (4, 8, 16, 32, 64, 128):
+        dog.observe(f"resident:{bucket}", 0.5)
+    assert len(dog._ewma) == 6
+    # ladder-key churn (the proliferating family the resident kernel
+    # replaces) must not evict a resident key that stays hot
+    for i in range(100):
+        dog.deadline_for("resident:8")
+        dog.observe(f"frontier:{i}", 0.1)
+    assert "resident:8" in dog._ewma
+    assert len(dog._ewma) <= 16
+    # a warm resident key budgets its own deadline from its own EWMA,
+    # not the cold-key cap
+    warm = dog.deadline_for("resident:8")
+    assert warm < dog.deadline_for("resident:256")  # cold: full cap

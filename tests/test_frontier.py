@@ -293,10 +293,14 @@ def test_harvest_learned_remaps_and_dedupes():
 # -------------------------------------- ladder integration / repacks
 
 
-def test_frontier_queue_carries_across_repacks():
+def test_frontier_queue_carries_across_repacks(monkeypatch):
     """Lanes retiring at different rounds force survivor re-packs; the
     frontier state (queues, trail, learned buffers) must compact with
-    the lanes and the straggler must still finish correctly."""
+    the lanes and the straggler must still finish correctly.  Repacks
+    only exist on the multi-dispatch ladder, so this pins the
+    MYTHRIL_TPU_RESIDENT_KERNEL=0 path (the resident kernel retires
+    lanes mask-level inside one dispatch — test_resident_kernel.py)."""
+    monkeypatch.setenv("MYTHRIL_TPU_RESIDENT_KERNEL", "0")
     # the chain is strictly sequential (one forced var per frontier
     # step), so a length past round 1's iteration budget (64 sweeps x
     # FRONTIER_BUDGET_MULT) guarantees the straggler survives into a
@@ -327,7 +331,11 @@ def test_frontier_queue_carries_across_repacks():
 def test_kill_switch_restores_dense_rounds(monkeypatch):
     """MYTHRIL_TPU_FRONTIER=0: callers stop building frontier inputs
     and the ladder runs the exact prior dense round kernel (the A/B
-    pin bench_compare's parity claim rests on)."""
+    pin bench_compare's parity claim rests on).  Pinned to the
+    multi-dispatch ladder: with the resident kernel on, a frontier
+    input routes to ops/resident.py instead (that switch's own A/B
+    pin lives in test_resident_kernel.py)."""
+    monkeypatch.setenv("MYTHRIL_TPU_RESIDENT_KERNEL", "0")
     monkeypatch.setenv("MYTHRIL_TPU_FRONTIER", "0")
     assert not frontier_enabled()
     monkeypatch.delenv("MYTHRIL_TPU_FRONTIER")

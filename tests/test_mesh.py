@@ -153,6 +153,10 @@ def test_corpus_shard_places_arrays_on_assigned_device(monkeypatch):
     import jax
 
     monkeypatch.setenv("MYTHRIL_TPU_PALLAS", "force")
+    # pin the dense kernels: with the resident solver on, the Pallas
+    # backend delegates cap-fitting cones to the gather-path resident
+    # kernel (returns None), but this test is about DENSE placement
+    monkeypatch.setenv("MYTHRIL_TPU_RESIDENT_KERNEL", "0")
     from mythril_tpu.ops.device_placement import corpus_shard, place
     from mythril_tpu.smt import symbol_factory
     from mythril_tpu.smt.solver import get_blast_context, reset_blast_context

@@ -26,6 +26,11 @@ def fresh_context(monkeypatch):
     # these tests pin the dense-kernel dispatch plane BELOW the word
     # tier: hold the tier off so the synthetic lanes actually reach it
     monkeypatch.setenv("MYTHRIL_TPU_WORD_TIER", "0")
+    # the dense tier now DECLINES cap-fitting cones in favor of the
+    # resident kernel (ops/resident.py) — hold that off too so these
+    # lanes exercise the dense kernels they pin (the resident path has
+    # its own suite in test_resident_kernel.py)
+    monkeypatch.setenv("MYTHRIL_TPU_RESIDENT_KERNEL", "0")
     reset_blast_context()
     yield
     reset_blast_context()
