@@ -987,6 +987,94 @@ def _fabric_microbench():
             pass
 
 
+def _persist_microbench():
+    """Warm-restart headline (persist/plane.py): analyze killbilly on a
+    server backed by a fresh ``--persist-dir``, tear everything down,
+    then stand up a NEW server (fresh plane — exactly a process restart
+    against the same directory) and re-submit the identical request.
+    The second pass must answer from the durable report cache without
+    re-analysis, so ``warm_restart_speedup`` (cold wall / warm wall,
+    gated higher-is-better in scripts/bench_compare.py) is the
+    restart-survival story in one number; ``persist_hit_rate`` is the
+    store-consultation hit fraction of the warm pass."""
+    import json as _json
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import urllib.request
+
+    from mythril_tpu.persist import plane as plane_mod
+    from mythril_tpu.serve import AnalysisServer, ServeConfig
+
+    name, code, tx_count, _expected = _corpus()[0]  # killbilly
+    persist_dir = _tempfile.mkdtemp(prefix="mtpu-bench-persist-")
+    saved = {
+        k: os.environ.get(k)
+        for k in ("MYTHRIL_TPU_PERSIST_DIR", "MYTHRIL_TPU_PERSIST_FLUSH_S")
+    }
+    os.environ["MYTHRIL_TPU_PERSIST_DIR"] = persist_dir
+    os.environ["MYTHRIL_TPU_PERSIST_FLUSH_S"] = "0"  # flush every put
+    payload = _json.dumps({
+        "code": code, "name": name, "tx_count": tx_count,
+        "deadline_s": 240, "source": "bench",
+    }).encode()
+
+    def one_process_pass():
+        # reset_for_tests + first use == a process restart against the
+        # same directory: the fresh plane re-opens and re-loads the
+        # store from disk, so the warm pass exercises the durable path
+        plane_mod.reset_for_tests()
+        server = AnalysisServer(ServeConfig.from_env(port=0))
+        server.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/analyze", data=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            began = time.monotonic()
+            body = _json.loads(
+                urllib.request.urlopen(req, timeout=240).read()
+            )
+            elapsed = time.monotonic() - began
+            hit_rate = plane_mod.get_knowledge_plane().hit_rate()
+            return elapsed, body, hit_rate
+        finally:
+            server.drain_and_stop("bench done")
+
+    try:
+        cold_s, cold_body, _rate = one_process_pass()
+        if not cold_body["findings_swc"]:
+            return {"error": "cold pass found nothing"}
+        warm_s, warm_body, hit_rate = one_process_pass()
+        out = {
+            "cold_s": round(cold_s, 3),
+            "warm_restart_s": round(warm_s, 4),
+            "warm_restart_speedup": (
+                round(cold_s / warm_s, 1) if warm_s else None
+            ),
+            "persist_hit_rate": (
+                round(hit_rate, 3) if hit_rate is not None else None
+            ),
+            "answered_from_cache": bool(warm_body.get("cached")),
+            "found": warm_body["findings_swc"],
+        }
+        if sorted(warm_body["findings_swc"]) != sorted(
+                cold_body["findings_swc"]):
+            out["error"] = (
+                f"warm restart diverged: cold "
+                f"{sorted(cold_body['findings_swc'])} vs warm "
+                f"{sorted(warm_body['findings_swc'])}"
+            )
+        return out
+    finally:
+        plane_mod.reset_for_tests()
+        for key, value in saved.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+        _shutil.rmtree(persist_dir, ignore_errors=True)
+
+
 def _scale_summary(row):
     keys = (
         "wall_s", "dispatches", "lanes", "unsat", "sat_verified",
@@ -1158,12 +1246,22 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
         # authenticated remote seat (gated higher-is-better in
         # bench_compare); absent when the microbench did not run
         headline["fabric_cpm"] = summary["fabric_cpm"]
+    if isinstance(summary.get("warm_restart_speedup"), (int, float)):
+        # persistent knowledge plane: a fresh process re-analyzing a
+        # seen contract against the same --persist-dir answers from
+        # the durable report cache (gated higher-is-better in
+        # bench_compare), plus the warm pass's store hit fraction
+        headline["warm_restart_speedup"] = summary[
+            "warm_restart_speedup"
+        ]
+        headline["persist_hit_rate"] = summary.get("persist_hit_rate")
     if "error" in summary:
         headline["error"] = str(summary["error"])[:160]
     line = json.dumps(headline)
     if len(line) > 500:  # hard cap so the tail capture can never lose it
         for key in ("autopilot_tuned", "autopilot_ladder",
                     "autopilot_routed", "tier_decided_pct",
+                    "persist_hit_rate", "warm_restart_speedup",
                     "fabric_cpm",
                     "worker_deaths_recovered", "fleet_speedup",
                     "microbench_device_vs_host",
@@ -1348,6 +1446,17 @@ def main() -> None:
             fabric_bench = {"error": str(exc)[:200]}
     print(json.dumps({"fabric_microbench": fabric_bench}),
           file=sys.stderr)
+    # persistent-knowledge microbench (persist/plane.py): warm-restart
+    # speedup against a shared --persist-dir; same isolation ordering
+    if quick:
+        persist_bench = {"skipped": "--quick run"}
+    else:
+        try:
+            persist_bench = _persist_microbench()
+        except Exception as exc:  # noqa: BLE001 — bench must not die here
+            persist_bench = {"error": str(exc)[:200]}
+    print(json.dumps({"persist_microbench": persist_bench}),
+          file=sys.stderr)
     summary = {
         "metric": "analyze_corpus_wall_s",
         "value": round(wall, 2),
@@ -1507,6 +1616,15 @@ def main() -> None:
     summary["fabric_microbench"] = fabric_bench
     if isinstance(fabric_bench.get("contracts_per_min"), (int, float)):
         summary["fabric_cpm"] = fabric_bench["contracts_per_min"]
+    summary["persist_microbench"] = persist_bench
+    if isinstance(persist_bench.get("warm_restart_speedup"),
+                  (int, float)):
+        summary["warm_restart_speedup"] = persist_bench[
+            "warm_restart_speedup"
+        ]
+        summary["persist_hit_rate"] = persist_bench.get(
+            "persist_hit_rate"
+        )
     # headline sweep utilization: over the corpus pass AND the scale
     # scenarios (the corpus's narrow frontiers rarely dispatch, so the
     # scale rows are where the ratio carries signal)

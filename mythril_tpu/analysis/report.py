@@ -274,6 +274,14 @@ class Report:
                 # cooperative checkpoint — consumers must not read the
                 # issue list as the analysis's final word
                 degraded["partial"] = True
+            # knowledge plane (persist/plane.py): warm/cold provenance
+            # for this run — absent entirely when persistence is off,
+            # keeping the pre-persist report byte-for-byte identical
+            from mythril_tpu.persist.plane import get_knowledge_plane
+
+            persist_block = get_knowledge_plane().persist_meta()
+            if persist_block is not None:
+                degraded["persist"] = persist_block
             if degraded:
                 meta["resilience"] = degraded
         except Exception:  # noqa: BLE001 — telemetry never breaks reports

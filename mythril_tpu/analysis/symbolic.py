@@ -121,6 +121,14 @@ class SymExecWrapper:
         for account in self.accounts.values():
             world_state.put_account(account)
 
+        # persistent knowledge plane (persist/plane.py): the warm/absorb
+        # seam lives HERE because every entry path — CLI analyze, the
+        # serve engine's _fire, a fleet worker's lease — builds a
+        # SymExecWrapper; the plane is inert unless a store directory is
+        # configured, so the unconfigured path is byte-for-byte the old one
+        persist_digest = self._persist_digest(contract, is_creation)
+        self._persist_warm_start(persist_digest)
+
         if is_creation:
             self.laser.sym_exec(
                 creation_code=contract.creation_code,
@@ -135,10 +143,48 @@ class SymExecWrapper:
                 world_state=world_state, target_address=address.value
             )
 
+        self._persist_absorb(persist_digest)
+
         if requires_statespace:
             self.nodes = self.laser.nodes
             self.edges = self.laser.edges
             self.calls = self._harvest_calls()
+
+    # -- persistence seam -----------------------------------------------
+
+    @staticmethod
+    def _persist_digest(contract, is_creation: bool) -> Optional[str]:
+        from mythril_tpu.persist.plane import code_digest, get_knowledge_plane
+
+        if not get_knowledge_plane().active:
+            return None
+        code = (contract.creation_code if is_creation
+                else getattr(contract, "code", None))
+        return code_digest(code if isinstance(code, str) else None)
+
+    @staticmethod
+    def _persist_warm_start(digest: Optional[str]) -> None:
+        if digest is None:
+            return
+        try:
+            from mythril_tpu.persist.plane import get_knowledge_plane
+            from mythril_tpu.smt.solver import get_blast_context
+
+            get_knowledge_plane().warm_start(digest, get_blast_context())
+        except Exception:  # noqa: BLE001 — warmth must never block analysis
+            log.debug("persist warm start failed", exc_info=True)
+
+    @staticmethod
+    def _persist_absorb(digest: Optional[str]) -> None:
+        if digest is None:
+            return
+        try:
+            from mythril_tpu.persist.plane import get_knowledge_plane
+            from mythril_tpu.smt.solver import get_blast_context
+
+            get_knowledge_plane().absorb(digest, get_blast_context())
+        except Exception:  # noqa: BLE001
+            log.debug("persist absorb failed", exc_info=True)
 
     # -- assembly steps -------------------------------------------------
 

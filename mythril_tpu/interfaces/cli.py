@@ -400,6 +400,14 @@ def create_analyzer_parser(analyzer_parser: argparse.ArgumentParser) -> None:
         metavar="ATTACKER_ADDRESS",
     )
     options.add_argument(
+        "--persist-dir",
+        help="directory for the persistent knowledge store: solver "
+        "memos, autopilot EWMAs and finished reports survive the "
+        "process and warm-start later runs (env: "
+        "MYTHRIL_TPU_PERSIST_DIR; kill switch MYTHRIL_TPU_PERSIST=0)",
+        metavar="DIR",
+    )
+    options.add_argument(
         "--creator-address",
         help="Designates a specific creator address to use during analysis",
         metavar="CREATOR_ADDRESS",
@@ -450,6 +458,13 @@ def create_serve_parser(parser: argparse.ArgumentParser) -> None:
         "(env: MYTHRIL_TPU_FLEET_SECRET_FILE)",
         metavar="FILE",
     )
+    parser.add_argument(
+        "--persist-dir",
+        help="directory for the persistent knowledge store: loaded at "
+        "startup, flushed on drain — restarts answer seen contracts "
+        "warm (env: MYTHRIL_TPU_PERSIST_DIR)",
+        metavar="DIR",
+    )
 
 
 def create_worker_parser(parser: argparse.ArgumentParser) -> None:
@@ -472,6 +487,12 @@ def create_worker_parser(parser: argparse.ArgumentParser) -> None:
         help="worker id announced in the hello (default "
         "HOSTNAME-PID)",
         metavar="ID",
+    )
+    parser.add_argument(
+        "--persist-dir",
+        help="directory for the persistent knowledge store shared "
+        "with (or private to) this seat (env: MYTHRIL_TPU_PERSIST_DIR)",
+        metavar="DIR",
     )
     parser.add_argument(
         "--reconnect",
@@ -969,6 +990,11 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
     except EnvSpecError as e:
         print(f"bad environment knob: {e}", file=sys.stderr)
         sys.exit(2)
+
+    if getattr(args, "persist_dir", None):
+        # --persist-dir wins over the env knob, and travels through the
+        # environment so spawned fleet workers inherit the store
+        os.environ["MYTHRIL_TPU_PERSIST_DIR"] = args.persist_dir
 
     if os.environ.get("MYTHRIL_TPU_FAULT") or os.environ.get(
         "MYTHRIL_TPU_KILL_AT"

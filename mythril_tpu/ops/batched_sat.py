@@ -205,6 +205,13 @@ class DispatchStats:
         self.delta_uploads = 0
         self.warm_start_hits = 0
         self.cone_memo_hits = 0
+        # persistent knowledge plane (persist/plane.py): analyses that
+        # warm-started from a stored channel snapshot vs ones the store
+        # had never seen — per-contract mirrors of the plane's process-
+        # lifetime counters, so bench rows can attribute a cheap row to
+        # persisted knowledge
+        self.persist_warm_hits = 0
+        self.persist_warm_misses = 0
         # word-level reasoning tier (smt/word_tier.py; this PR): lanes
         # decided UNSAT by empty abstractions / SAT by constant fold
         # without ever building CNF, total variable bits pinned by the

@@ -35,8 +35,9 @@ Everything here is host-side policy: no jax import at module load.
 """
 
 import logging
-import os
 from typing import Callable, Dict, Optional, Tuple
+
+from mythril_tpu.support.env import env_flag
 
 log = logging.getLogger(__name__)
 
@@ -47,21 +48,19 @@ log = logging.getLogger(__name__)
 CONE_MEMO_CAP = 128
 
 
-def _env_on(name: str) -> bool:
-    return os.environ.get(name, "1").lower() not in ("0", "off", "false")
-
-
 def resident_pool_enabled() -> bool:
     """``MYTHRIL_TPU_RESIDENT_POOL=0`` forces a full clause-pool
     rebuild + upload on every dispatch (kill switch / A-B ablation);
-    default keeps the pool device-resident with delta appends."""
-    return _env_on("MYTHRIL_TPU_RESIDENT_POOL")
+    default keeps the pool device-resident with delta appends.
+    Parsed through :func:`support.env.env_flag`, so ``validate_env``
+    rejects a typo'd value at startup (KNOWN_SPECS lists the knob)."""
+    return env_flag("MYTHRIL_TPU_RESIDENT_POOL", True)
 
 
 def warm_start_enabled() -> bool:
     """``MYTHRIL_TPU_WARM_START=0`` disables parent-model phase
     seeding (lanes cold-start their decision phases from DLIS alone)."""
-    return _env_on("MYTHRIL_TPU_WARM_START")
+    return env_flag("MYTHRIL_TPU_WARM_START", True)
 
 
 class ConeMemo:

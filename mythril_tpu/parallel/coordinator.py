@@ -706,7 +706,7 @@ class Coordinator:
                 )
             return
         if kind == "heartbeat":
-            self._on_heartbeat(seat, header)
+            self._on_heartbeat(seat, header, body)
         elif kind == "gossip":
             self._on_gossip(seat, header, body)
         elif kind == "checkpoint":
@@ -729,7 +729,8 @@ class Coordinator:
             return True
         return stamp.lease_epoch != lease.epoch
 
-    def _on_heartbeat(self, seat: WorkerSeat, header: dict) -> None:
+    def _on_heartbeat(self, seat: WorkerSeat, header: dict,
+                      body: bytes = b"") -> None:
         from mythril_tpu.resilience.faults import get_fault_plane
 
         if get_fault_plane().fire("lease_partition") is not None:
@@ -740,8 +741,20 @@ class Coordinator:
             return
         lease = self._lease_of(seat)
         if self._stale(lease, header):
+            if body and header.get("persist"):
+                self.stats.gossip_dropped_stale += 1
             return
         lease.last_heartbeat = self.clock()
+        if body and header.get("persist"):
+            # a knowledge delta rode this heartbeat (persist/plane.py):
+            # the epoch fence above already vouched for the sender, so
+            # apply + fan out through the standard gossip route (which
+            # re-stamps per recipient), then make it durable
+            if get_fault_plane().fire("gossip_drop") is not None:
+                return
+            self.stats.persist_deltas_applied += 1
+            self.route_gossip(seat.worker_id, header, body)
+            self._persist_absorb_gossip()
 
     def _on_gossip(self, seat: WorkerSeat, header: dict,
                    body: bytes) -> None:
@@ -787,6 +800,59 @@ class Coordinator:
                 },
                 body,
             )
+
+    def _persist_absorb_gossip(self) -> None:
+        """Coordinator-side durability for a routed knowledge delta:
+        re-freeze the merged blast context under the digest of the
+        analysis this process last touched.  Best-effort and a no-op
+        when the persist plane is inert."""
+        try:
+            from mythril_tpu.persist.plane import get_knowledge_plane
+
+            plane = get_knowledge_plane()
+            if not plane.active:
+                return
+            from mythril_tpu.smt.solver import get_blast_context
+
+            plane.absorb_gossip(plane.last_digest, get_blast_context())
+        except Exception:  # noqa: BLE001 — durability is optional
+            log.debug("fleet: persist absorb of routed gossip failed",
+                      exc_info=True)
+
+    def _seed_gossip(self, lease: Lease, seat: WorkerSeat) -> None:
+        """Warm a freshly granted seat with everything the coordinator
+        already knows (its own context merges every routed delta): one
+        gossip frame right behind the grant, stamped with the lease's
+        epoch so the worker's fence accepts it.  Skipped when the body
+        would not fit a frame or the plane has gossip disabled."""
+        try:
+            from mythril_tpu.persist.plane import (
+                get_knowledge_plane, gossip_enabled,
+            )
+
+            plane = get_knowledge_plane()
+            if not (plane.active and gossip_enabled()):
+                return
+            from mythril_tpu.parallel.gossip import (
+                freeze_knowledge, max_frame_bytes,
+            )
+            from mythril_tpu.smt.solver import get_blast_context
+
+            body = freeze_knowledge(get_blast_context())
+            if len(body) >= max_frame_bytes():
+                return
+            seat.handle.send(
+                {
+                    "type": "gossip",
+                    "lease_id": lease.lease_id,
+                    "stamp": Stamp(lease_epoch=lease.epoch).as_dict(),
+                    "origin": "coordinator",
+                },
+                body,
+            )
+        except Exception:  # noqa: BLE001 — a cold seat still works
+            log.debug("fleet: seed gossip to %s failed", seat.worker_id,
+                      exc_info=True)
 
     def _on_checkpoint(self, seat: WorkerSeat, header: dict,
                        body: bytes) -> None:
@@ -1073,6 +1139,10 @@ class Coordinator:
             # the connection died between accept and grant: declare the
             # seat dead; the lease goes back to PENDING via revoke
             self._declare_dead(seat, "grant send failed")
+            return
+        # persist plane: warm the new seat with the coordinator's
+        # accumulated knowledge so a joiner skips the cold ramp
+        self._seed_gossip(lease, seat)
 
     def cancel_lease(self, lease_id: str,
                      reason: str = "cancelled") -> bool:

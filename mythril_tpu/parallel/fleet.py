@@ -54,6 +54,11 @@ _FIELDS = {
     "auth_rejects": "connections rejected by the fabric handshake",
     "frame_rejects": "malformed/tampered/oversized frames struck",
     "remote_attaches": "externally-launched workers attached",
+    # persist plane (persist/plane.py): knowledge deltas riding
+    # heartbeat frames between seats, and deltas applied+absorbed on
+    # the coordinator side
+    "persist_deltas_sent": "knowledge deltas sent on heartbeats",
+    "persist_deltas_applied": "heartbeat knowledge deltas applied",
 }
 
 
@@ -607,12 +612,37 @@ class _WorkerSession:
             with self.lease_lock:
                 header = self.lease_header
             if header is not None:
-                self.send({
+                hb = {
                     "type": "heartbeat",
                     "lease_id": header["lease_id"],
                     "stamp": header["stamp"],
                     "worker_id": self.worker_id,
-                })
+                }
+                # persist gossip rides the heartbeat frame: a knowledge
+                # delta (plain freeze_knowledge body, same encoding as
+                # a tx-boundary gossip) attaches whenever the channels
+                # changed since the last beat.  Best-effort end to end:
+                # a freeze racing the analysis thread, or a body past
+                # MAX_FRAME, skips THIS beat's delta — the next beat
+                # (or the tx boundary) carries it
+                body = b""
+                try:
+                    from mythril_tpu.persist.plane import (
+                        get_knowledge_plane,
+                    )
+                    from mythril_tpu.smt.solver import get_blast_context
+
+                    delta = get_knowledge_plane().encode_heartbeat_delta(
+                        get_blast_context()
+                    )
+                    if delta:
+                        hb["persist"] = True
+                        body = delta
+                        fleet_stats.persist_deltas_sent += 1
+                except Exception:  # noqa: BLE001 — heartbeats must beat
+                    log.debug("worker: persist delta skipped",
+                              exc_info=True)
+                self.send(hb, body)
             time.sleep(interval_holder.get("s", 0.5))
 
     # -- boundary duties (called from the svm seam) ---------------------
@@ -659,6 +689,12 @@ class _WorkerSession:
         except Exception:  # noqa: BLE001
             log.debug("worker: gossip send failed", exc_info=True)
         self.ship_checkpoint(header)
+        # boundary flush for the knowledge store (no-op when inert):
+        # the same "no dispatch in flight" guarantee that makes gossip
+        # safe here makes the freeze-for-disk safe
+        from mythril_tpu.persist.plane import get_knowledge_plane
+
+        get_knowledge_plane().maybe_flush()
 
     def ship_checkpoint(self, header: dict) -> None:
         """Journal-over-the-wire: ship the local boundary journal back
@@ -957,6 +993,12 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     retries = (opts.reconnect if opts.reconnect is not None
                else env_int("MYTHRIL_TPU_FLEET_RECONNECT", 0, floor=0))
     checkpoint.install_signal_handlers()
+    # knowledge store: load once at seat start (warm leases from the
+    # first one) — inert without MYTHRIL_TPU_PERSIST_DIR
+    from mythril_tpu.persist.plane import get_knowledge_plane
+
+    if get_knowledge_plane().active:
+        get_knowledge_plane().store
     global _worker_session
     attempt = 0
     while True:

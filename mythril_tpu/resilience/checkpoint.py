@@ -338,14 +338,38 @@ def load_journal(directory: str) -> Optional[dict]:
     errors = []
     for _, path in reversed(generations):
         try:
-            return _read_one(path)
+            payload = _read_one(path)
         except JournalCorrupt as exc:
             errors.append(str(exc))
-            log.warning("checkpoint: skipping corrupt journal (%s)", exc)
+            _note_corrupt_fallback(path, str(exc))
         except Exception as exc:  # noqa: BLE001 — unpickle failure
             errors.append(f"{path}: {exc}")
-            log.warning("checkpoint: unreadable journal %s (%s)", path, exc)
+            _note_corrupt_fallback(path, str(exc))
+        else:
+            if errors:
+                log.warning(
+                    "checkpoint: resumed from an OLDER generation after "
+                    "%d corrupt one(s) — up to one cadence window of "
+                    "work will be re-executed", len(errors),
+                )
+            return payload
     raise JournalCorrupt("; ".join(errors))
+
+
+def _note_corrupt_fallback(path: str, why: str) -> None:
+    """One skipped-as-corrupt journal generation: loud, structured,
+    counted.  The run survives on an older generation (that is what
+    retention is for), but a silently rotting journal directory is an
+    operator problem, not a log-greppable footnote."""
+    resilience_stats.checkpoint_corrupt_fallbacks += 1
+    log.warning("checkpoint: skipping corrupt journal %s (%s)", path, why)
+    try:
+        from mythril_tpu.observability import spans as obs
+
+        obs.instant("checkpoint.corrupt_fallback", cat="resilience",
+                    path=os.path.basename(path), error=why)
+    except Exception:  # noqa: BLE001 — telemetry never blocks a resume
+        pass
 
 
 # ---------------------------------------------------------------------------

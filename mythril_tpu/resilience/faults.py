@@ -117,6 +117,10 @@ FAULT_POINTS = (
     "lease_partition",
     "remote_auth_fail",
     "frame_corrupt",
+    # knowledge store (persist/store.py): fires inside flush(), before
+    # the segment write — an armed shot aborts the flush (records stay
+    # staged), MYTHRIL_TPU_KILL_AT lands a SIGKILL mid-flush
+    "persist_flush",
 )
 
 DEFAULT_HANG_S = 30.0

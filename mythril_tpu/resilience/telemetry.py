@@ -47,6 +47,17 @@ _FIELDS = {
     # a budget expiry drains ONE request at its next boundary — the
     # partial report carries meta.resilience.partial plus this counter
     "deadline_expiries": "request wall-clock budgets that expired",
+    # a load_journal that had to skip a corrupt generation and fall
+    # back to an older one — the run continues, but the operator must
+    # see that a journal write is rotting (disk, kill cadence)
+    "checkpoint_corrupt_fallbacks": (
+        "corrupt journal generations skipped at load"
+    ),
+    # knowledge store (persist/store.py): segments failing validation
+    # are set aside and the process starts colder, never crashes
+    "persist_corrupt_segments": "knowledge-store segments quarantined",
+    "persist_flushes": "knowledge-store segments flushed",
+    "persist_report_hits": "admission-edge report cache hits",
 }
 
 

@@ -23,6 +23,7 @@ Everything here is plain threading + the metrics registry; the engine
 thread is the single consumer, HTTP handler threads are producers.
 """
 
+import logging
 import threading
 import time
 from collections import deque
@@ -31,6 +32,8 @@ from typing import Optional
 from mythril_tpu.observability.metrics import get_registry
 from mythril_tpu.serve.config import ServeConfig, current_rss_mb
 from mythril_tpu.serve.protocol import AnalyzeRequest, RequestError
+
+log = logging.getLogger(__name__)
 
 
 class Ticket:
@@ -243,6 +246,40 @@ class AdmissionQueue:
                 source: round(self._tenant_spent_s(source), 3)
                 for source in list(self._usage)
             }
+
+    def cached_response(self, request: AnalyzeRequest):
+        """Admission-edge report cache: the stored response body for an
+        EXACT prior submission (same bytecode digest, tx_count,
+        max_depth, module set, tool version), or None.  A hit is
+        re-stamped so a consumer can tell it apart from a fresh
+        analysis; the stored verdict itself is untouched.  Always None
+        when the persist plane is inert, while draining (a draining
+        server answers nothing), or on any cache-layer error — the
+        cache can only ever short-circuit, never shed or corrupt."""
+        if self._closed:
+            return None
+        try:
+            from mythril_tpu.persist.plane import (
+                code_digest, get_knowledge_plane,
+            )
+
+            plane = get_knowledge_plane()
+            if not plane.active:
+                return None
+            body = plane.report_cache_get(
+                code_digest(request.code), request.tx_count,
+                request.max_depth, request.modules,
+            )
+        except Exception:  # noqa: BLE001 — the cache never 500s a request
+            log.debug("persist: report cache lookup failed",
+                      exc_info=True)
+            return None
+        if body is None:
+            return None
+        body = dict(body)
+        body["cached"] = True
+        body["analysis_s"] = 0.0
+        return body
 
     def submit(self, request: AnalyzeRequest) -> Ticket:
         """Admit or shed.  Raises :class:`RequestError` (503 + a

@@ -119,6 +119,14 @@ class AnalysisEngine:
         args.checkpoint_dir = None
         args.resume_from = None
         set_serve_mode(True)
+        # the knowledge store loads ONCE at engine start (not lazily on
+        # the first request), so the first request already warm-starts
+        # and a corrupt store is quarantined before traffic arrives
+        from mythril_tpu.persist.plane import get_knowledge_plane
+
+        plane = get_knowledge_plane()
+        if plane.active:
+            plane.store  # open + load + register the atexit flush
 
     def debug_requests(self) -> dict:
         """The ``/debug/requests`` body: the in-flight request (phase =
@@ -434,6 +442,20 @@ class AnalysisEngine:
             ) if budget else None,
             "mode": self.mode(),
         }
+        try:
+            from mythril_tpu.persist.plane import (
+                code_digest, get_knowledge_plane,
+            )
+
+            # a finished, non-partial verdict becomes the admission
+            # edge's report cache entry (partial bodies are refused by
+            # report_cache_put itself); inert without a persist dir
+            get_knowledge_plane().report_cache_put(
+                code_digest(request.code), request.tx_count,
+                request.max_depth, request.modules, body,
+            )
+        except Exception:  # noqa: BLE001 — caching never fails a request
+            log.debug("persist: report cache store failed", exc_info=True)
         return body
 
     def _fail_request(self, rid: str, request, exc) -> dict:

@@ -155,6 +155,14 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             raw = self._read_body()
             request = parse_analyze_request(raw, self._srv.config)
+            # admission-edge report cache (persist/plane.py): an exact
+            # re-submission of a finished analysis answers here, before
+            # the queue ever sees it — no analysis, no queue slot, no
+            # tenant quota spend.  Inert without a persist store.
+            cached = self._srv.queue.cached_response(request)
+            if cached is not None:
+                self._send_json(200, cached)
+                return
             ticket = self._srv.queue.submit(request)
         except RequestError as exc:
             self._send_error_obj(exc)
@@ -317,6 +325,11 @@ class AnalysisServer:
         self.engine.join(timeout=self.config.max_deadline_s)
         if self.router is not None:
             self.router.shutdown()
+        # drain boundary: everything the daemon learned becomes durable
+        # before the process goes away (no-op without a persist store)
+        from mythril_tpu.persist.plane import get_knowledge_plane
+
+        get_knowledge_plane().flush()
         from mythril_tpu.observability import finalize_outputs
 
         finalize_outputs()

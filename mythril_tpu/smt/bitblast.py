@@ -274,6 +274,16 @@ class BlastContext:
                 del self.unsat_memo[stale]
         self.unsat_memo[key] = True
 
+    def knowledge_signature(self) -> tuple:
+        """Cheap change-detection fingerprint of the globally-valid
+        knowledge channels (what ``freeze_channels`` would capture).
+        The persist plane compares successive values to decide whether
+        a heartbeat should carry a gossip delta — all three components
+        only ever grow or bump, so an unchanged signature means an
+        identical freeze."""
+        return (len(self.unsat_memo), len(self.probe_memo),
+                self.model_version, len(self.recent_models))
+
     def unsat_memo_hit(self, key) -> bool:
         """Memo lookup that REFRESHES recency on a hit (dict preserves
         insertion order, so re-inserting moves the key to the evict-last

@@ -40,9 +40,11 @@ class EnvSpecError(RuntimeError):
 
 
 #: name -> (kind, floor, ceil); kind in {"int", "float", "listen",
-#: "file", "flag"}.  "listen" validates a HOST:PORT spec, "file" an
-#: existing non-empty file, and "flag" a kill-switch boolean (the
-#: :func:`env_flag` vocabulary — floor/ceil unused for all three).
+#: "file", "flag", "dir"}.  "listen" validates a HOST:PORT spec,
+#: "file" an existing non-empty file, "flag" a kill-switch boolean
+#: (the :func:`env_flag` vocabulary), and "dir" a usable directory
+#: path (created on demand by its owner, so it only has to NOT be an
+#: existing non-directory — floor/ceil unused for all four).
 #: Static entries cover knobs whose owning module may not have
 #: imported by validation time; env_int/env_float self-register the
 #: rest.
@@ -81,6 +83,16 @@ KNOWN_SPECS: Dict[str, Tuple[str, Optional[float], Optional[float]]] = {
     "MYTHRIL_TPU_RESIDENT_BUDGET": ("int", 1, None),
     "MYTHRIL_TPU_RESIDENT_WATCHDOG": ("int", 1, None),
     "MYTHRIL_TPU_RESIDENT_EXTRA": ("int", 1, None),
+    # incremental dispatch kill switches (ops/incremental.py)
+    "MYTHRIL_TPU_RESIDENT_POOL": ("flag", None, None),
+    "MYTHRIL_TPU_WARM_START": ("flag", None, None),
+    # persistent knowledge plane (persist/): kill switch, store
+    # directory, flush cadence, compaction cap, heartbeat gossip
+    "MYTHRIL_TPU_PERSIST": ("flag", None, None),
+    "MYTHRIL_TPU_PERSIST_DIR": ("dir", None, None),
+    "MYTHRIL_TPU_PERSIST_FLUSH_S": ("float", 0.0, None),
+    "MYTHRIL_TPU_PERSIST_CAP_MB": ("float", 1.0, None),
+    "MYTHRIL_TPU_PERSIST_GOSSIP": ("flag", None, None),
 }
 
 #: raw values :func:`env_flag` understands; anything else set on a
@@ -180,6 +192,12 @@ def validate_env(environ=None) -> None:
                 raise EnvSpecError(
                     f"{name}={raw!r}: not a flag "
                     f"(expected one of {'/'.join(FLAG_VALUES)})"
+                )
+            continue
+        if kind == "dir":
+            if os.path.exists(raw) and not os.path.isdir(raw):
+                raise EnvSpecError(
+                    f"{name}={raw!r}: exists and is not a directory"
                 )
             continue
         try:

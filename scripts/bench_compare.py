@@ -80,8 +80,14 @@ GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
 #: through one authenticated remote seat (serve/fabric.py): handshake,
 #: per-frame MAC, journal-over-the-wire, or router overhead creeping
 #: into the request path shows up here first
+#: warm_restart_speedup gates the persistent knowledge plane
+#: (persist/plane.py): a fresh process re-analyzing a seen contract
+#: against the same --persist-dir must keep answering from the durable
+#: report cache — store-load cost or cache misses creeping into the
+#: restart path show up here first
 GATED_HIGHER_BETTER = ("serve_cpm", "microbench_device_vs_host",
-                       "fleet_speedup", "states_per_s", "fabric_cpm")
+                       "fleet_speedup", "states_per_s", "fabric_cpm",
+                       "warm_restart_speedup")
 #: floor below which a baseline is noise and ratios are meaningless
 MIN_BASE = 0.05
 

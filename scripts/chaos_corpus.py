@@ -578,7 +578,8 @@ def _routable_ip():
 class _WorkerChild:
     """One ``myth worker --connect`` subprocess."""
 
-    def __init__(self, connect, secret_file, reconnect=60):
+    def __init__(self, connect, secret_file, reconnect=60,
+                 extra_env=None):
         myth = os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             "myth",
@@ -586,6 +587,7 @@ class _WorkerChild:
         env = dict(os.environ)
         env.pop("MYTHRIL_TPU_FAULT", None)
         env.pop("MYTHRIL_TPU_KILL_AT", None)
+        env.update(extra_env or {})
         self.proc = subprocess.Popen(
             [sys.executable, myth, "worker", "--connect", connect,
              "--secret-file", secret_file,
@@ -820,6 +822,211 @@ def multihost_soak_main() -> int:
 
 
 # ---------------------------------------------------------------------------
+# --persist: soak the knowledge store (persist/)
+# ---------------------------------------------------------------------------
+
+
+def persist_soak_main() -> int:
+    """The --persist driver: a shared ``--persist-dir`` must make warm
+    state SURVIVE process restarts, SIGKILL mid-flush, and deliberate
+    corruption — and gossip it across fabric seats — while findings
+    never change and nothing ever crashes."""
+    import glob
+    import shutil
+
+    import bench
+
+    failures = []
+
+    def check(scenario, ok, **detail):
+        row = {"scenario": scenario, "ok": bool(ok), **detail}
+        print(json.dumps(row))
+        if not ok:
+            failures.append(row)
+
+    print("persist soak: computing in-process CLI reference ...",
+          file=sys.stderr)
+    reference = _serve_reference()
+    print(json.dumps({"reference": reference}), file=sys.stderr)
+    corpus_rows = bench._corpus()
+    corpus = {name: (code, tx) for name, code, tx, _ in corpus_rows}
+    kb_name, (kb_code, kb_tx) = "killbilly", corpus["killbilly"]
+    alt_name = corpus_rows[1][0]  # any cache-miss contract
+    alt_code, alt_tx = corpus[alt_name]
+
+    persist_dir = tempfile.mkdtemp(prefix="mtpu-persist-soak-")
+    penv = {"MYTHRIL_TPU_PERSIST_DIR": persist_dir,
+            "MYTHRIL_TPU_PERSIST_FLUSH_S": "0"}
+
+    def submit(child, name, code, tx_count):
+        return child.analyze({
+            "code": code, "name": name, "tx_count": tx_count,
+            "deadline_s": 240, "source": "soak",
+        })
+
+    # -- scenario 1: populate cold, then a FRESH process answers the
+    # same submission from the durable report cache at parity ----------
+    child = _ServeChild(extra_env=penv)
+    try:
+        check("persist_server_ready", child.wait_ready())
+        status, body, _ = submit(child, kb_name, kb_code, kb_tx)
+        check("cold_pass_parity",
+              status == 200
+              and body.get("findings_swc") == reference[kb_name],
+              found=body.get("findings_swc") if body else None)
+    finally:
+        child.stop()
+    child = _ServeChild(extra_env=penv)
+    try:
+        check("warm_restart_ready", child.wait_ready())
+        status, body, _ = submit(child, kb_name, kb_code, kb_tx)
+        check("warm_restart_cached_parity",
+              status == 200
+              and body.get("findings_swc") == reference[kb_name]
+              and body.get("cached") is True,
+              cached=body.get("cached") if body else None)
+    finally:
+        child.stop()
+
+    # -- scenario 2: SIGKILL lands exactly at the flush point (the
+    # armed kill fires inside SegmentStore.flush) — the restarted
+    # process must stay warm for what WAS flushed and simply re-derive
+    # what was torn away, at parity throughout ------------------------
+    child = _ServeChild(extra_env=dict(
+        penv, MYTHRIL_TPU_KILL_AT="persist_flush",
+    ))
+    try:
+        check("killat_server_ready", child.wait_ready())
+        status, body, _ = submit(child, alt_name, alt_code, alt_tx)
+        # the process SIGKILLs mid-request: any client-visible outcome
+        # short of a wrong verdict is acceptable here
+        deadline = time.time() + 30
+        while time.time() < deadline and child.proc.poll() is None:
+            time.sleep(0.2)
+        check("sigkill_mid_flush_landed", child.proc.poll() is not None,
+              status=status)
+    finally:
+        child.stop()
+    child = _ServeChild(extra_env=penv)
+    try:
+        check("restart_after_torn_flush_ready", child.wait_ready())
+        status_w, body_w, _ = submit(child, kb_name, kb_code, kb_tx)
+        status_c, body_c, _ = submit(child, alt_name, alt_code, alt_tx)
+        check(
+            "torn_flush_parity",
+            status_w == 200 and status_c == 200
+            and body_w.get("findings_swc") == reference[kb_name]
+            and body_w.get("cached") is True
+            and body_c.get("findings_swc") == reference[alt_name],
+            warm_cached=body_w.get("cached") if body_w else None,
+            alt_found=body_c.get("findings_swc") if body_c else None,
+        )
+    finally:
+        child.stop()
+
+    # -- scenario 3: bit-flip every segment — the store must quarantine
+    # and the process must degrade to a cold start at exact parity ----
+    segments = sorted(glob.glob(os.path.join(persist_dir, "seg-*.bin")))
+    check("store_has_segments", bool(segments), n=len(segments))
+    for path in segments:
+        mid = os.path.getsize(path) // 2
+        with open(path, "r+b") as fh:
+            fh.seek(mid)
+            byte = fh.read(1) or b"\x00"
+            fh.seek(mid)
+            fh.write(bytes([byte[0] ^ 0xFF]))
+    child = _ServeChild(extra_env=penv)
+    try:
+        check("corrupt_store_server_ready", child.wait_ready())
+        status, body, _ = submit(child, kb_name, kb_code, kb_tx)
+        quarantined = glob.glob(
+            os.path.join(persist_dir, "*.quarantined")
+        )
+        check(
+            "corrupt_store_cold_parity",
+            status == 200
+            and body.get("findings_swc") == reference[kb_name]
+            and not body.get("cached")
+            and len(quarantined) >= len(segments),
+            quarantined=len(quarantined),
+            found=body.get("findings_swc") if body else None,
+        )
+    finally:
+        child.stop()
+
+    # -- scenario 4: kill switch — the dir is set but MYTHRIL_TPU_
+    # PERSIST=0 must restore the exact in-memory-only path (no reads,
+    # no writes, no cached answers) -----------------------------------
+    def _segment_count():
+        return len(glob.glob(os.path.join(persist_dir, "seg-*.bin")))
+
+    before = _segment_count()
+    child = _ServeChild(extra_env=dict(penv, MYTHRIL_TPU_PERSIST="0"))
+    try:
+        check("kill_switch_server_ready", child.wait_ready())
+        status, body, _ = submit(child, kb_name, kb_code, kb_tx)
+        status2, body2, _ = submit(child, kb_name, kb_code, kb_tx)
+        check(
+            "kill_switch_inert",
+            status == 200 and status2 == 200
+            and body.get("findings_swc") == reference[kb_name]
+            and not body.get("cached") and not body2.get("cached")
+            and _segment_count() == before,
+            segments_before=before, segments_after=_segment_count(),
+        )
+    finally:
+        child.stop()
+
+    # -- scenario 5: two-seat fabric — knowledge deltas ride worker
+    # heartbeats through the coordinator; findings parity through the
+    # fabric with persistence + gossip armed on every process ---------
+    secret_path = tempfile.mktemp(prefix="mtpu-persist-secret-")
+    with open(secret_path, "w") as fh:
+        fh.write("%032x\n" % random.SystemRandom().getrandbits(128))
+    gossip_dir = tempfile.mkdtemp(prefix="mtpu-persist-gossip-")
+    genv = {"MYTHRIL_TPU_PERSIST_DIR": gossip_dir,
+            "MYTHRIL_TPU_PERSIST_FLUSH_S": "0",
+            "MYTHRIL_TPU_PERSIST_GOSSIP": "1"}
+    fleet_port = _free_port()
+    connect = f"127.0.0.1:{fleet_port}"
+    child = _ServeChild(
+        extra_env=genv,
+        extra_args=["--fleet-listen", connect,
+                    "--secret-file", secret_path],
+    )
+    workers = [_WorkerChild(connect, secret_path, extra_env=genv)
+               for _ in range(2)]
+    try:
+        check("gossip_fabric_ready", child.wait_ready())
+        check("gossip_two_seats", _wait_seats(child.base, want=2))
+        parity = {}
+        for name, (code, tx_count) in corpus.items():
+            status, body, _ = submit(child, name, code, tx_count)
+            parity[name] = (
+                status == 200
+                and body.get("findings_swc") == reference[name]
+            )
+        check("gossip_fabric_parity", all(parity.values()),
+              per_contract=parity)
+    finally:
+        child.stop()
+        for worker in workers:
+            worker.stop()
+        try:
+            os.unlink(secret_path)
+        except OSError:
+            pass
+    shutil.rmtree(gossip_dir, ignore_errors=True)
+    shutil.rmtree(persist_dir, ignore_errors=True)
+
+    if failures:
+        print(json.dumps({"persist_soak_failures": failures}))
+        return 1
+    print(json.dumps({"persist_soak_ok": True, "scenarios": 5}))
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # --fleet: soak the frontier fleet
 # ---------------------------------------------------------------------------
 
@@ -980,6 +1187,13 @@ def main() -> int:
                         "worker SIGKILL, hostile peer, coordinator "
                         "SIGKILL+restart, and the fleet kill switch, "
                         "all at findings parity")
+    parser.add_argument("--persist", action="store_true",
+                        help="soak the knowledge store: warm restart "
+                        "from a shared --persist-dir, SIGKILL mid-"
+                        "flush, bit-flipped segments => quarantine + "
+                        "cold start, the MYTHRIL_TPU_PERSIST=0 kill "
+                        "switch, and two-seat heartbeat gossip — "
+                        "findings parity asserted everywhere")
     parser.add_argument("--kr-child", action="store_true",
                         help=argparse.SUPPRESS)
     parser.add_argument("--kr-dir", default=None, help=argparse.SUPPRESS)
@@ -996,6 +1210,8 @@ def main() -> int:
         return fleet_soak_main()
     if args_ns.multihost:
         return multihost_soak_main()
+    if args_ns.persist:
+        return persist_soak_main()
     rng = random.Random(args_ns.seed)
 
     import logging

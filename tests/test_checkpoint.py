@@ -147,6 +147,28 @@ def test_corrupt_newest_falls_back_one_generation(tmp_path):
     assert cp.load_journal(d) == {"n": 1}
 
 
+def test_corrupt_fallback_is_counted_and_warned(tmp_path, caplog):
+    """The fallback to an older generation must be LOUD: a structured
+    warning plus the checkpoint_corrupt_fallbacks counter, not a silent
+    resume from stale state."""
+    import logging
+
+    d = str(tmp_path)
+    cp.write_journal(d, {"n": 1})
+    newest = cp.write_journal(d, {"n": 2})
+    with open(newest, "r+b") as fh:
+        fh.seek(-1, os.SEEK_END)
+        fh.write(b"\xff")
+    before = resilience_stats.checkpoint_corrupt_fallbacks
+    with caplog.at_level(logging.WARNING,
+                         logger="mythril_tpu.resilience.checkpoint"):
+        assert cp.load_journal(d) == {"n": 1}
+    assert resilience_stats.checkpoint_corrupt_fallbacks == before + 1
+    messages = [r.getMessage() for r in caplog.records]
+    assert any("corrupt journal" in m for m in messages), messages
+    assert any("OLDER generation" in m for m in messages), messages
+
+
 def test_every_generation_corrupt_raises_loudly(tmp_path):
     d = str(tmp_path)
     for n in range(2):

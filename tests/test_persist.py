@@ -1,0 +1,513 @@
+"""Knowledge-plane tests (mythril_tpu/persist/): the crash-safe store.
+
+Three layers, all tier-1 (CPU, assembler contracts):
+
+- **Store integrity fuzz** — truncation, bit-flips, version skew, and a
+  concurrent second writer must every one yield a clean cold start
+  (quarantine + counter), never a crash, never a changed verdict.
+- **Plane semantics** — warm start / absorb through the real
+  ``SymExecWrapper`` seam at exact findings parity, version-skewed
+  payloads degrading to a miss, the report cache's key construction,
+  and the ``MYTHRIL_TPU_PERSIST=0`` kill switch restoring the exact
+  in-memory-only path both ways.
+- **Serve integration** — a fresh server against a populated
+  ``--persist-dir`` answers an exact re-submission from the durable
+  report cache >=5x faster than the cold analysis.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from mythril_tpu.persist import plane as plane_mod
+from mythril_tpu.persist.store import (
+    MAGIC,
+    STORE_VERSION,
+    SegmentStore,
+)
+
+pytestmark = pytest.mark.persist
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane(monkeypatch):
+    """Every test starts with an inert plane and no persist env; the
+    module-level singleton is reset on both sides so state can never
+    leak between tests (or into the rest of the suite)."""
+    for key in ("MYTHRIL_TPU_PERSIST", "MYTHRIL_TPU_PERSIST_DIR",
+                "MYTHRIL_TPU_PERSIST_FLUSH_S",
+                "MYTHRIL_TPU_PERSIST_CAP_MB",
+                "MYTHRIL_TPU_PERSIST_GOSSIP"):
+        monkeypatch.delenv(key, raising=False)
+    plane_mod.reset_for_tests()
+    yield
+    plane_mod.reset_for_tests()
+
+
+def _segments_of(directory):
+    return sorted(
+        name for name in os.listdir(directory)
+        if name.startswith("seg-") and name.endswith(".bin")
+    )
+
+
+def _populated_store(tmp_path, records=None):
+    store = SegmentStore(str(tmp_path)).open()
+    for kind, key, payload in records or [
+        ("channels", "d" * 64, b"payload-one"),
+        ("report", "r" * 64, b'{"ok": true}'),
+    ]:
+        store.put(kind, key, payload)
+    assert store.flush()
+    store.close()
+    return _segments_of(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# store: round trip, ordering, atomicity
+# ---------------------------------------------------------------------------
+
+
+def test_store_round_trip_survives_reopen(tmp_path):
+    _populated_store(tmp_path)
+    assert not os.path.exists(tmp_path / ".seg.tmp")
+    store = SegmentStore(str(tmp_path)).open()
+    assert store.get("channels", "d" * 64) == b"payload-one"
+    assert store.get("report", "r" * 64) == b'{"ok": true}'
+    assert store.loaded_records == 2
+    assert store.corrupt_segments == 0
+    store.close()
+
+
+def test_last_record_wins_across_segments_and_epochs(tmp_path):
+    store = SegmentStore(str(tmp_path)).open()
+    store.put("channels", "k", b"v1")
+    store.flush()
+    store.put("channels", "k", b"v2")
+    store.flush()
+    store.close()
+    # a NEW writer (epoch + 1) supersedes again
+    store = SegmentStore(str(tmp_path)).open()
+    assert store.get("channels", "k") == b"v2"
+    store.put("channels", "k", b"v3")
+    store.flush()
+    store.close()
+    store = SegmentStore(str(tmp_path)).open()
+    assert store.get("channels", "k") == b"v3"
+    store.close()
+
+
+def test_identical_reput_stays_clean(tmp_path):
+    store = SegmentStore(str(tmp_path)).open()
+    store.put("channels", "k", b"same")
+    assert store.flush()
+    store.put("channels", "k", b"same")  # no-op: identical bytes
+    assert not store.dirty
+    assert store.flush() is False
+    store.close()
+
+
+def test_injected_flush_fault_keeps_records_staged(tmp_path):
+    from mythril_tpu.resilience import faults
+
+    store = SegmentStore(str(tmp_path)).open()
+    store.put("channels", "k", b"v")
+    faults.reset_for_tests()
+    faults.get_fault_plane().arm("persist_flush", times=1)
+    try:
+        assert store.flush() is False  # aborted, never raises
+        assert store.dirty              # still staged
+        assert not _segments_of(str(tmp_path))  # nothing partial
+        assert store.flush()            # shot consumed: next one lands
+    finally:
+        faults.reset_for_tests()
+        store.close()
+
+
+def test_compaction_respects_cap(tmp_path, monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_CAP_MB", "1")
+    store = SegmentStore(str(tmp_path)).open()
+    blob = os.urandom(300_000)
+    for n in range(6):  # ~1.8MB across 6 segments > the 1MB cap
+        store.put("channels", f"k{n}", blob + bytes([n]))
+        store.flush()
+    assert len(_segments_of(str(tmp_path))) == 1  # compacted
+    store.close()
+    store = SegmentStore(str(tmp_path)).open()
+    assert store.loaded_records == 6  # the live table survived intact
+    store.close()
+
+
+# ---------------------------------------------------------------------------
+# store: integrity fuzz — corruption always degrades, never crashes
+# ---------------------------------------------------------------------------
+
+
+def test_truncation_fuzz_quarantines_never_raises(tmp_path):
+    segments = _populated_store(tmp_path)
+    path = os.path.join(str(tmp_path), segments[0])
+    size = os.path.getsize(path)
+    # every truncation point in the file (header, record header, body)
+    for keep in (0, 3, len(MAGIC), len(MAGIC) + 4, size // 2, size - 1):
+        with open(path, "wb") as fh:
+            fh.write(_read_backup(tmp_path)[:keep])
+        store = SegmentStore(str(tmp_path)).open()
+        assert store.get("channels", "d" * 64) is None  # cold
+        assert store.corrupt_segments >= 1
+        store.close()
+        _restore_segment(tmp_path, segments[0])
+
+
+def _read_backup(tmp_path):
+    backup = tmp_path / "_backup.bin"
+    if not backup.exists():
+        seg = _segments_of(str(tmp_path))[0]
+        backup.write_bytes((tmp_path / seg).read_bytes())
+    return backup.read_bytes()
+
+
+def _restore_segment(tmp_path, name):
+    for stray in os.listdir(str(tmp_path)):
+        if stray.endswith(".quarantined"):
+            os.unlink(os.path.join(str(tmp_path), stray))
+    (tmp_path / name).write_bytes(_read_backup(tmp_path))
+
+
+def test_bit_flip_fuzz_quarantines_never_raises(tmp_path):
+    segments = _populated_store(tmp_path)
+    original = _read_backup(tmp_path)
+    path = os.path.join(str(tmp_path), segments[0])
+    # flip a byte in every region: magic, header, record header, meta,
+    # payload, final byte
+    for offset in (0, len(MAGIC) + 1, len(MAGIC) + 14, len(original) // 2,
+                   len(original) - 1):
+        corrupted = bytearray(original)
+        corrupted[offset] ^= 0xFF
+        with open(path, "wb") as fh:
+            fh.write(bytes(corrupted))
+        store = SegmentStore(str(tmp_path)).open()
+        assert store.get("channels", "d" * 64) is None
+        assert store.corrupt_segments >= 1
+        assert any(n.endswith(".quarantined")
+                   for n in os.listdir(str(tmp_path)))
+        store.close()
+        _restore_segment(tmp_path, segments[0])
+
+
+def test_version_skew_quarantines(tmp_path):
+    import struct
+
+    segments = _populated_store(tmp_path)
+    original = _read_backup(tmp_path)
+    skewed = (original[: len(MAGIC)]
+              + struct.pack("<I", STORE_VERSION + 7)
+              + original[len(MAGIC) + 4:])
+    (tmp_path / segments[0]).write_bytes(skewed)
+    store = SegmentStore(str(tmp_path)).open()
+    assert store.loaded_records == 0
+    assert store.corrupt_segments == 1
+    store.close()
+
+
+def test_valid_segments_survive_a_corrupt_sibling(tmp_path):
+    store = SegmentStore(str(tmp_path)).open()
+    store.put("channels", "good", b"kept")
+    store.flush()
+    store.put("channels", "doomed", b"lost")
+    store.flush()
+    store.close()
+    doomed = _segments_of(str(tmp_path))[-1]
+    raw = bytearray((tmp_path / doomed).read_bytes())
+    raw[-1] ^= 0xFF
+    (tmp_path / doomed).write_bytes(bytes(raw))
+    store = SegmentStore(str(tmp_path)).open()
+    # all-or-nothing per segment, not per store: the valid one loads
+    assert store.get("channels", "good") == b"kept"
+    assert store.get("channels", "doomed") is None
+    assert store.corrupt_segments == 1
+    store.close()
+
+
+def test_concurrent_second_writer_degrades_read_only(tmp_path):
+    first = SegmentStore(str(tmp_path)).open()
+    first.put("channels", "k", b"v")
+    first.flush()
+    second = SegmentStore(str(tmp_path)).open()
+    try:
+        assert second.read_only          # the flock held by `first`
+        assert second.get("channels", "k") == b"v"  # warm reads still work
+        second.put("channels", "x", b"y")
+        assert second.flush() is False   # never writes
+        assert len(_segments_of(str(tmp_path))) == 1
+    finally:
+        second.close()
+        first.close()
+
+
+# ---------------------------------------------------------------------------
+# plane: gating, kill switch, degradation
+# ---------------------------------------------------------------------------
+
+
+def test_plane_inert_without_dir():
+    plane = plane_mod.get_knowledge_plane()
+    assert not plane.active
+    assert plane.store is None
+    assert plane.warm_start("d" * 64, object()) is False
+    assert plane.report_cache_get("d" * 64, 1, 22, None) is None
+    assert plane.persist_meta() is None
+
+
+def test_kill_switch_inerts_plane_both_ways(tmp_path, monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_DIR", str(tmp_path))
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST", "0")
+    plane_mod.reset_for_tests()
+    plane = plane_mod.get_knowledge_plane()
+    assert not plane.active
+    assert plane.store is None
+    assert not os.listdir(str(tmp_path))  # no store files ever created
+    # flipping the switch back on re-activates against the same dir
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST", "1")
+    plane_mod.reset_for_tests()
+    plane = plane_mod.get_knowledge_plane()
+    assert plane.active
+    assert plane.store is not None
+
+
+def test_version_skewed_channel_payload_degrades_to_cold(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_DIR", str(tmp_path))
+    plane_mod.reset_for_tests()
+    plane = plane_mod.get_knowledge_plane()
+    digest = "a" * 64
+    # garbage where a freeze_knowledge pickle should be: the store
+    # loads it happily (opaque bytes), the thaw degrades to a miss
+    plane.store.put(plane_mod.KIND_CHANNELS, digest, b"\x80\x05garbage")
+    from mythril_tpu.smt.solver import get_blast_context
+
+    assert plane.warm_start(digest, get_blast_context()) is False
+    assert plane.thaw_errors == 1
+
+
+def test_report_cache_key_includes_everything_that_changes_findings(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_DIR", str(tmp_path))
+    plane_mod.reset_for_tests()
+    plane = plane_mod.get_knowledge_plane()
+    digest = "b" * 64
+    body = {"findings_swc": ["106"], "partial": False}
+    plane.report_cache_put(digest, 2, 128, ["suicide"], body)
+    hit = plane.report_cache_get(digest, 2, 128, ["suicide"])
+    assert hit and hit["findings_swc"] == ["106"]
+    # any analysis-shaping parameter change misses by construction
+    assert plane.report_cache_get(digest, 3, 128, ["suicide"]) is None
+    assert plane.report_cache_get(digest, 2, 64, ["suicide"]) is None
+    assert plane.report_cache_get(digest, 2, 128, ["ether_thief"]) is None
+    assert plane.report_cache_get("c" * 64, 2, 128, ["suicide"]) is None
+
+
+def test_report_cache_refuses_partial_verdicts(tmp_path, monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_DIR", str(tmp_path))
+    plane_mod.reset_for_tests()
+    plane = plane_mod.get_knowledge_plane()
+    plane.report_cache_put("d" * 64, 1, 22, None,
+                           {"findings_swc": [], "partial": True})
+    assert plane.report_cache_get("d" * 64, 1, 22, None) is None
+
+
+def test_heartbeat_delta_gating(tmp_path, monkeypatch):
+    from mythril_tpu.smt.solver import get_blast_context
+
+    ctx = get_blast_context()
+    plane = plane_mod.get_knowledge_plane()
+    assert plane.encode_heartbeat_delta(ctx) is None  # inert plane
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_DIR", str(tmp_path))
+    plane_mod.reset_for_tests()
+    plane = plane_mod.get_knowledge_plane()
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_GOSSIP", "0")
+    assert plane.encode_heartbeat_delta(ctx) is None  # gossip killed
+    monkeypatch.delenv("MYTHRIL_TPU_PERSIST_GOSSIP")
+    first = plane.encode_heartbeat_delta(ctx)
+    assert isinstance(first, bytes) and first
+    # unchanged knowledge signature => no repeat delta next beat
+    assert plane.encode_heartbeat_delta(ctx) is None
+
+
+# ---------------------------------------------------------------------------
+# plane: end-to-end findings parity through the SymExecWrapper seam
+# ---------------------------------------------------------------------------
+
+
+def _analyze_killbilly():
+    """One in-process killbilly analysis with the canonical CLI reset
+    sequence; returns the SWC id set."""
+    import bench
+
+    found, _row = bench._analyze_one(
+        "killbilly", _killbilly_code(), 1,
+        execution_timeout=120, max_depth=128,
+    )
+    return found
+
+
+def _killbilly_code():
+    import bench
+
+    return bench._corpus()[0][1]
+
+
+def test_warm_restart_and_corrupt_store_findings_parity(
+        tmp_path, monkeypatch):
+    """The acceptance pin: cold == warm == corrupted-cold findings.
+    reset_for_tests + fresh first use is exactly a process restart
+    against the same directory."""
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_DIR", str(tmp_path))
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_FLUSH_S", "0")
+    plane_mod.reset_for_tests()
+    cold = _analyze_killbilly()
+    assert "106" in cold
+    assert _segments_of(str(tmp_path))  # the analysis became durable
+
+    plane_mod.reset_for_tests()  # process restart #1: warm
+    warm = _analyze_killbilly()
+    plane = plane_mod.get_knowledge_plane()
+    assert warm == cold
+    assert plane.warm_hits >= 1
+
+    # corrupt every segment: restart #2 must cold-start at parity
+    for name in _segments_of(str(tmp_path)):
+        path = os.path.join(str(tmp_path), name)
+        raw = bytearray(open(path, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        open(path, "wb").write(bytes(raw))
+    plane_mod.reset_for_tests()
+    corrupt_cold = _analyze_killbilly()
+    plane = plane_mod.get_knowledge_plane()
+    assert corrupt_cold == cold
+    assert plane.store.corrupt_segments >= 1
+    assert plane.warm_hits == 0
+
+
+def test_kill_switch_findings_parity_exact_inmemory_path(
+        tmp_path, monkeypatch):
+    """MYTHRIL_TPU_PERSIST=0 with a dir set must behave exactly like no
+    dir at all: same findings, zero store traffic."""
+    baseline = _analyze_killbilly()
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_DIR", str(tmp_path))
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST", "0")
+    plane_mod.reset_for_tests()
+    killed = _analyze_killbilly()
+    assert killed == baseline
+    assert not os.listdir(str(tmp_path))
+    plane = plane_mod.get_knowledge_plane()
+    assert plane.warm_hits == plane.warm_misses == 0
+
+
+# ---------------------------------------------------------------------------
+# serve: the durable report cache across a simulated process restart
+# ---------------------------------------------------------------------------
+
+
+def test_serve_warm_restart_answers_from_cache_5x(tmp_path, monkeypatch):
+    from mythril_tpu.serve import AnalysisServer, ServeConfig
+
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_DIR", str(tmp_path))
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_FLUSH_S", "0")
+    payload = json.dumps({
+        "code": _killbilly_code(), "name": "killbilly", "tx_count": 1,
+        "deadline_s": 120, "source": "test",
+    }).encode()
+
+    def one_server_pass():
+        plane_mod.reset_for_tests()  # fresh plane == process restart
+        server = AnalysisServer(ServeConfig.from_env(port=0))
+        server.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/analyze", data=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            began = time.monotonic()
+            body = json.loads(
+                urllib.request.urlopen(req, timeout=120).read()
+            )
+            return time.monotonic() - began, body
+        finally:
+            server.drain_and_stop("test done")
+
+    cold_s, cold_body = one_server_pass()
+    assert "106" in cold_body["findings_swc"]
+    assert not cold_body.get("cached")
+    warm_s, warm_body = one_server_pass()
+    assert warm_body["findings_swc"] == cold_body["findings_swc"]
+    assert warm_body["cached"] is True
+    assert warm_body["analysis_s"] == 0.0
+    assert cold_s / warm_s >= 5.0, (cold_s, warm_s)
+
+
+# ---------------------------------------------------------------------------
+# env knobs: registered, validated, fatal at startup
+# ---------------------------------------------------------------------------
+
+
+def test_persist_knobs_validate(monkeypatch):
+    from mythril_tpu.support.env import EnvSpecError, validate_env
+
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_FLUSH_S", "-1")
+    with pytest.raises(EnvSpecError):
+        validate_env()
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_FLUSH_S", "abc")
+    with pytest.raises(EnvSpecError):
+        validate_env()
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_FLUSH_S", "0")
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_CAP_MB", "0.5")
+    with pytest.raises(EnvSpecError):
+        validate_env()  # below the 1MB floor
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_CAP_MB", "64")
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST", "maybe")
+    with pytest.raises(EnvSpecError):
+        validate_env()
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST", "0")
+    validate_env()
+
+
+def test_persist_dir_knob_rejects_non_directory(tmp_path, monkeypatch):
+    from mythril_tpu.support.env import EnvSpecError, validate_env
+
+    file_path = tmp_path / "not-a-dir"
+    file_path.write_text("x")
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_DIR", str(file_path))
+    with pytest.raises(EnvSpecError):
+        validate_env()
+    # an absent path is fine (the store mkdirs it) and so is a real dir
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_DIR",
+                       str(tmp_path / "absent"))
+    validate_env()
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_DIR", str(tmp_path))
+    validate_env()
+
+
+def test_cli_rejects_bad_persist_knob_with_exit_2():
+    myth = os.path.join(REPO_ROOT, "myth")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MYTHRIL_TPU_PERSIST_FLUSH_S"] = "never"
+    proc = subprocess.run(
+        [sys.executable, myth, "disassemble", "-c", "6001"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "bad environment knob" in proc.stderr
