@@ -1100,8 +1100,11 @@ def _scale_summary(row):
         # iterations + on-device first-UIP clauses harvested)
         "frontier_steps", "learned_clauses",
         # symbolic lockstep tier (interpreter steps inside batched
-        # segments + their wall, the states_per_s numerator/denominator)
+        # segments + their wall, the states_per_s numerator/denominator,
+        # plus the NEEDS_HOST parks vs plane traffic that kept lanes in)
         "states_stepped", "segment_s",
+        "needs_host_boundaries", "mem_plane_ops",
+        "storage_plane_ops", "keccak_device_hashes",
         # resident solver (ops/resident.py): raw device kernel
         # invocations, persistent dispatches, their exit taxonomy,
         # and dense rows delegated into the shared state layout
@@ -1210,6 +1213,14 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
         # Absent (not null) when no segment ran — kill switch on, or a
         # corpus whose frontiers never shared a pc
         headline["states_per_s"] = summary["states_per_s"]
+    if summary.get("host_boundaries_per_1k_states") is not None:
+        # NEEDS_HOST tail: serial parks per 1k lockstep steps — the
+        # number the memory/storage/keccak planes exist to shrink,
+        # gated lower-is-better in bench_compare.  Absent (not null)
+        # when no segment ever stepped
+        headline["host_boundaries_per_1k_states"] = summary[
+            "host_boundaries_per_1k_states"
+        ]
     if "t3_wall_s" in summary:
         headline["t3_wall_s"] = summary["t3_wall_s"]
     if isinstance(mesh_scale, dict) and "skipped" not in mesh_scale:
@@ -1271,6 +1282,7 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
                     "blast_s", "sweep_util", "learned_clauses",
                     "sweeps_per_lane",
                     "h2d_bytes", "device_sweeps", "states_per_s",
+                    "host_boundaries_per_1k_states",
                     "dispatches_per_analysis",
                     "checkpoint_overhead_s", "t3_wall_s", "error",
                     "watchdog_trips", "demotions"):
@@ -1536,6 +1548,20 @@ def main() -> None:
         "plane_total_bits": sum(
             r.get("plane_total_bits", 0) for r in rows
         ),
+        # memory/storage/keccak plane traffic vs the NEEDS_HOST tail:
+        # parks back to serial stepping (every boundary is a batched
+        # segment dying early) against the scatter/gather and device
+        # hashes that kept lanes inside the segment instead
+        "needs_host_boundaries": sum(
+            r.get("needs_host_boundaries", 0) for r in rows
+        ),
+        "mem_plane_ops": sum(r.get("mem_plane_ops", 0) for r in rows),
+        "storage_plane_ops": sum(
+            r.get("storage_plane_ops", 0) for r in rows
+        ),
+        "keccak_device_hashes": sum(
+            r.get("keccak_device_hashes", 0) for r in rows
+        ),
         # degradation ladder telemetry (resilience/): a faulted or
         # flaky-device round is attributable from the artifact alone
         "watchdog_trips": sum(r.get("watchdog_trips", 0) for r in rows),
@@ -1698,6 +1724,18 @@ def main() -> None:
     )
     summary["states_per_s"] = (
         round(seg_steps / seg_wall, 1) if seg_wall else None
+    )
+    # NEEDS_HOST tail headline: serial parks per thousand lockstep
+    # steps across the same passes.  The memory/storage/keccak planes
+    # exist to shrink this number — gated lower-is-better in
+    # scripts/bench_compare.py.  None (absent from the headline) when
+    # no segment ran, so a kill-switched round keeps its cap headroom
+    seg_boundaries = summary["needs_host_boundaries"] + sum(
+        r.get("needs_host_boundaries", 0) for r in scale_rows.values()
+    )
+    summary["host_boundaries_per_1k_states"] = (
+        round(seg_boundaries / seg_steps * 1000, 2)
+        if seg_steps else None
     )
     # ledger-derived attribution: what share of all dispatched lanes
     # each funnel tier decided across this whole bench process (the

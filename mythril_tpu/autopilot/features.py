@@ -111,20 +111,29 @@ def _extract(nodes: List) -> dict:
     }
 
 
-def segment_features(lanes: int, ops: int, coherence: float) -> dict:
+def segment_features(lanes: int, ops: int, coherence: float,
+                     planes=()) -> dict:
     """Shape vector for one lockstep segment group (symbolic_lockstep):
     lane count, straight-line run length, and entry-stack coherence —
     the fraction of entry stack slots holding interned-shared or
     constant terms across the group (1.0 = fully coherent siblings,
-    0.0 = unrelated states that happen to share a pc).  Rides the same
-    signature/cost-model machinery as the solver lanes under the
-    ``lockstep`` tier key."""
-    return {
+    0.0 = unrelated states that happen to share a pc).  ``planes``
+    names the data-plane kinds ("keccak"/"mem"/"storage") the run
+    crosses: segments that gather/scatter memory or hash on-device
+    cost differently per lane than pure stack traffic, so the cost
+    model buckets them apart.  Rides the same signature/cost-model
+    machinery as the solver lanes under the ``lockstep`` tier key."""
+    features = {
         "v": FEATURE_VERSION,
         "seg_lanes": int(lanes),
         "seg_ops": int(ops),
         "seg_coherence": round(float(coherence), 3),
     }
+    if planes:
+        # key present only when a plane op is in the run: plane-free
+        # segments keep their pre-plane signatures (and ledger rows)
+        features["seg_planes"] = tuple(sorted(planes))
+    return features
 
 
 def _bucket(n: int) -> int:
@@ -144,11 +153,18 @@ def feature_signature(features: dict) -> str:
         # length bucket like cone counts; coherence in tenths — solver
         # signatures are untouched (no seg_* fields, no suffix)
         coh = int(round(features.get("seg_coherence", 0.0) * 10))
+        planes = features.get("seg_planes") or ()
+        # plane-kind suffix (k/m/s initials) only when the run crosses
+        # a data plane — plane-free signatures stay byte-identical to
+        # the pre-plane ledger
+        suffix = ("." + "".join(sorted(k[:1] for k in planes))
+                  if planes else "")
         return (
             f"f{features.get('v', 0)}"
             f".g{_bucket(features.get('seg_lanes', 0))}"
             f".o{_bucket(features.get('seg_ops', 0))}"
             f".h{coh}"
+            f"{suffix}"
         )
     ops = features.get("ops") or {}
     mix = "".join(c[0] for c in OP_CLASSES if ops.get(c))

@@ -30,18 +30,35 @@ of opcodes up to the next branch point.  This tier exploits that:
   constraint flows into the round's single ``prune_infeasible`` pass,
   which hands the whole frontier's fork masks to ``batch_check_states``
   in one dispatch (laser/batch.py);
+- **memory/storage/keccak planes** — concrete-offset
+  MLOAD/MSTORE/MSTORE8 and concrete-key SLOAD/SSTORE execute
+  in-segment as scatter/gather over batched per-lane byte and limb
+  planes (the fixed-arena layout prototyped in ``ops/lockstep.py``),
+  and SHA3 over a fully concrete memory window hashes on-device
+  through ``ops/keccak.py``, the result word re-entering the stack
+  plane.  The exact serial gas charges are preflighted stage for
+  stage, SSTORE's static-context ``WriteProtection`` is raised at the
+  serial point in the hook order, and a lane whose offset, key, or
+  hashed content is symbolic parks at a host boundary exactly as
+  before the planes landed (``MYTHRIL_TPU_SEG_PLANES_MEM=0`` restores
+  that boundary for every lane);
 - **NEEDS_HOST boundary** — any opcode outside the supported set
-  (CALL/CREATE/KECCAK, storage, host services — the same philosophy as
-  ``ops/lockstep.py``'s NEEDS_HOST set) ends the segment *before* the
-  opcode: the lane returns to the scheduler as its own successor with
-  identical machine state and the serial interpreter takes over;
+  (CALL/CREATE, new transactions, host services — the same philosophy
+  as ``ops/lockstep.py``'s NEEDS_HOST set) ends the segment *before*
+  the opcode: the lane returns to the scheduler as its own successor
+  with identical machine state and the serial interpreter takes over.
+  Every parked lane is counted in ``DispatchStats`` with the opcode
+  that parked it (``needs_host_boundaries`` / ``boundary_causes``);
 - **limb-plane carriage** — while a segment runs, a top-relative
   shadow of the group's stack slots is carried as ops/word_prop
   abstract words: batched ``f_*`` kernels over a lane axis when the
   group has 2+ lanes, scalar ``s_*`` twins otherwise
   (``MYTHRIL_TPU_SEG_PLANES=0`` disables).  The shadow is telemetry —
   known-bit density feeds ``DispatchStats`` — and never influences
-  execution;
+  execution.  JUMPI fork successors inherit a copy-on-write reference
+  to the segment's data planes: the fork itself copies nothing, the
+  next segment's shadow adopts the lane's row in place, and the first
+  post-fork write splits the backing arrays;
 - **autopilot routing** — each group's shape (lanes, run length, entry
   coherence) is scored by ``autopilot.route_segment``; shapes the cost
   model has learned to be slower per lane than
@@ -62,7 +79,10 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from mythril_tpu.laser.ethereum.evm_exceptions import VmException
+from mythril_tpu.laser.ethereum.evm_exceptions import (
+    VmException,
+    WriteProtection,
+)
 from mythril_tpu.laser.ethereum.instructions import Instruction
 from mythril_tpu.laser.ethereum.state.machine_state import STACK_LIMIT
 from mythril_tpu.laser.plugin.signals import (
@@ -70,12 +90,13 @@ from mythril_tpu.laser.plugin.signals import (
     PluginSkipWorldState,
 )
 from mythril_tpu.observability import spans as obs
+from mythril_tpu.ops import keccak as keccak_kernel
 from mythril_tpu.ops import u256
 from mythril_tpu.ops import word_prop as W
 from mythril_tpu.ops.batched_sat import dispatch_stats
-from mythril_tpu.smt import BitVec
+from mythril_tpu.smt import BitVec, symbol_factory
 from mythril_tpu.support.env import env_flag, env_int
-from mythril_tpu.support.opcodes import BY_NAME
+from mythril_tpu.support.opcodes import BY_NAME, calculate_sha3_gas
 
 log = logging.getLogger(__name__)
 
@@ -99,13 +120,31 @@ INTERIOR_OPS = frozenset(
 #: decorated semantics on a defensive copy
 TERMINATORS = frozenset(("JUMP", "JUMPI"))
 
+#: data-plane opcodes, mapped to the plane that carries them: these
+#: execute in-segment through their raw mutators when every lane's
+#: offset/key (and, for SHA3, the whole hashed window) is concrete;
+#: a lane with a symbolic shape parks at a host boundary instead
+PLANE_OPS = {
+    "MLOAD": "mem", "MSTORE": "mem", "MSTORE8": "mem",
+    "SLOAD": "storage", "SSTORE": "storage", "SHA3": "keccak",
+}
+
 _SEG_MAX_OPS_DEFAULT = 64
+_SEG_MEM_WORDS_DEFAULT = 128      # 4096-byte arena = ops/lockstep.py
+_SEG_STORAGE_SLOTS_DEFAULT = 32   # associative slots = ops/lockstep.py
+_SEG_KECCAK_MAX_DEFAULT = 256     # device-hash width cap, bytes
 
 
 def lockstep_enabled() -> bool:
     """``MYTHRIL_TPU_SYM_LOCKSTEP=0`` pins the exact per-state
     interpreter path."""
     return env_flag("MYTHRIL_TPU_SYM_LOCKSTEP", True)
+
+
+def mem_planes_enabled() -> bool:
+    """``MYTHRIL_TPU_SEG_PLANES_MEM=0`` restores the pre-plane
+    NEEDS_HOST boundary at every memory/storage/keccak opcode."""
+    return env_flag("MYTHRIL_TPU_SEG_PLANES_MEM", True)
 
 
 def _fold(op_code: str) -> str:
@@ -120,10 +159,10 @@ class _OpPlan:
     """Everything one segment step needs about one instruction."""
 
     __slots__ = ("op", "pops", "pushes", "terminator", "mutator",
-                 "transition", "instr_obj", "address")
+                 "transition", "instr_obj", "address", "plane", "mutation")
 
     def __init__(self, op, pops, pushes, terminator, mutator, transition,
-                 instr_obj, address):
+                 instr_obj, address, plane=None, mutation=False):
         self.op = op
         self.pops = pops
         self.pushes = pushes
@@ -132,6 +171,8 @@ class _OpPlan:
         self.transition = transition
         self.instr_obj = instr_obj
         self.address = address
+        self.plane = plane          # "mem" | "storage" | "keccak" | None
+        self.mutation = mutation    # SSTORE: decorator's static guard
 
 
 class SegmentPlan:
@@ -142,19 +183,22 @@ class SegmentPlan:
     mid-basic-block (checkpointed frontier, fleet handoff) groups
     exactly like a fresh fork."""
 
-    __slots__ = ("info",)
+    __slots__ = ("info", "ops")
 
     def __init__(self, code):
         self.info: List[Optional[_OpPlan]] = []
+        self.ops: List[str] = []
         instr_objs: Dict[str, Instruction] = {}
         for instr in code.instruction_list:
+            self.ops.append(instr.op_code)
             self.info.append(self._plan_op(instr, instr_objs))
 
     @staticmethod
     def _plan_op(instr, instr_objs) -> Optional[_OpPlan]:
         op = instr.op_code
         terminator = op in TERMINATORS
-        if not terminator and op not in INTERIOR_OPS:
+        plane = PLANE_OPS.get(op)
+        if not terminator and plane is None and op not in INTERIOR_OPS:
             return None
         table = BY_NAME.get(op)
         wrapped = getattr(Instruction, _fold(op) + "_", None)
@@ -162,12 +206,13 @@ class SegmentPlan:
         transition = getattr(wrapped, "transition", None)
         if table is None or mutator is None or transition is None:
             return None
-        if transition.is_state_mutation_instruction:
-            return None  # pragma: no cover — none in the supported set
-        if not terminator and not (
-            transition.increment_pc and transition.enable_gas
-        ):
+        mutation = bool(transition.is_state_mutation_instruction)
+        if mutation and plane is None:
+            return None  # pragma: no cover — SSTORE is the only one
+        if not terminator and not transition.increment_pc:
             return None  # pragma: no cover — defensive
+        if not terminator and plane is None and not transition.enable_gas:
+            return None  # pragma: no cover — plane ops charge inside
         obj = instr_objs.get(op)
         if obj is None:
             # hook-free Instruction solely as the mutator's self (push_
@@ -175,20 +220,45 @@ class SegmentPlan:
             # by the segment loop from the svm's own tables
             obj = instr_objs[op] = Instruction(op, None)
         return _OpPlan(op, table.pops, table.pushes, terminator, mutator,
-                       transition, obj, instr.address)
+                       transition, obj, instr.address, plane, mutation)
 
     def supported_at(self, pc: int) -> bool:
         return 0 <= pc < len(self.info) and self.info[pc] is not None
 
-    def run_length(self, pc: int, cap: int) -> int:
+    def op_at(self, pc: int) -> Optional[str]:
+        """Raw opcode name at ``pc`` — names the boundary cause even
+        when the op has no plan entry."""
+        if 0 <= pc < len(self.ops):
+            return self.ops[pc]
+        return None
+
+    def run_length(self, pc: int, cap: int, planes: bool = True) -> int:
         """Planned ops from ``pc`` to the segment end (inclusive of a
-        terminator), capped."""
+        terminator), capped.  With ``planes`` off, data-plane opcodes
+        bound the run like any other NEEDS_HOST boundary."""
         n = 0
         while n < cap and self.supported_at(pc + n):
+            info = self.info[pc + n]
+            if not planes and info.plane is not None:
+                break
             n += 1
-            if self.info[pc + n - 1].terminator:
+            if info.terminator:
                 break
         return n
+
+    def plane_kinds(self, pc: int, cap: int) -> Tuple[str, ...]:
+        """Sorted plane kinds ("keccak"/"mem"/"storage") the segment
+        starting at ``pc`` would cross — an autopilot routing feature."""
+        kinds = set()
+        n = 0
+        while n < cap and self.supported_at(pc + n):
+            info = self.info[pc + n]
+            if info.plane is not None:
+                kinds.add(info.plane)
+            n += 1
+            if info.terminator:
+                break
+        return tuple(sorted(kinds))
 
 
 _plan_cache: Dict[str, Optional[SegmentPlan]] = {}
@@ -237,6 +307,154 @@ def _term_sword(item):
     return W.s_top(_WM)
 
 
+def _conc(item) -> Optional[int]:
+    """Concrete value of a stack slot (raw int or constant BitVec), or
+    None for a symbolic term."""
+    if isinstance(item, int):
+        return item
+    return getattr(item, "value", None)
+
+
+_EMPTY_KECCAK: Optional[int] = None
+
+
+def _empty_keccak_int() -> int:
+    global _EMPTY_KECCAK
+    if _EMPTY_KECCAK is None:
+        from mythril_tpu.support.crypto import keccak256
+
+        _EMPTY_KECCAK = int.from_bytes(keccak256(b""), "big")
+    return _EMPTY_KECCAK
+
+
+class _LanePlanes:
+    """Batched memory and storage planes for one segment group: [lane,
+    ...] numpy arrays in the fixed-arena layout of ``ops/lockstep.py``
+    (byte plane + known-byte mask for memory, associative limb-keyed
+    slots for storage, each value carried as the four word_prop limb
+    planes).  Copy-on-write: JUMPI fork successors share a reference,
+    the fork itself copies nothing, and the first write after adoption
+    splits the backing arrays."""
+
+    __slots__ = ("mem_kv", "mem_km", "skeys", "slo", "shi", "skm",
+                 "skv", "sused", "shared")
+
+    _ARRAYS = ("mem_kv", "mem_km", "skeys", "slo", "shi", "skm",
+               "skv", "sused")
+
+    def __init__(self, lanes: int, mem_bytes: int, storage_slots: int):
+        self.mem_kv = np.zeros((lanes, mem_bytes), dtype=np.uint8)
+        self.mem_km = np.zeros((lanes, mem_bytes), dtype=bool)
+        shape = (lanes, storage_slots, u256.NUM_LIMBS)
+        self.skeys = np.zeros(shape, dtype=np.uint32)
+        self.slo = np.zeros(shape, dtype=np.uint32)
+        self.shi = np.zeros(shape, dtype=np.uint32)
+        self.skm = np.zeros(shape, dtype=np.uint32)
+        self.skv = np.zeros(shape, dtype=np.uint32)
+        self.sused = np.zeros((lanes, storage_slots), dtype=bool)
+        self.shared = False
+
+    def mark_shared(self) -> None:
+        self.shared = True
+
+    def _own(self) -> None:
+        if self.shared:
+            for name in self._ARRAYS:
+                setattr(self, name, getattr(self, name).copy())
+            self.shared = False
+
+    def seed_row(self, row: int, src: "_LanePlanes", src_row: int) -> None:
+        """Adopt one lane's planes from a forked-off segment (arena
+        shapes must match — a knob change between segments drops the
+        carry instead of mixing layouts)."""
+        if (src.mem_kv.shape[1] != self.mem_kv.shape[1]
+                or src.skeys.shape[1] != self.skeys.shape[1]):
+            return
+        for name in self._ARRAYS:
+            getattr(self, name)[row] = getattr(src, name)[src_row]
+
+    # -- memory ---------------------------------------------------------
+
+    def mem_store(self, offsets, kv_bytes, km_bytes) -> None:
+        """Batched scatter of same-width byte windows, one per lane.
+        ``offsets`` int64[L] pre-clamped to the arena size; rows fully
+        in-arena scatter, rows straddling the arena edge invalidate the
+        overlapped tail (unknown beats stale)."""
+        self._own()
+        size = self.mem_kv.shape[1]
+        width = kv_bytes.shape[1]
+        in_arena = offsets + width <= size
+        rows = np.nonzero(in_arena)[0]
+        if rows.size:
+            idx = offsets[rows, None] + np.arange(width)
+            self.mem_kv[rows[:, None], idx] = np.where(
+                km_bytes[rows], kv_bytes[rows], 0
+            )
+            self.mem_km[rows[:, None], idx] = km_bytes[rows]
+        for row in np.nonzero(~in_arena & (offsets < size))[0]:
+            self.mem_kv[row, int(offsets[row]):] = 0
+            self.mem_km[row, int(offsets[row]):] = False
+
+    def mem_load(self, offsets, width: int):
+        """Batched gather: (kv, km) uint8/bool [L, width]; rows outside
+        the arena read back fully unknown."""
+        in_arena = offsets + width <= self.mem_kv.shape[1]
+        safe = np.where(in_arena, offsets, 0)
+        idx = safe[:, None] + np.arange(width)
+        lane = np.arange(self.mem_kv.shape[0])[:, None]
+        km = self.mem_km[lane, idx] & in_arena[:, None]
+        return np.where(km, self.mem_kv[lane, idx], 0), km
+
+    def mem_invalidate(self, rows) -> None:
+        """Wipe whole lanes' memory knowledge (a symbolic-offset write
+        could have landed anywhere — unknown beats stale)."""
+        if len(rows):
+            self._own()
+            self.mem_kv[rows] = 0
+            self.mem_km[rows] = False
+
+    # -- storage --------------------------------------------------------
+
+    def storage_store(self, keys, lo, hi, km, kv, valid=None) -> None:
+        """Associative scatter (same scan as ops/lockstep h_sstore):
+        a key hit updates its slot, a miss takes the first free slot, a
+        full lane drops the new key — later loads of it miss back to
+        the live term, same-key hits stay exact.  ``valid`` masks lanes
+        out of the scatter entirely (symbolic keys)."""
+        self._own()
+        hits = (self.skeys == keys[:, None, :]).all(-1) & self.sused
+        found = hits.any(-1)
+        full = self.sused.all(-1) & ~found
+        idx = np.where(found, hits.argmax(-1), (~self.sused).argmax(-1))
+        keep = ~full if valid is None else (~full & valid)
+        rows = np.nonzero(keep)[0]
+        if rows.size:
+            self.skeys[rows, idx[rows]] = keys[rows]
+            self.slo[rows, idx[rows]] = lo[rows]
+            self.shi[rows, idx[rows]] = hi[rows]
+            self.skm[rows, idx[rows]] = km[rows]
+            self.skv[rows, idx[rows]] = kv[rows]
+            self.sused[rows, idx[rows]] = True
+
+    def storage_invalidate(self, rows) -> None:
+        """Wipe whole lanes' storage knowledge (a symbolic-key write
+        could have hit any slot — unknown beats stale)."""
+        if len(rows):
+            self._own()
+            self.sused[rows] = False
+
+    def storage_load(self, keys):
+        """Associative gather: (found bool[L], lo, hi, km, kv
+        uint32[L, 8]) — missed lanes carry garbage limbs behind a False
+        ``found``."""
+        hits = (self.skeys == keys[:, None, :]).all(-1) & self.sused
+        found = hits.any(-1)
+        idx = hits.argmax(-1)
+        lane = np.arange(keys.shape[0])
+        return (found, self.slo[lane, idx], self.shi[lane, idx],
+                self.skm[lane, idx], self.skv[lane, idx])
+
+
 def _slot_key(item):
     """Coherence identity of a stack slot: constants compare by value,
     symbolic terms by object identity (shared sub-DAG)."""
@@ -283,6 +501,19 @@ class _PlaneShadow:
         self.dead = False
         self.known_bits = 0
         self.total_bits = 0
+        self.planes: Optional[_LanePlanes] = None
+        self.mem_ops = 0
+        self.storage_ops = 0
+        self.keccak_hashes = 0
+        self._plane_args: Optional[List] = None
+        # COW adoption: a JUMPI fork attached a shared plane reference
+        # to this lane; valid only while nothing executed since (the
+        # attribute dies on any state copy, and the pc must still match)
+        self._seed_refs: List[Tuple[int, "_LanePlanes", int]] = []
+        for row, s in enumerate(states):
+            ref = s.__dict__.pop("_seg_planes", None)
+            if ref is not None and ref[2] == s.mstate.pc:
+                self._seed_refs.append((row, ref[0], ref[1]))
         if not self.scalar:
             shape = (len(states),)
             self._wm = W.width_mask(256, shape)
@@ -365,6 +596,7 @@ class _PlaneShadow:
         must run *before* the mutators, while the stacks are still
         pre-op (DUPn pops n, SWAPn pops n+1, so ``info.pops`` is
         exactly the operand depth for every supported op)."""
+        self._plane_args = None
         if self.dead or not info.pops:
             return
         if any(len(s.mstate.stack) < info.pops for s in self.states):
@@ -372,6 +604,8 @@ class _PlaneShadow:
             return
         try:
             self._materialize(info.pops - 1)
+            if info.plane is not None:
+                self._plane_args = self._capture_plane_args(info)
         except Exception:  # noqa: BLE001 — telemetry must never raise
             log.debug("plane shadow materialize failed", exc_info=True)
             self.dead = True
@@ -394,6 +628,9 @@ class _PlaneShadow:
 
     def _transfer(self, op: str, info: "_OpPlan") -> None:
         sc = self.scalar
+        if info.plane is not None and self._plane_args is not None:
+            self._transfer_plane(op, info)
+            return
         if op.startswith("PUSH"):
             item = self.states[0].mstate.stack[-1]
             if sc:
@@ -482,9 +719,218 @@ class _PlaneShadow:
                     [_term_sword(s.mstate.stack[-1]) for s in self.states]
                 ))
 
+    # -- memory/storage/keccak planes -----------------------------------
+
+    def _ensure_planes(self) -> _LanePlanes:
+        if self.planes is None:
+            mem_bytes = env_int("MYTHRIL_TPU_SEG_MEM_WORDS",
+                                _SEG_MEM_WORDS_DEFAULT, floor=1) * 32
+            slots = env_int("MYTHRIL_TPU_SEG_STORAGE_SLOTS",
+                            _SEG_STORAGE_SLOTS_DEFAULT, floor=1)
+            self.planes = _LanePlanes(len(self.states), mem_bytes, slots)
+            for row, src, src_row in self._seed_refs:
+                self.planes.seed_row(row, src, src_row)
+        return self.planes
+
+    def _capture_plane_args(self, info: "_OpPlan") -> List:
+        """Per-lane concrete plane arguments, read from the live stacks
+        *before* the mutators pop them.  SHA3 shapes are concrete by
+        the segment gate; the other plane ops may carry symbolic
+        operands (None here) — the transfer skips or invalidates those
+        lanes while the live mutators run their deterministic symbolic
+        paths in-segment."""
+        op = info.op
+        args: List = []
+        for s in self.states:
+            stack = s.mstate.stack
+            if op == "SHA3":
+                index = _conc(stack[-1])
+                length = _conc(stack[-2])
+                window = None
+                if index is not None and length is not None and length >= 0:
+                    data = []
+                    for b in s.mstate.memory[index:index + length]:
+                        v = b if isinstance(b, int) else _conc(b)
+                        if v is None:
+                            data = None
+                            break
+                        data.append(v & 0xFF)
+                    if data is not None:
+                        # pre-extension slice may fall short: the
+                        # mutator hashes the zero-extended window
+                        data.extend([0] * (length - len(data)))
+                        window = np.array(data, dtype=np.uint8)
+                args.append((index, length, window))
+            else:
+                args.append(_conc(stack[-1]))
+        return args
+
+    def _word_planes(self, word):
+        """Shadow word → four uint32[L, 8] limb planes (lifts scalar)."""
+        if self.scalar:
+            return tuple(
+                np.asarray(u256.from_int(word[k], ()),
+                           dtype=np.uint32)[None]
+                for k in range(4)
+            )
+        return word
+
+    def _from_planes(self, lo, hi, km, kv):
+        """Four uint32[L, 8] limb planes → shadow word (folds scalar)."""
+        if self.scalar:
+            return tuple(int(u256.to_int(c[0])) for c in (lo, hi, km, kv))
+        return (lo, hi, km, kv)
+
+    def _top_word(self):
+        """Term-derived word of the live stack tops (post-mutation —
+        the authoritative result the planes are measured against)."""
+        if self.scalar:
+            return _term_sword(self.states[0].mstate.stack[-1])
+        return self._lift(
+            [_term_sword(s.mstate.stack[-1]) for s in self.states]
+        )
+
+    def _meet_words(self, a, b):
+        """Both words soundly abstract the same concrete value; keep
+        the union of their known bits."""
+        _alo, _ahi, a_km, a_kv = self._word_planes(a)
+        _blo, _bhi, b_km, b_kv = self._word_planes(b)
+        km = a_km | b_km
+        kv = (a_kv & a_km) | (b_kv & b_km)
+        return self._from_planes(kv, kv | ~km, km, kv)
+
+    def _word_bytes(self, word, width: int):
+        """Value word → (kv, km) byte windows [L, width]: the low
+        ``width`` bytes in big-endian memory order, a byte known iff
+        all 8 of its bits are."""
+        _lo, _hi, km, kv = self._word_planes(word)
+        kv_b = np.asarray(u256.limbs_to_bytes(kv, xp=np))
+        km_b = np.asarray(u256.limbs_to_bytes(km, xp=np)) == 0xFF
+        return kv_b[:, -width:], km_b[:, -width:]
+
+    def _bytes_word(self, kv_b, km_b):
+        """(kv, km) byte windows [L, 32] → shadow word planes."""
+        kv = np.asarray(u256.bytes_to_limbs(np.where(km_b, kv_b, 0),
+                                            xp=np))
+        km = np.asarray(u256.bytes_to_limbs(
+            np.where(km_b, 0xFF, 0).astype(np.uint8), xp=np))
+        return self._from_planes(kv, kv | ~km, km, kv)
+
+    def _clamped_offsets(self, args, size: int):
+        """Per-lane offsets clamped into int64 range: ``size`` stands
+        in for every unusable (huge or missing) offset — it reads and
+        writes as out-of-arena."""
+        return np.array(
+            [min(a, size) if isinstance(a, int) and a >= 0 else size
+             for a in args],
+            dtype=np.int64,
+        )
+
+    def _keys_plane(self, args):
+        return np.stack([
+            np.asarray(u256.from_int(a if isinstance(a, int) else 0, ()),
+                       dtype=np.uint32)
+            for a in args
+        ])
+
+    def _transfer_plane(self, op: str, info: "_OpPlan") -> None:
+        """Advance the data planes past one memory/storage/keccak op.
+        Stacks are already mutated; the live terms stay authoritative —
+        a plane miss falls back to the term-derived word, so the planes
+        can only add known bits, never invent them."""
+        args = self._plane_args
+        planes = self._ensure_planes()
+        size = planes.mem_kv.shape[1]
+        lanes = len(self.states)
+        valid = np.array([isinstance(a, int) for a in args], dtype=bool) \
+            if op != "SHA3" else None
+        if op in ("MSTORE", "MSTORE8"):
+            _off, val = self._operands(2)
+            width = 1 if op == "MSTORE8" else 32
+            kv_b, km_b = self._word_bytes(val, width)
+            planes.mem_store(self._clamped_offsets(args, size), kv_b, km_b)
+            # a symbolic-offset store could have landed anywhere in the
+            # lane's memory — drop that lane's whole plane
+            planes.mem_invalidate(np.nonzero(~valid)[0])
+            self.mem_ops += int(valid.sum())
+            return
+        if op == "MLOAD":
+            self._operands(1)
+            kv_b, km_b = planes.mem_load(
+                self._clamped_offsets(args, size), 32
+            )
+            self._push(self._meet_words(self._bytes_word(kv_b, km_b),
+                                        self._top_word()))
+            self.mem_ops += int(valid.sum())
+            return
+        if op == "SLOAD":
+            self._operands(1)
+            found, lo, hi, km, kv = planes.storage_load(
+                self._keys_plane(args)
+            )
+            # symbolic key: _keys_plane aliased it to 0 — treat as miss
+            found = found & valid
+            km = np.where(found[:, None], km, 0).astype(np.uint32)
+            kv = np.where(found[:, None], kv & km, 0).astype(np.uint32)
+            plane_word = self._from_planes(kv, kv | ~km, km, kv)
+            self._push(self._meet_words(plane_word, self._top_word()))
+            self.storage_ops += int(valid.sum())
+            return
+        if op == "SSTORE":
+            _key, val = self._operands(2)
+            lo, hi, km, kv = self._word_planes(val)
+            planes.storage_store(self._keys_plane(args), lo, hi, km, kv,
+                                 valid=valid)
+            # a symbolic-key store could have hit any slot — drop that
+            # lane's whole storage plane
+            planes.storage_invalidate(np.nonzero(~valid)[0])
+            self.storage_ops += int(valid.sum())
+            return
+        if op == "SHA3":
+            self._operands(2)
+            self._push(self._device_hash(args))
+            return
+        raise ValueError(f"unplanned plane op {op}")  # pragma: no cover
+
+    def _device_hash(self, args):
+        """Batched on-device keccak over the lanes' concrete windows,
+        grouped by width (the kernel batches same-width rows); the
+        result word is fully known and re-enters the stack plane."""
+        lanes = len(self.states)
+        by_len: Dict[int, List[int]] = {}
+        for row, (_index, _length, window) in enumerate(args):
+            if window is not None:
+                by_len.setdefault(window.shape[0], []).append(row)
+        kv = np.zeros((lanes, u256.NUM_LIMBS), dtype=np.uint32)
+        km = np.zeros((lanes, u256.NUM_LIMBS), dtype=np.uint32)
+        for length, group_rows in by_len.items():
+            if length == 0:
+                # constant, not a device hash: keccak256(b"")
+                word = np.asarray(
+                    u256.from_int(_empty_keccak_int(), ()),
+                    dtype=np.uint32,
+                )
+                for row in group_rows:
+                    kv[row] = word
+                    km[row] = 0xFFFFFFFF
+                continue
+            data = np.stack([args[row][2] for row in group_rows])
+            words = np.asarray(keccak_kernel.digest_to_word(
+                keccak_kernel.keccak256_batch(data, xp=np), xp=np
+            ))
+            for i, row in enumerate(group_rows):
+                kv[row] = words[i]
+                km[row] = 0xFFFFFFFF
+            self.keccak_hashes += len(group_rows)
+        plane_word = self._from_planes(kv, kv | ~km, km, kv)
+        return self._meet_words(plane_word, self._top_word())
+
     def flush(self) -> None:
         dispatch_stats.plane_known_bits += self.known_bits
         dispatch_stats.plane_total_bits += self.total_bits
+        dispatch_stats.mem_plane_ops += self.mem_ops
+        dispatch_stats.storage_plane_ops += self.storage_ops
+        dispatch_stats.keccak_device_hashes += self.keccak_hashes
 
 
 # ---------------------------------------------------------------------------
@@ -520,6 +966,60 @@ def _would_out_of_gas(lane, gas_min: int) -> bool:
         tx.gas_limit = gas_limit.value
         gas_limit = gas_limit.value
     return gas_limit is not None and prospective >= gas_limit
+
+
+def _plane_out_of_gas(lane, info: _OpPlan) -> bool:
+    """Preflight for the data-plane ops, which charge gas *inside*
+    their mutators (``enable_gas=False``) after popping — replayed
+    stage for stage so the live lane faults exactly where the serial
+    copy would.  The memory ops' mem-extend stage checks only the
+    machine interval (strict >) and its failure is subsumed by the
+    final combined check; SHA3's word-gas stage checks the transaction
+    limit too, so it is replayed separately."""
+    mstate = lane.mstate
+    stack = mstate.stack
+    op = info.op
+    if op in ("MLOAD", "MSTORE", "MSTORE8"):
+        offset = _conc(stack[-1])
+        if offset is None:
+            # symbolic offset: mem_extend no-ops and the mutator
+            # charges the opcode-table minimum instead
+            return _would_out_of_gas(lane, BY_NAME[op].gas_min)
+        size = 1 if op == "MSTORE8" else 32
+        return _would_out_of_gas(
+            lane, mstate.calculate_memory_gas(offset, size) + 3
+        )
+    if op == "SLOAD":
+        from mythril_tpu.support.support_args import args as _args
+
+        min_gas = BY_NAME["SLOAD"].gas_min
+        if getattr(_args, "exact_gas_tracking", False):
+            min_gas = 50
+        return _would_out_of_gas(lane, min_gas)
+    if op == "SSTORE":
+        min_gas = BY_NAME["SSTORE"].gas_min
+        index = _conc(stack[-1])
+        value = _conc(stack[-2])
+        if index is not None and value is not None:
+            storage = lane.environment.active_account.storage
+            old_value = storage[symbol_factory.BitVecVal(index, 256)]
+            if (getattr(old_value, "value", None) is not None
+                    and old_value.value == 0 and value != 0):
+                min_gas = 20000
+        return _would_out_of_gas(lane, min_gas)
+    if op == "SHA3":
+        index = _conc(stack[-1])
+        length = _conc(stack[-2])
+        if index is None or length is None:  # pragma: no cover — gated
+            return False
+        sha3_min = calculate_sha3_gas(length)[0]
+        if _would_out_of_gas(lane, sha3_min):
+            return True
+        if length:
+            ext = mstate.calculate_memory_gas(index, length)
+            return mstate.min_gas_used + sha3_min + ext > mstate.gas_limit
+        return False
+    return False  # pragma: no cover — exhaustive over PLANE_OPS
 
 
 def _step_lane(svm, lane, info: _OpPlan):
@@ -558,6 +1058,13 @@ def _step_lane(svm, lane, info: _OpPlan):
     if (not info.terminator and info.transition.enable_gas
             and _would_out_of_gas(lane, BY_NAME[op_code].gas_min)):
         return op_code, _vm_exception_path(svm, lane, op_code, "")
+    # (static-context mutations raise WriteProtection before the
+    # mutator's gas charges run serially — skip the preflight so the
+    # same exception wins here)
+    if (info.plane is not None
+            and not (info.mutation and lane.environment.static)
+            and _plane_out_of_gas(lane, info)):
+        return op_code, _vm_exception_path(svm, lane, op_code, "")
 
     # 4. laser-level pre hook + state hooks
     try:
@@ -577,6 +1084,16 @@ def _step_lane(svm, lane, info: _OpPlan):
     try:
         for hook in svm.instr_pre_hook[op_code]:
             hook(lane)
+        if info.mutation and lane.environment.static:
+            # the StateTransition decorator's static-context guard,
+            # raised at its serial point in the order (after the
+            # instruction pre hooks, before the mutator) with its
+            # exact message — WriteProtection is a VmException, so the
+            # arm below routes it through the serial unwind
+            raise WriteProtection(
+                f"The function {op_code.lower()} cannot be executed "
+                "in a static call"
+            )
         target = copy(lane) if info.terminator else lane
         result = info.mutator(info.instr_obj, target)
         for state in result:
@@ -610,7 +1127,61 @@ class _Group:
         self.states: List = []
 
 
-def _run_group(svm, group: _Group, rounds, max_ops: int) -> int:
+def _plane_lane_ok(lane, info: _OpPlan, keccak_max: int) -> bool:
+    """Per-lane gate for the data-plane ops.  Only SHA3 still parks: a
+    symbolic index or length, an over-cap width, or any symbolic byte
+    in the hashed window means no device hash (and a symbolic index is
+    a serial crash path through Memory.__getitem__).  The other plane
+    ops run their deterministic single-successor symbolic paths
+    in-segment — the transfer skips or invalidates those lanes."""
+    if info.op != "SHA3":
+        return True
+    stack = lane.mstate.stack
+    if len(stack) < info.pops:
+        return True  # underflows in-segment through the serial arm
+    top = _conc(stack[-1])
+    if top is None:
+        return False
+    length = _conc(stack[-2])
+    if length is None or length < 0 or length > keccak_max:
+        return False
+    for b in lane.mstate.memory[top:top + length]:
+        if not isinstance(b, int) and getattr(b, "value", None) is None:
+            return False
+    return True
+
+
+def _note_boundary(op: Optional[str], lanes: int) -> None:
+    """Count lanes handed back to the serial interpreter, keyed by the
+    opcode that parked them ("cap" when the op budget ran out with
+    supported code ahead)."""
+    dispatch_stats.needs_host_boundaries += lanes
+    key = op or "end-of-code"
+    causes = dispatch_stats.boundary_causes
+    causes[key] = causes.get(key, 0) + lanes
+
+
+def _attach_planes(shadow, active, term_succs) -> None:
+    """COW fork handoff: every JUMPI/JUMP successor inherits a shared
+    reference to the segment's data planes — the fork itself copies
+    nothing; the next segment's shadow adopts the lane's row in place
+    and the first post-fork write splits the backing arrays.
+    Staleness-safe because ``GlobalState.__copy__`` drops the
+    attribute (any serial execution copies) and adoption re-checks the
+    pc."""
+    if (shadow is None or shadow.dead or shadow.planes is None
+            or len(active) != len(shadow.states)):
+        return
+    shadow.planes.mark_shared()
+    for row, succs in term_succs:
+        for succ in succs:
+            succ.__dict__["_seg_planes"] = (
+                shadow.planes, row, succ.mstate.pc
+            )
+
+
+def _run_group(svm, group: _Group, rounds, max_ops: int,
+               planes_on: bool, keccak_max: int) -> int:
     """Execute one segment group in lockstep.  Appends one round record
     per lane outcome to ``rounds`` and returns the number of (state,
     opcode) interpreter steps executed."""
@@ -621,14 +1192,38 @@ def _run_group(svm, group: _Group, rounds, max_ops: int) -> int:
               if env_flag("MYTHRIL_TPU_SEG_PLANES", True) else None)
     stepped = 0
     last_op: Optional[str] = None
+    boundary_op: Optional[str] = None
     for _ in range(max_ops):
         info = plan.info[pc] if 0 <= pc < len(plan.info) else None
         if info is None:
-            break  # NEEDS_HOST boundary: hand the lanes back below
+            # NEEDS_HOST boundary: hand the lanes back below
+            boundary_op = plan.op_at(pc)
+            break
+        if info.plane is not None:
+            if not planes_on:
+                boundary_op = info.op  # kill switch: pre-plane boundary
+                break
+            kept = []
+            for lane in active:
+                if _plane_lane_ok(lane, info, keccak_max):
+                    kept.append(lane)
+                else:
+                    # symbolic SHA3 shape: this lane parks exactly as
+                    # every lane did before the planes landed (the
+                    # entry gate guarantees last_op is set here)
+                    rounds.append((lane, last_op, [lane]))
+                    _note_boundary(info.op, 1)
+            if len(kept) != len(active):
+                if shadow is not None:
+                    shadow.dead = True  # lane set changed under it
+                active = kept
+                if not active:
+                    break
         if shadow is not None and not info.terminator:
             shadow.prepare(info)
         survivors = []
-        for lane in active:
+        term_succs: List[Tuple[int, List]] = []
+        for row, lane in enumerate(active):
             try:
                 outcome = _step_lane(svm, lane, info)
             except NotImplementedError:
@@ -640,20 +1235,31 @@ def _run_group(svm, group: _Group, rounds, max_ops: int) -> int:
                 survivors.append(lane)
             else:
                 rounds.append((lane, outcome[0], outcome[1]))
+                if info.terminator:
+                    term_succs.append((row, outcome[1]))
         stepped += len(active)
         last_op = info.op
         if shadow is not None and not info.terminator:
             shadow.step(info, len(survivors))
+        if info.terminator:
+            _attach_planes(shadow, active, term_succs)
+            active = []
+            break
         active = survivors
-        if info.terminator or not active:
-            active = [] if info.terminator else active
+        if not active:
             break
         pc += 1
-    # lanes still live at a boundary (unsupported opcode or the op cap)
-    # return to the scheduler as their own successor: identical machine
-    # state, serial interpreter next round
+    else:
+        # op budget exhausted; name the boundary for the cause ledger
+        boundary_op = "cap" if plan.supported_at(pc) else plan.op_at(pc)
+    # lanes still live at a boundary (unsupported opcode, symbolic
+    # plane shape, kill switch, or the op cap) return to the scheduler
+    # as their own successor: identical machine state, serial
+    # interpreter next round
     for lane in active:
         rounds.append((lane, last_op, [lane]))
+    if active:
+        _note_boundary(boundary_op, len(active))
     if shadow is not None:
         shadow.flush()
     return stepped
@@ -669,6 +1275,10 @@ def run_lockstep(svm, batch, rounds, create: bool, track_gas: bool):
             or not lockstep_enabled()):
         return batch, None
 
+    planes_on = mem_planes_enabled()
+    keccak_max = env_int("MYTHRIL_TPU_SEG_KECCAK_MAX_BYTES",
+                         _SEG_KECCAK_MAX_DEFAULT, floor=0)
+
     serial: List = []
     groups: Dict[Tuple[int, int], _Group] = {}
     order: List[_Group] = []
@@ -676,6 +1286,15 @@ def run_lockstep(svm, batch, rounds, create: bool, track_gas: bool):
         plan = plan_for(state.environment.code)
         pc = state.mstate.pc
         if plan is None or not plan.supported_at(pc):
+            serial.append(state)
+            continue
+        entry = plan.info[pc]
+        if entry.plane is not None and (
+                not planes_on
+                or not _plane_lane_ok(state, entry, keccak_max)):
+            # a symbolic SHA3 shape (or the kill switch) at the entry
+            # pc: the serial interpreter takes the opcode directly
+            _note_boundary(entry.op, 1)
             serial.append(state)
             continue
         key = (id(plan), pc)
@@ -715,8 +1334,10 @@ def run_lockstep(svm, batch, rounds, create: bool, track_gas: bool):
             continue
         features = segment_features(
             len(group.states),
-            group.plan.run_length(group.pc, max_ops),
+            group.plan.run_length(group.pc, max_ops, planes_on),
             entry_coherence(group.states),
+            group.plan.plane_kinds(group.pc, max_ops) if planes_on
+            else (),
         )
         if not autopilot.route_segment(features):
             serial.extend(group.states)
@@ -726,7 +1347,8 @@ def run_lockstep(svm, batch, rounds, create: bool, track_gas: bool):
         with obs.span("svm.segment", cat="svm",
                       sink=(dispatch_stats, "segment_s"),
                       lanes=len(group.states), pc=group.pc):
-            stepped = _run_group(svm, group, rounds, max_ops)
+            stepped = _run_group(svm, group, rounds, max_ops,
+                                 planes_on, keccak_max)
         dispatch_stats.states_stepped += stepped
         autopilot.note_segment(features, len(group.states),
                                time.monotonic() - began)

@@ -264,6 +264,20 @@ class DispatchStats:
         # word_prop transfers could pin
         self.plane_known_bits = 0
         self.plane_total_bits = 0
+        # NEEDS_HOST tail: lanes handed back to the serial interpreter
+        # at a segment boundary, keyed by the opcode that parked them
+        # ("cap" = op budget, "end-of-code" = fell off the bytecode) —
+        # bench divides boundaries by states_stepped for the
+        # host_boundaries_per_1k_states headline, profile_t3 prints
+        # the cause split
+        self.needs_host_boundaries = 0
+        self.boundary_causes = {}
+        # memory/storage/keccak data planes (symbolic_lockstep): lane-
+        # ops executed in-segment through each plane, and SHA3 results
+        # hashed on-device by ops/keccak.py instead of parking
+        self.mem_plane_ops = 0
+        self.storage_plane_ops = 0
+        self.keccak_device_hashes = 0
 
     def as_dict(self):
         from mythril_tpu.parallel.fleet import fleet_stats

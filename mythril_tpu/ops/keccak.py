@@ -1,0 +1,234 @@
+"""Batched keccak-256 over hi/lo uint32 lane pairs.
+
+The lockstep tier's SHA3 handling (laser/ethereum/symbolic_lockstep.py)
+needs the mapping-slot shape — ``keccak256(key ++ slot)`` over fully
+concrete memory — to stay on-device: one hash per lane, all lanes the
+same byte width, result word re-entering the stack plane.  The host
+reference (support/crypto.py) hashes one buffer at a time in pure
+Python; this module is its batched twin.
+
+Layout: the keccak-f[1600] state is 25 64-bit lanes, but TPU lanes are
+32-bit and x64 emulation is global and slow (same constraint as
+ops/u256.py), so each 64-bit lane is carried as an (hi, lo) uint32
+pair — ``uint32[B]`` per half, 50 arrays total.  Rotation amounts are
+per-position constants, so every rotl64 compiles to two static shifts
+per half; the 24 rounds and the absorb loop unroll at trace time
+(input width is static per call — the segment shadow only batches
+same-width hashes together).
+
+Like ops/u256.py / ops/word_prop.py, every kernel takes an ``xp``
+namespace: plain numpy for small host-side batches (and the
+differential tests), jax.numpy for the device path — one algorithm,
+two executors.
+"""
+
+from typing import List, Tuple
+
+import numpy as np
+
+__all__ = [
+    "RATE_BYTES", "keccak_f_batch", "keccak256_batch",
+    "digest_to_word", "mapping_slot_batch",
+]
+
+#: sponge rate of keccak-256: 136 bytes = 17 64-bit lanes per block
+RATE_BYTES = 136
+_RATE_LANES = RATE_BYTES // 8
+
+#: round constants, split into (hi, lo) uint32 halves (keccak-f[1600]
+#: has 24 rounds; values match support/crypto.py `_RC`)
+_RC64 = (
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+)
+
+#: rotation offsets indexed [x][y] (same table as support/crypto.py)
+_ROT = (
+    (0, 36, 3, 41, 18),
+    (1, 44, 10, 45, 2),
+    (62, 6, 43, 15, 61),
+    (28, 55, 25, 21, 56),
+    (27, 20, 39, 8, 14),
+)
+
+
+def _ns(xp):
+    if xp is not None:
+        return xp
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _rotl64(hi, lo, shift: int, xp):
+    """Rotate an (hi, lo) uint32 pair left by a STATIC shift amount.
+    Static because every call site's shift is a table constant — the
+    branch resolves at trace time, never on device."""
+    shift %= 64
+    if shift == 0:
+        return hi, lo
+    if shift == 32:
+        return lo, hi
+    if shift > 32:
+        hi, lo = lo, hi
+        shift -= 32
+    inv = 32 - shift
+    new_hi = ((hi << xp.uint32(shift)) | (lo >> xp.uint32(inv))) & xp.uint32(
+        0xFFFFFFFF
+    )
+    new_lo = ((lo << xp.uint32(shift)) | (hi >> xp.uint32(inv))) & xp.uint32(
+        0xFFFFFFFF
+    )
+    return new_hi, new_lo
+
+
+def keccak_f_batch(hi: List, lo: List, xp=None) -> Tuple[List, List]:
+    """One keccak-f[1600] permutation over a batch.
+
+    ``hi``/``lo`` are length-25 lists of uint32[B] arrays (flat lane
+    index ``i = x + 5*y``, matching the reference's ``lanes[x][y]``).
+    Returns new (hi, lo) lists; inputs are not mutated.
+    """
+    xp = _ns(xp)
+    hi, lo = list(hi), list(lo)
+    for rc in _RC64:
+        # theta
+        c_hi = [hi[x] ^ hi[x + 5] ^ hi[x + 10] ^ hi[x + 15] ^ hi[x + 20]
+                for x in range(5)]
+        c_lo = [lo[x] ^ lo[x + 5] ^ lo[x + 10] ^ lo[x + 15] ^ lo[x + 20]
+                for x in range(5)]
+        for x in range(5):
+            r_hi, r_lo = _rotl64(
+                c_hi[(x + 1) % 5], c_lo[(x + 1) % 5], 1, xp
+            )
+            d_hi = c_hi[(x - 1) % 5] ^ r_hi
+            d_lo = c_lo[(x - 1) % 5] ^ r_lo
+            for y in range(5):
+                hi[x + 5 * y] = hi[x + 5 * y] ^ d_hi
+                lo[x + 5 * y] = lo[x + 5 * y] ^ d_lo
+        # rho + pi: b[y][(2x+3y)%5] = rotl(a[x][y], ROT[x][y])
+        b_hi: List = [None] * 25
+        b_lo: List = [None] * 25
+        for x in range(5):
+            for y in range(5):
+                r_hi, r_lo = _rotl64(
+                    hi[x + 5 * y], lo[x + 5 * y], _ROT[x][y], xp
+                )
+                b_hi[y + 5 * ((2 * x + 3 * y) % 5)] = r_hi
+                b_lo[y + 5 * ((2 * x + 3 * y) % 5)] = r_lo
+        # chi
+        for x in range(5):
+            for y in range(5):
+                i = x + 5 * y
+                i1 = (x + 1) % 5 + 5 * y
+                i2 = (x + 2) % 5 + 5 * y
+                hi[i] = b_hi[i] ^ (~b_hi[i1] & b_hi[i2])
+                lo[i] = b_lo[i] ^ (~b_lo[i1] & b_lo[i2])
+        # iota
+        hi[0] = hi[0] ^ xp.uint32(rc >> 32)
+        lo[0] = lo[0] ^ xp.uint32(rc & 0xFFFFFFFF)
+    return hi, lo
+
+
+def keccak256_batch(data, xp=None):
+    """keccak-256 of a batch of SAME-WIDTH byte strings.
+
+    ``data``: uint8[B, L] (L a static Python int — the lockstep shadow
+    only batches hashes of identical concrete width).  Returns
+    uint8[B, 32] digests, byte-for-byte equal to
+    ``support.crypto.keccak256`` on each row.
+    """
+    xp = _ns(xp)
+    data = xp.asarray(data, dtype=xp.uint8)
+    batch = data.shape[0]
+    length = int(data.shape[1])
+    # original Keccak pad10*1 with domain byte 0x01 (not SHA3's 0x06)
+    pad_len = RATE_BYTES - (length % RATE_BYTES)
+    if pad_len == 1:
+        tail = np.array([0x81], dtype=np.uint8)
+    else:
+        tail = np.zeros(pad_len, dtype=np.uint8)
+        tail[0] = 0x01
+        tail[-1] = 0x80
+    padded = xp.concatenate(
+        [data, xp.broadcast_to(xp.asarray(tail), (batch, pad_len))],
+        axis=1,
+    )
+    zero = xp.zeros((batch,), dtype=xp.uint32)
+    hi = [zero] * 25
+    lo = [zero] * 25
+    total = length + pad_len
+    for block_start in range(0, total, RATE_BYTES):
+        for i in range(_RATE_LANES):
+            off = block_start + 8 * i
+            b = padded[:, off:off + 8].astype(xp.uint32)
+            word_lo = (b[:, 0] | (b[:, 1] << xp.uint32(8))
+                       | (b[:, 2] << xp.uint32(16))
+                       | (b[:, 3] << xp.uint32(24)))
+            word_hi = (b[:, 4] | (b[:, 5] << xp.uint32(8))
+                       | (b[:, 6] << xp.uint32(16))
+                       | (b[:, 7] << xp.uint32(24)))
+            hi[i] = hi[i] ^ word_hi
+            lo[i] = lo[i] ^ word_lo
+        hi, lo = keccak_f_batch(hi, lo, xp)
+    # squeeze: 32 bytes = lanes 0..3, little-endian per lane
+    cols = []
+    for i in range(4):
+        for half in (lo[i], hi[i]):
+            for shift in (0, 8, 16, 24):
+                cols.append(
+                    ((half >> xp.uint32(shift)) & xp.uint32(0xFF)).astype(
+                        xp.uint8
+                    )
+                )
+    return xp.stack(cols, axis=1)
+
+
+def digest_to_word(digest, xp=None):
+    """uint8[B, 32] big-endian digests -> uint32[B, 8] little-endian
+    limb words (the ops/u256.py layout the stack plane carries), i.e.
+    ``u256.from_int(int.from_bytes(digest_row, "big"))`` per row."""
+    xp = _ns(xp)
+    digest = xp.asarray(digest, dtype=xp.uint8).astype(xp.uint32)
+    limbs = []
+    for limb in range(8):
+        # limb k covers big-endian bytes [32-4k-4, 32-4k)
+        base = 32 - 4 * limb - 4
+        limbs.append(
+            (digest[:, base] << xp.uint32(24))
+            | (digest[:, base + 1] << xp.uint32(16))
+            | (digest[:, base + 2] << xp.uint32(8))
+            | digest[:, base + 3]
+        )
+    return xp.stack(limbs, axis=1)
+
+
+def mapping_slot_batch(keys, slots, xp=None):
+    """The dominant SHA3 shape: ``keccak256(key ++ slot)`` per lane.
+
+    ``keys``/``slots``: uint32[B, 8] little-endian limb words.  Returns
+    uint32[B, 8] limb words of the 64-byte-concat hash — the Solidity
+    mapping-slot address for ``mapping(... => ...)`` at ``slot``.
+    """
+    xp = _ns(xp)
+    keys = xp.asarray(keys, dtype=xp.uint32)
+    slots = xp.asarray(slots, dtype=xp.uint32)
+
+    def word_bytes(word):
+        cols = []
+        for limb in range(7, -1, -1):  # big-endian byte order
+            for shift in (24, 16, 8, 0):
+                cols.append(
+                    ((word[:, limb] >> xp.uint32(shift))
+                     & xp.uint32(0xFF)).astype(xp.uint8)
+                )
+        return xp.stack(cols, axis=1)
+
+    data = xp.concatenate([word_bytes(keys), word_bytes(slots)], axis=1)
+    return digest_to_word(keccak256_batch(data, xp), xp)

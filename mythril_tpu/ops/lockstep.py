@@ -42,6 +42,38 @@ STORAGE_SLOTS = 32
 
 RUNNING, STOPPED, RETURNED, REVERTED, ERROR, NEEDS_HOST = 0, 1, 2, 3, 4, 5
 
+# why a lane halted NEEDS_HOST, packed per lane as (reason << 8) | opcode
+# so the profiler/autopilot can tell an arena limit (fixable by sizing)
+# from an unsupported opcode (fixable only by a new handler)
+CAUSE_NONE, CAUSE_MEM_OOB, CAUSE_STORAGE_FULL, CAUSE_UNSUPPORTED = 0, 1, 2, 3
+
+_CAUSE_NAMES = {
+    CAUSE_NONE: "none",
+    CAUSE_MEM_OOB: "mem-arena-oob",
+    CAUSE_STORAGE_FULL: "storage-arena-full",
+    CAUSE_UNSUPPORTED: "unsupported-op",
+}
+
+
+def decode_cause(value) -> tuple:
+    """One packed per-lane boundary-cause -> (reason name, opcode)."""
+    value = int(value)
+    return _CAUSE_NAMES.get(value >> 8, "none"), value & 0xFF
+
+
+def cause_histogram(state) -> dict:
+    """NEEDS_HOST lanes bucketed by decoded cause:
+    {"mem-arena-oob@0x51": count, ...} — the breakdown
+    scripts/profile_t3.py reports."""
+    halt = np.asarray(state.halt)
+    cause = np.asarray(state.cause)
+    out: dict = {}
+    for lane in np.nonzero(halt == NEEDS_HOST)[0]:
+        reason, opcode = decode_cause(cause[lane])
+        key = f"{reason}@0x{opcode:02x}"
+        out[key] = out.get(key, 0) + 1
+    return out
+
 
 class Program(NamedTuple):
     """Host-prepared shared bytecode: padded code + jumpdest validity.
@@ -94,6 +126,7 @@ class EVMState(NamedTuple):
     halt: object     # i32[B]
     ret_off: object  # i32[B]
     ret_len: object  # i32[B]
+    cause: object    # i32[B]  ((reason << 8) | opcode when NEEDS_HOST)
 
 
 def init_state(batch: int, calldata: np.ndarray, calldatasize, callvalue=None,
@@ -139,6 +172,7 @@ def init_state(batch: int, calldata: np.ndarray, calldatasize, callvalue=None,
         halt=jnp.zeros(B, jnp.int32),
         ret_off=jnp.zeros(B, jnp.int32),
         ret_len=jnp.zeros(B, jnp.int32),
+        cause=jnp.zeros(B, jnp.int32),
     )
 
 
@@ -295,6 +329,16 @@ def make_step():
             for oc in opcodes:
                 sel = sel | (op == oc)
             return sel & live
+
+        def park(s, newly, reason):
+            """Halt ``newly`` lanes NEEDS_HOST, recording the packed
+            (reason, opcode) boundary cause for the profiler."""
+            return s._replace(
+                halt=jnp.where(newly, NEEDS_HOST, s.halt),
+                cause=jnp.where(
+                    newly, jnp.int32(reason << 8) | op, s.cause
+                ),
+            )
 
         # --- STOP ---
         def h_stop(s, mask):
@@ -495,10 +539,9 @@ def make_step():
             data = _gather32(s.memory, off)
             value = _bytes_to_word(data)
             stack = _set_at(s.stack, s.sp - 1, value, ok)
-            return s._replace(
-                stack=stack,
-                pc=jnp.where(ok, s.pc + 1, s.pc),
-                halt=jnp.where(mask & oob, NEEDS_HOST, s.halt),
+            return park(
+                s._replace(stack=stack, pc=jnp.where(ok, s.pc + 1, s.pc)),
+                mask & oob, CAUSE_MEM_OOB,
             )
 
         def h_mstore(s, mask):
@@ -509,11 +552,13 @@ def make_step():
             value = _peek(s, 1)
             data = _word_to_bytes(value)
             memory = _scatter32(s.memory, off, data, ok)
-            return s._replace(
-                memory=memory,
-                sp=jnp.where(ok, s.sp - 2, s.sp),
-                pc=jnp.where(ok, s.pc + 1, s.pc),
-                halt=jnp.where(mask & oob, NEEDS_HOST, s.halt),
+            return park(
+                s._replace(
+                    memory=memory,
+                    sp=jnp.where(ok, s.sp - 2, s.sp),
+                    pc=jnp.where(ok, s.pc + 1, s.pc),
+                ),
+                mask & oob, CAUSE_MEM_OOB,
             )
 
         def h_mstore8(s, mask):
@@ -527,11 +572,13 @@ def make_step():
             B = s.sp.shape[0]
             memory = s.memory.at[jnp.arange(B), off].set(value)
             memory = jnp.where(ok[:, None], memory, s.memory)
-            return s._replace(
-                memory=memory,
-                sp=jnp.where(ok, s.sp - 2, s.sp),
-                pc=jnp.where(ok, s.pc + 1, s.pc),
-                halt=jnp.where(mask & oob, NEEDS_HOST, s.halt),
+            return park(
+                s._replace(
+                    memory=memory,
+                    sp=jnp.where(ok, s.sp - 2, s.sp),
+                    pc=jnp.where(ok, s.pc + 1, s.pc),
+                ),
+                mask & oob, CAUSE_MEM_OOB,
             )
 
         # --- storage (associative linear scan over K slots) ---
@@ -568,11 +615,13 @@ def make_step():
             sused = s.sused.at[jnp.arange(B), idx].set(
                 jnp.where(write, True, s.sused[jnp.arange(B), idx])
             )
-            return s._replace(
-                skeys=skeys, svals=svals, sused=sused,
-                sp=jnp.where(mask, s.sp - 2, s.sp),
-                pc=jnp.where(mask, s.pc + 1, s.pc),
-                halt=jnp.where(mask & full, NEEDS_HOST, s.halt),
+            return park(
+                s._replace(
+                    skeys=skeys, svals=svals, sused=sused,
+                    sp=jnp.where(mask, s.sp - 2, s.sp),
+                    pc=jnp.where(mask, s.pc + 1, s.pc),
+                ),
+                mask & full, CAUSE_STORAGE_FULL,
             )
 
         # --- environment / calldata ---
@@ -662,9 +711,7 @@ def make_step():
             handled = handled | mask
             state = guarded(mask, handler)(state)
         unknown = live & ~handled
-        state = state._replace(
-            halt=jnp.where(unknown, NEEDS_HOST, state.halt)
-        )
+        state = park(state, unknown, CAUSE_UNSUPPORTED)
         return state
 
     return step

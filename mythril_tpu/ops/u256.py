@@ -67,6 +67,41 @@ def to_int(limbs) -> int:
     return value
 
 
+def bytes_to_limbs(data, xp=None):
+    """uint8[..., 32] big-endian byte windows -> uint32[..., 8]
+    little-endian limb words (``from_int(int.from_bytes(row, "big"))``
+    per row).  The memory-plane gather/scatter kernel: EVM memory is
+    big-endian bytes, the stack plane is little-endian limbs."""
+    xp = _ns(xp)
+    data = xp.asarray(data, dtype=xp.uint8).astype(xp.uint32)
+    limbs = []
+    for limb in range(NUM_LIMBS):
+        # limb k covers big-endian bytes [32-4k-4, 32-4k)
+        base = 32 - 4 * limb - 4
+        limbs.append(
+            (data[..., base] << xp.uint32(24))
+            | (data[..., base + 1] << xp.uint32(16))
+            | (data[..., base + 2] << xp.uint32(8))
+            | data[..., base + 3]
+        )
+    return xp.stack(limbs, axis=-1)
+
+
+def limbs_to_bytes(word, xp=None):
+    """uint32[..., 8] little-endian limb words -> uint8[..., 32]
+    big-endian byte windows (inverse of :func:`bytes_to_limbs`)."""
+    xp = _ns(xp)
+    word = xp.asarray(word, dtype=xp.uint32)
+    cols = []
+    for limb in range(NUM_LIMBS - 1, -1, -1):
+        for shift in (24, 16, 8, 0):
+            cols.append(
+                ((word[..., limb] >> xp.uint32(shift))
+                 & xp.uint32(0xFF)).astype(xp.uint8)
+            )
+    return xp.stack(cols, axis=-1)
+
+
 # ---------------------------------------------------------------------------
 # add / sub / neg
 # ---------------------------------------------------------------------------

@@ -65,6 +65,13 @@ KNOWN_SPECS: Dict[str, Tuple[str, Optional[float], Optional[float]]] = {
     "MYTHRIL_TPU_SEG_MIN_LANES": ("int", 1, None),
     "MYTHRIL_TPU_SEG_MAX_OPS": ("int", 1, None),
     "MYTHRIL_TPU_SEG_CEIL_MS": ("float", 0.0, None),
+    # lockstep memory/storage/keccak planes (symbolic_lockstep.py):
+    # kill switch, per-lane arena sizes, and the concrete-width cap
+    # past which SHA3 parks to the host keccak path
+    "MYTHRIL_TPU_SEG_PLANES_MEM": ("flag", None, None),
+    "MYTHRIL_TPU_SEG_MEM_WORDS": ("int", 1, None),
+    "MYTHRIL_TPU_SEG_STORAGE_SLOTS": ("int", 1, None),
+    "MYTHRIL_TPU_SEG_KECCAK_MAX_BYTES": ("int", 0, None),
     "MYTHRIL_TPU_FLEET_HEARTBEAT_S": ("float", 0.05, None),
     "MYTHRIL_TPU_FLEET_LEASE_TTL_S": ("float", 0.1, None),
     "MYTHRIL_TPU_FLEET_SPLIT_AFTER_S": ("float", 0.0, None),

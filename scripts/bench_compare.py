@@ -62,7 +62,13 @@ GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
          # the persistent kernel collapses the round ladder to ~1
          # dispatch per solve, so this creeping back UP means the
          # ladder is escaping to the host again
-         "dispatches_per_analysis")
+         "dispatches_per_analysis",
+         # symbolic lockstep NEEDS_HOST tail: serial parks per 1k
+         # lockstep steps — the memory/storage/keccak planes keep
+         # concrete-offset MLOAD/MSTORE/SLOAD/SSTORE/SHA3 inside the
+         # batched segment, so this creeping back UP means segments
+         # are dying early into serial stepping again
+         "host_boundaries_per_1k_states")
 #: gated metrics where LARGER is better (delta sign inverted):
 #: sustained warm-server throughput must not fall, the microbench
 #: device-vs-host ratio (both sides measured in the same run since the

@@ -138,6 +138,19 @@ def main() -> None:
     row["lockstep_span_share"] = round(
         lockstep_s / row["total_wall_s"], 4
     ) if row["total_wall_s"] else 0.0
+    # NEEDS_HOST boundary breakdown: which opcode (or "cap" /
+    # "end-of-code") parked lanes back to serial stepping, sorted by
+    # count — the per-cause view behind the bench headline's
+    # host_boundaries_per_1k_states, and the worklist for the next
+    # opcode worth teaching the memory/storage/keccak planes
+    causes = row.get("boundary_causes") or {}
+    row["boundary_cause_split"] = dict(
+        sorted(causes.items(), key=lambda kv: (-kv[1], kv[0]))
+    )
+    steps = row.get("states_stepped", 0)
+    row["host_boundaries_per_1k_states"] = round(
+        row.get("needs_host_boundaries", 0) / steps * 1000, 2
+    ) if steps else None
     # fleet-worker shares (populated when the run shards via
     # MYTHRIL_TPU_FLEET_WORKERS / --workers: each lease's wall lands
     # under fleet.worker:<id> via Tracer.add_external_total, so the

@@ -1,0 +1,113 @@
+"""Batched keccak kernel (ops/keccak.py) parity tests.
+
+Oracle: support.crypto.keccak256 — the same pure-Python sponge the
+keccak_function_manager uses for concrete hashes, so kernel parity here
+IS findings parity for every device-hashed SHA3 in the lockstep tier.
+Covers fuzzed widths 1–256 bytes at lane batches >= 8, the mapping-slot
+``keccak256(key ++ slot)`` shape, and numpy/jnp executor parity.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from mythril_tpu.ops import keccak, u256
+from mythril_tpu.support.crypto import keccak256
+
+pytestmark = pytest.mark.keccak
+
+
+def _ref_batch(rows):
+    return np.stack(
+        [np.frombuffer(keccak256(bytes(r)), dtype=np.uint8) for r in rows]
+    )
+
+
+@pytest.mark.parametrize("length", [1, 8, 31, 32, 33, 64, 104, 135,
+                                    136, 137, 200, 255, 256])
+def test_fuzzed_widths_match_reference(length):
+    rng = random.Random(1000 + length)
+    batch = 8
+    rows = np.array(
+        [[rng.randrange(256) for _ in range(length)] for _ in range(batch)],
+        dtype=np.uint8,
+    )
+    got = np.asarray(keccak.keccak256_batch(rows, xp=np))
+    assert got.dtype == np.uint8 and got.shape == (batch, 32)
+    np.testing.assert_array_equal(got, _ref_batch(rows))
+
+
+def test_empty_input_batch():
+    rows = np.zeros((8, 0), dtype=np.uint8)
+    got = np.asarray(keccak.keccak256_batch(rows, xp=np))
+    np.testing.assert_array_equal(got, _ref_batch(rows))
+
+
+def test_wide_batch_distinct_rows():
+    # 16 lanes, all different content: no cross-lane bleed
+    rng = random.Random(7)
+    rows = np.array(
+        [[rng.randrange(256) for _ in range(64)] for _ in range(16)],
+        dtype=np.uint8,
+    )
+    got = np.asarray(keccak.keccak256_batch(rows, xp=np))
+    np.testing.assert_array_equal(got, _ref_batch(rows))
+    assert len({bytes(r) for r in got}) == 16
+
+
+def test_digest_to_word_limb_layout():
+    rng = random.Random(9)
+    rows = np.array(
+        [[rng.randrange(256) for _ in range(40)] for _ in range(8)],
+        dtype=np.uint8,
+    )
+    digests = keccak.keccak256_batch(rows, xp=np)
+    words = np.asarray(keccak.digest_to_word(digests, xp=np))
+    for lane in range(8):
+        expect = int.from_bytes(keccak256(bytes(rows[lane])), "big")
+        assert u256.to_int(words[lane]) == expect
+
+
+def test_mapping_slot_shape():
+    # the Solidity mapping address: keccak256(key ++ slot), 64 bytes
+    rng = random.Random(11)
+    pairs = [(rng.getrandbits(256), rng.randrange(32)) for _ in range(8)]
+    keys = np.stack([u256.from_int(k) for k, _ in pairs])
+    slots = np.stack([u256.from_int(s) for _, s in pairs])
+    got = np.asarray(keccak.mapping_slot_batch(keys, slots, xp=np))
+    for lane, (key, slot) in enumerate(pairs):
+        data = key.to_bytes(32, "big") + slot.to_bytes(32, "big")
+        assert u256.to_int(got[lane]) == int.from_bytes(
+            keccak256(data), "big"
+        )
+
+
+def test_numpy_jnp_executor_parity():
+    jnp = pytest.importorskip("jax.numpy")
+    rng = random.Random(13)
+    for length in (1, 32, 64, 136, 256):
+        rows = np.array(
+            [[rng.randrange(256) for _ in range(length)]
+             for _ in range(8)],
+            dtype=np.uint8,
+        )
+        host = np.asarray(keccak.keccak256_batch(rows, xp=np))
+        dev = np.asarray(keccak.keccak256_batch(jnp.asarray(rows), xp=jnp))
+        np.testing.assert_array_equal(host, dev)
+
+
+def test_jnp_mapping_slot_parity():
+    jnp = pytest.importorskip("jax.numpy")
+    rng = random.Random(17)
+    keys = np.stack(
+        [u256.from_int(rng.getrandbits(256)) for _ in range(8)]
+    )
+    slots = np.stack([u256.from_int(i) for i in range(8)])
+    host = np.asarray(keccak.mapping_slot_batch(keys, slots, xp=np))
+    dev = np.asarray(
+        keccak.mapping_slot_batch(
+            jnp.asarray(keys), jnp.asarray(slots), xp=jnp
+        )
+    )
+    np.testing.assert_array_equal(host, dev)

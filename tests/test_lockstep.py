@@ -150,12 +150,13 @@ def test_memory_oob_offsets_halt_needs_host():
     """ADVICE r1: offsets past the arena (or with high limbs set) must
     hand the lane to the host, not alias the arena edge."""
     cases = [
-        bytes([0x61, 0xFF, 0xFF, 0x51, 0x00]),          # MLOAD 0xFFFF
-        bytes([0x60, 1, 0x64, 1, 0, 0, 0, 0, 0x52, 0x00]),  # MSTORE @2^32
+        (bytes([0x61, 0xFF, 0xFF, 0x51, 0x00]), 0x51),   # MLOAD 0xFFFF
+        # MSTORE @2^32
+        (bytes([0x60, 1, 0x64, 1, 0, 0, 0, 0, 0x52, 0x00]), 0x52),
         # MSTORE8 at an offset with a nonzero high limb (PUSH32)
-        bytes([0x60, 7, 0x7F] + [1] + [0] * 31 + [0x53, 0x00]),
+        (bytes([0x60, 7, 0x7F] + [1] + [0] * 31 + [0x53, 0x00]), 0x53),
     ]
-    for code in cases:
+    for code, opcode in cases:
         state = lockstep.init_state(
             1, np.zeros((1, 0), np.uint8), np.asarray([0], np.int32)
         )
@@ -163,6 +164,43 @@ def test_memory_oob_offsets_halt_needs_host():
         assert int(np.asarray(final.halt)[0]) == lockstep.NEEDS_HOST, (
             f"code {code.hex()} should halt NEEDS_HOST"
         )
+        # the boundary-cause plane must say WHY (and through which op)
+        reason, parked_op = lockstep.decode_cause(
+            np.asarray(final.cause)[0]
+        )
+        assert (reason, parked_op) == ("mem-arena-oob", opcode)
+
+
+def test_boundary_cause_distinguishes_parks():
+    """Arena-overflow, storage-full, and unsupported-opcode parks carry
+    distinct per-lane causes (the profiler's breakdown satellite)."""
+    # unsupported opcode: CALL (0xF1) after harmless pushes
+    code = bytes([0x60, 0, 0x60, 0, 0x60, 0, 0x60, 0, 0x60, 0, 0x60, 0,
+                  0x60, 0, 0xF1, 0x00])
+    state = lockstep.init_state(
+        1, np.zeros((1, 0), np.uint8), np.asarray([0], np.int32)
+    )
+    final, _ = lockstep.run_batch(code, state, 64)
+    assert int(np.asarray(final.halt)[0]) == lockstep.NEEDS_HOST
+    assert lockstep.decode_cause(np.asarray(final.cause)[0]) == (
+        "unsupported-op", 0xF1,
+    )
+
+    # storage arena exhaustion: SSTOREs to more distinct keys than slots
+    prog = []
+    for key in range(lockstep.STORAGE_SLOTS + 1):
+        prog += [0x60, 1, 0x61, key >> 8, key & 0xFF, 0x55]
+    prog += [0x00]
+    state = lockstep.init_state(
+        1, np.zeros((1, 0), np.uint8), np.asarray([0], np.int32)
+    )
+    final, _ = lockstep.run_batch(bytes(prog), state, 512)
+    assert int(np.asarray(final.halt)[0]) == lockstep.NEEDS_HOST
+    assert lockstep.decode_cause(np.asarray(final.cause)[0]) == (
+        "storage-arena-full", 0x55,
+    )
+    hist = lockstep.cause_histogram(final)
+    assert hist == {"storage-arena-full@0x55": 1}
 
 
 def test_memory_in_arena_roundtrip():

@@ -86,7 +86,9 @@ def make_svm() -> LaserEVM:
 def fingerprint(state: GlobalState):
     """Everything an opcode step can legally change, stringified (the
     two paths run the same mutator functions, so matching term trees
-    stringify identically)."""
+    stringify identically) — including memory and storage now that the
+    data-plane opcodes execute in-segment."""
+    storage = state.environment.active_account.storage
     return (
         state.mstate.pc,
         state.mstate.depth,
@@ -94,6 +96,11 @@ def fingerprint(state: GlobalState):
         state.mstate.max_gas_used,
         tuple(str(x) for x in state.mstate.stack),
         tuple(str(c) for c in state.world_state.constraints),
+        tuple(str(b) for b in state.mstate.memory[0:len(state.mstate.memory)]),
+        tuple(sorted(
+            (str(k), str(v))
+            for k, v in storage.printable_storage.items()
+        )),
     )
 
 
@@ -254,25 +261,31 @@ def test_out_of_gas_parity(monkeypatch):
 # segment seams
 # ---------------------------------------------------------------------------
 
-# PUSH1 1; PUSH1 2; ADD; PUSH1 0; SSTORE — four interior ops, then a
-# NEEDS_HOST boundary the segment must stop in front of
-_SEG_CODE = "6001600201600055"
+# PUSH1 1; PUSH1 2; ADD; PUSH1 0; BALANCE — four interior ops, then a
+# NEEDS_HOST boundary the segment must stop in front of (SSTORE used to
+# be the boundary here; the storage plane made it interior)
+_SEG_CODE = "6001600201600031"
 
 
 def test_needs_host_mid_segment_bailout(monkeypatch):
     """The segment halts AT the unsupported opcode with identical
-    machine state, and the serial interpreter finishes the opcode from
-    there exactly as an all-serial run would."""
+    machine state, the serial interpreter finishes the opcode from
+    there exactly as an all-serial run would, and the parked lane is
+    counted against the opcode that parked it."""
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+
     base = make_state(_SEG_CODE)
     twin = copy(base)
 
-    # all-serial reference: the four interior steps before SSTORE
+    # all-serial reference: the four interior steps before BALANCE
     svm_s = make_svm()
     serial = base
     for _ in range(4):
         (serial,), _op = serial_once(svm_s, serial)
     ref_mid = fingerprint(serial)
 
+    boundaries0 = dispatch_stats.needs_host_boundaries
+    causes0 = dispatch_stats.boundary_causes.get("BALANCE", 0)
     svm_l = make_svm()
     left, rounds = lockstep_once(svm_l, [twin], monkeypatch=monkeypatch)
     assert left == []
@@ -281,8 +294,10 @@ def test_needs_host_mid_segment_bailout(monkeypatch):
     assert last_op == "PUSH1"        # last interior op actually run
     assert succ == [lane]            # lane returns as its own successor
     assert fingerprint(lane) == ref_mid
-    assert lane.mstate.pc == 4       # parked ON the SSTORE boundary
+    assert lane.mstate.pc == 4       # parked ON the BALANCE boundary
     assert sl.plan_for(lane.environment.code).info[4] is None
+    assert dispatch_stats.needs_host_boundaries == boundaries0 + 1
+    assert dispatch_stats.boundary_causes.get("BALANCE", 0) == causes0 + 1
 
 
 def test_mid_block_entry_resume(monkeypatch):
@@ -325,8 +340,9 @@ def test_jumpi_fork_mask_split(monkeypatch):
 def test_sibling_group_batches_and_matches_serial(monkeypatch):
     """Three sibling lanes at one pc run as one lane batch (the batched
     f_* plane path) and every lane's machine state matches its serial
-    twin after the whole straight-line run."""
-    code = "6001600201600055"  # 4 interior ops, then SSTORE boundary
+    twin after the whole straight-line run — which now executes the
+    concrete-key SSTORE in-segment through the storage plane."""
+    code = "6001600201600055"  # 4 interior ops + SSTORE, then code end
     stacks = (
         [symbol_factory.BitVecSym("a", 256)],
         [symbol_factory.BitVecSym("b", 256), 5],
@@ -337,17 +353,19 @@ def test_sibling_group_batches_and_matches_serial(monkeypatch):
 
     from mythril_tpu.ops.batched_sat import dispatch_stats
     stepped0 = dispatch_stats.states_stepped
+    storage0 = dispatch_stats.storage_plane_ops
     svm_l = make_svm()
     left, rounds = lockstep_once(svm_l, lanes, monkeypatch=monkeypatch)
     assert left == []
-    assert dispatch_stats.states_stepped - stepped0 == 12  # 3 lanes x 4 ops
+    assert dispatch_stats.states_stepped - stepped0 == 15  # 3 lanes x 5 ops
+    assert dispatch_stats.storage_plane_ops - storage0 == 3
     assert len(rounds) == 3
 
     svm_s = make_svm()
     for (lane, _op, succ), twin in zip(rounds, twins):
         assert succ == [lane]
         serial = twin
-        for _ in range(4):
+        for _ in range(5):
             (serial,), _ = serial_once(svm_s, serial)
         assert fingerprint(lane) == fingerprint(serial)
 
@@ -383,10 +401,264 @@ def test_statespace_and_gas_rounds_stay_serial():
 def test_unsupported_entry_pc_falls_through():
     """A lane parked ON a NEEDS_HOST opcode goes straight to the serial
     remainder — no empty segment, no round record."""
-    lanes = [make_state(_SEG_CODE, [1, 0], pc=4)]  # ON the SSTORE
+    lanes = [make_state(_SEG_CODE, [1, 0], pc=4)]  # ON the BALANCE
     rounds = []
     serial, _ = sl.run_lockstep(make_svm(), lanes, rounds, False, False)
     assert serial == lanes and rounds == []
+
+
+# ---------------------------------------------------------------------------
+# memory/storage/keccak planes
+# ---------------------------------------------------------------------------
+
+
+def _prime_memory(state, blob):
+    state.mstate.memory.extend(len(blob))
+    for i, b in enumerate(blob):
+        state.mstate.memory[i] = b
+
+
+def plane_differential_step(code_hex, stack, monkeypatch, memory=None,
+                            gas_limit=8_000_000, static=False):
+    """One data-plane opcode through both paths.  An in-segment shape
+    must execute with exact parity; a parked shape (symbolic SHA3)
+    must hand the untouched lane to the serial remainder."""
+    base = make_state(code_hex, stack, gas_limit=gas_limit)
+    twin = copy(base)
+    spare = copy(base)
+    for s in (base, twin, spare):
+        if memory:
+            _prime_memory(s, memory)
+        s.environment.static = static
+
+    serial_new, serial_op = serial_once(make_svm(), base)
+
+    svm = make_svm()
+    monkeypatch.setenv("MYTHRIL_TPU_SEG_MAX_OPS", "1")
+    rounds = []
+    serial_left, timed_out = sl.run_lockstep(svm, [twin], rounds,
+                                             False, False)
+    assert timed_out is None
+    if serial_left:
+        # parked at the host boundary: lane untouched, no record
+        assert serial_left == [twin] and rounds == []
+        assert fingerprint(twin) == fingerprint(spare)
+        return "parked"
+    assert len(rounds) == 1
+    _lane, lock_op, lock_new = rounds[0]
+    assert lock_op == serial_op
+    got = sorted(fingerprint(s) for s in lock_new)
+    want = sorted(fingerprint(s) for s in serial_new)
+    assert got == want, (
+        f"divergence on {lock_op}: lockstep={got} serial={want}"
+    )
+    return "executed"
+
+
+PLANE_FUZZ_OPS = ("MLOAD", "MSTORE", "MSTORE8", "SLOAD", "SSTORE", "SHA3")
+
+
+def test_plane_differential_fuzz(monkeypatch):
+    """Memory/storage/keccak opcodes over randomized concrete AND
+    symbolic offsets/keys/values: every shape except a symbolic SHA3
+    executes in-segment with zero divergence on (pc, stack, memory,
+    storage, constraints) — symbolic offsets/keys ride the live
+    mutators' deterministic paths while the planes skip those lanes —
+    and only symbolic SHA3 shapes park untouched."""
+    rng = random.Random(0x5EED)
+    offsets = [0, 1, 31, 32, 96, 4095, 4096, 8192, 2**200]
+    outcomes = {op: set() for op in PLANE_FUZZ_OPS}
+    for op in PLANE_FUZZ_OPS:
+        code = f"{BY_NAME[op].byte:02x}"
+        for trial in range(14):
+            sym = symbol_factory.BitVecSym(f"p{op}_{trial}", 256)
+            off = (sym if trial % 3 == 2
+                   else rng.choice(offsets))
+            val = rng.choice(
+                [rng.choice(_INTERESTING),
+                 symbol_factory.BitVecSym(f"v{op}_{trial}", 256)]
+            )
+            memory = None
+            if op == "SHA3":
+                length = rng.choice([0, 1, 32, 64, 136, 256, 300])
+                if trial % 3 == 2:
+                    length = sym
+                stack = [length, rng.choice([0, 32, 4096])]
+                memory = [rng.randrange(256) for _ in range(128)]
+                if trial % 5 == 4:
+                    memory[7] = symbol_factory.BitVecSym(
+                        f"m{trial}", 8
+                    )  # symbolic byte in the window -> park
+            elif op == "MLOAD":
+                stack = [off]
+                memory = [rng.randrange(256) for _ in range(64)]
+            elif op in ("MSTORE", "MSTORE8"):
+                stack = [val, off]
+            elif op == "SLOAD":
+                stack = [off]
+            else:  # SSTORE
+                stack = [val, off]
+            if trial == 0:
+                stack = stack[:-1] or []  # underflow arm
+            outcomes[op].add(plane_differential_step(
+                code, stack, monkeypatch, memory=memory
+            ))
+    for op, seen in outcomes.items():
+        assert "executed" in seen, f"{op} never took the plane path"
+        if op == "SHA3":
+            assert "parked" in seen, "SHA3 never exercised the park arm"
+        else:
+            assert "parked" not in seen, (
+                f"{op} parked — symbolic operands must stay in-segment"
+            )
+
+
+def test_plane_segment_memory_storage_keccak_roundtrip(monkeypatch):
+    """A full segment that stores, loads, hashes and stores the digest
+    — PUSH/MSTORE/MLOAD/SHA3/SSTORE straight line — runs entirely
+    in-segment over multiple lanes with serial-exact machine state,
+    and the plane/device counters move."""
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+
+    code = (
+        "7f" + "11" * 32       # PUSH32 0x1111..11
+        + "600052"             # PUSH1 0; MSTORE
+        + "6020600020"         # PUSH1 32; PUSH1 0; SHA3
+        + "600555"             # PUSH1 5; SSTORE
+        + "600554"             # PUSH1 5; SLOAD
+        + "600051"             # PUSH1 0; MLOAD
+    )
+    lanes = [make_state(code), make_state(code)]
+    twins = [copy(s) for s in lanes]
+
+    mem0 = dispatch_stats.mem_plane_ops
+    sto0 = dispatch_stats.storage_plane_ops
+    kec0 = dispatch_stats.keccak_device_hashes
+    svm_l = make_svm()
+    left, rounds = lockstep_once(svm_l, lanes, monkeypatch=monkeypatch)
+    assert left == []
+    assert len(rounds) == 2
+    # 2 lanes x (MSTORE + MLOAD) and x (SSTORE + SLOAD), 2 device hashes
+    assert dispatch_stats.mem_plane_ops - mem0 == 4
+    assert dispatch_stats.storage_plane_ops - sto0 == 4
+    assert dispatch_stats.keccak_device_hashes - kec0 == 2
+
+    svm_s = make_svm()
+    for (lane, _op, succ), twin in zip(rounds, twins):
+        assert succ == [lane]
+        serial = twin
+        for _ in range(12):
+            (serial,), _ = serial_once(svm_s, serial)
+        assert fingerprint(lane) == fingerprint(serial)
+
+
+def test_plane_fork_split_parity(monkeypatch):
+    """MSTORE before a symbolic JUMPI, MLOAD/SHA3 after: the fork
+    splits the planes copy-on-write and both re-entering branches stay
+    serial-exact (the adoption path) on every lane."""
+    # byte layout: 0 PUSH1 0x42 | 2 PUSH1 0 | 4 MSTORE | 5 PUSH1 9 |
+    # 7 JUMPI | 8 STOP | 9 JUMPDEST | 10 PUSH1 32 | 12 PUSH1 0 |
+    # 14 SHA3 | 15 STOP  (instruction indices 0..10, JUMPDEST at 6)
+    code = (
+        "6042600052"           # PUSH1 0x42; PUSH1 0; MSTORE
+        + "600957"             # PUSH1 9; JUMPI  (dest = JUMPDEST byte)
+        + "00"                 # STOP (fall-through branch)
+        + "5b6020600020"       # JUMPDEST; PUSH1 32; PUSH1 0; SHA3
+        + "00"                 # STOP
+    )
+    cond = symbol_factory.BitVecSym("fork_c", 256)
+    lane = make_state(code, [cond])
+    twin = copy(lane)
+
+    svm_l = make_svm()
+    left, rounds = lockstep_once(svm_l, [lane], monkeypatch=monkeypatch)
+    assert left == [] and len(rounds) == 1
+    _lane, op, succ = rounds[0]
+    assert op == "JUMPI" and len(succ) == 2
+    # both successors carry the COW plane attachment
+    assert all("_seg_planes" in s.__dict__ for s in succ)
+
+    # the jump-taken branch re-enters lockstep at the JUMPDEST; SHA3
+    # hashes the 0x42 word carried over through the adopted mem plane
+    taken = [s for s in succ if s.mstate.pc == 6]
+    untaken = [s for s in succ if s.mstate.pc != 6]
+    assert len(taken) == 1 and len(untaken) == 1
+    keccak0 = sl.dispatch_stats.keccak_device_hashes
+    rounds2 = []
+    left2, _ = sl.run_lockstep(svm_l, list(taken), rounds2,
+                               False, False)
+    assert left2 == [] and len(rounds2) == 1
+    lane2, op2, succ2 = rounds2[0]
+    assert op2 == "SHA3" and succ2 == [lane2]
+    assert lane2.mstate.pc == 10
+    assert sl.dispatch_stats.keccak_device_hashes == keccak0 + 1
+    # adoption consumed the attachment
+    assert "_seg_planes" not in lane2.__dict__
+
+    svm_s = make_svm()
+    mid = twin
+    for _ in range(4):                  # PUSH1 0x42; PUSH1 0; MSTORE;
+        (mid,), _ = serial_once(svm_s, mid)              # PUSH1 9
+    serial_succ, _ = serial_once(svm_s, mid)             # JUMPI fork
+    assert len(serial_succ) == 2
+    s_taken = [s for s in serial_succ if s.mstate.pc == 6][0]
+    s_untaken = [s for s in serial_succ if s.mstate.pc != 6][0]
+    # untaken branches match straight off the fork
+    assert fingerprint(untaken[0]) == fingerprint(s_untaken)
+    for _ in range(4):                  # JUMPDEST; PUSH1 32; PUSH1 0;
+        (s_taken,), _ = serial_once(svm_s, s_taken)      # SHA3
+    assert fingerprint(lane2) == fingerprint(s_taken)
+
+
+def test_plane_gas_parity(monkeypatch):
+    """Exhausted gas intervals fault the plane ops exactly where the
+    serial staged charges do (mem-extend stage, word-gas stage, sstore
+    20k zero->nonzero minimum)."""
+    rng = random.Random(3)
+    for gas_limit in (0, 2, 3, 5, 30, 42, 5000, 19999, 20000):
+        plane_differential_step("52", [7, 0], monkeypatch,
+                                gas_limit=gas_limit)          # MSTORE
+        plane_differential_step("51", [0], monkeypatch,
+                                gas_limit=gas_limit)          # MLOAD
+        plane_differential_step("55", [rng.choice([0, 9]), 1],
+                                monkeypatch, gas_limit=gas_limit)  # SSTORE
+        plane_differential_step("54", [1], monkeypatch,
+                                gas_limit=gas_limit)          # SLOAD
+        plane_differential_step("20", [64, 0], monkeypatch,
+                                gas_limit=gas_limit)          # SHA3
+
+
+def test_sstore_static_context_write_protection_parity(monkeypatch):
+    """SSTORE inside a STATICCALL context raises WriteProtection at
+    the exact serial point — successors, hooks and revert shape all
+    match."""
+    assert plane_differential_step(
+        "55", [3, 1], monkeypatch, static=True
+    ) == "executed"
+
+
+def test_mem_planes_kill_switch_restores_boundary(monkeypatch):
+    """MYTHRIL_TPU_SEG_PLANES_MEM=0 turns every data-plane opcode back
+    into the pre-plane NEEDS_HOST boundary: entry lanes fall through to
+    serial, mid-segment lanes park with a cause record."""
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+
+    monkeypatch.setenv("MYTHRIL_TPU_SEG_PLANES_MEM", "0")
+    # ON an SSTORE: straight to serial
+    lanes = [make_state("6001600201600055", [1, 0], pc=4)]
+    rounds = []
+    serial, _ = sl.run_lockstep(make_svm(), lanes, rounds, False, False)
+    assert serial == lanes and rounds == []
+
+    # mid-segment: parks in front of the SSTORE like the seed tier did
+    causes0 = dispatch_stats.boundary_causes.get("SSTORE", 0)
+    lane = make_state("6001600201600055")
+    rounds = []
+    serial, _ = sl.run_lockstep(make_svm(), [lane], rounds, False, False)
+    assert serial == []
+    assert len(rounds) == 1 and rounds[0][2] == [lane]
+    assert lane.mstate.pc == 4
+    assert dispatch_stats.boundary_causes.get("SSTORE", 0) > causes0
 
 
 # ---------------------------------------------------------------------------
@@ -418,6 +690,16 @@ def test_full_pipeline_kill_switch_findings_parity(monkeypatch):
     found_off, row_off = _chaos_analyze("lockstep_off")
     assert row_off.get("states_stepped", 0) == 0
     assert found_on == found_off == {"106"}, (found_on, found_off)
+    # memory/storage/keccak planes off (tier still on): the affected
+    # opcodes become boundaries again, findings identical
+    monkeypatch.setenv("MYTHRIL_TPU_SYM_LOCKSTEP", "1")
+    monkeypatch.setenv("MYTHRIL_TPU_SEG_PLANES_MEM", "0")
+    found_noplanes, row_noplanes = _chaos_analyze("planes_off")
+    assert row_noplanes.get("states_stepped", 0) > 0
+    assert row_noplanes.get("mem_plane_ops", 0) == 0
+    assert row_noplanes.get("storage_plane_ops", 0) == 0
+    assert row_noplanes.get("keccak_device_hashes", 0) == 0
+    assert found_noplanes == found_on, (found_noplanes, found_on)
 
 
 def test_hook_parity_on_chaos_tree(monkeypatch):
