@@ -1129,6 +1129,56 @@ def _scale_summary(row):
     return out
 
 
+def _wild_microbench():
+    """Wild-bytecode envelope headline (scripts/corpus_sweep.py): one
+    fixture sweep through the hardened loader for the tail latency
+    (``corpus_p95_s``, gated lower-is-better in bench_compare) and one
+    mutation-fuzz round for the never-crash fraction
+    (``wild_survival_pct``, gated higher-is-better — anything under
+    100 means an exception crossed a boundary that promised it never
+    would).  Both run as subprocesses so a hardening regression can at
+    worst fail a row, never the bench."""
+    import subprocess as _subprocess
+
+    sweep = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts",
+        "corpus_sweep.py",
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MYTHRIL_TPU_FAULT", None)
+    env.pop("MYTHRIL_TPU_KILL_AT", None)
+
+    def one(extra):
+        proc = _subprocess.run(
+            [sys.executable, sweep, "--deadline-s", "2",
+             "--max-depth", "16"] + extra,
+            capture_output=True, text=True, timeout=420, env=env,
+        )
+        report = None
+        for line in reversed(proc.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                report = json.loads(line)
+                break
+        return proc.returncode, report
+
+    out = {}
+    rc, sweep_report = one(["--limit", "12"])
+    if rc != 0 or not sweep_report:
+        out["error"] = f"fixture sweep exited {rc}"
+        return out
+    out["corpus_contracts"] = sweep_report["contracts"]
+    out["corpus_p95_s"] = sweep_report["corpus_p95_s"]
+    out["corpus_survival_pct"] = sweep_report["survival_pct"]
+    out["findings_rate"] = sweep_report["findings_rate"]
+    rc, wild_report = one(["--wild", "25"])
+    if wild_report is None:
+        out["error"] = f"wild fuzz exited {rc}"
+        return out
+    out["wild_cases"] = wild_report["cases"]
+    out["wild_survival_pct"] = wild_report["wild_survival_pct"]
+    return out
+
+
 def build_headline_line(summary, mesh_scale, microbench) -> str:
     """The ONE stdout line the driver's tail capture is judged on:
     compact (hard-capped at 500 chars), holding the corpus wall,
@@ -1266,12 +1316,21 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
             "warm_restart_speedup"
         ]
         headline["persist_hit_rate"] = summary.get("persist_hit_rate")
+    if isinstance(summary.get("corpus_p95_s"), (int, float)):
+        # wild-bytecode envelope: fixture-sweep p95 wall (gated
+        # lower-is-better in bench_compare) and the mutation-fuzz
+        # never-crash fraction (gated higher-is-better; 100 or bust).
+        # Absent (not null) on --quick runs or when the sweep errored
+        headline["corpus_p95_s"] = summary["corpus_p95_s"]
+    if isinstance(summary.get("wild_survival_pct"), (int, float)):
+        headline["wild_survival_pct"] = summary["wild_survival_pct"]
     if "error" in summary:
         headline["error"] = str(summary["error"])[:160]
     line = json.dumps(headline)
     if len(line) > 500:  # hard cap so the tail capture can never lose it
         for key in ("autopilot_tuned", "autopilot_ladder",
                     "autopilot_routed", "tier_decided_pct",
+                    "wild_survival_pct", "corpus_p95_s",
                     "persist_hit_rate", "warm_restart_speedup",
                     "fabric_cpm",
                     "worker_deaths_recovered", "fleet_speedup",
@@ -1469,6 +1528,16 @@ def main() -> None:
             persist_bench = {"error": str(exc)[:200]}
     print(json.dumps({"persist_microbench": persist_bench}),
           file=sys.stderr)
+    # wild-bytecode microbench (scripts/corpus_sweep.py): fixture-sweep
+    # tail latency + mutation-fuzz survival, in subprocesses
+    if quick:
+        wild_bench = {"skipped": "--quick run"}
+    else:
+        try:
+            wild_bench = _wild_microbench()
+        except Exception as exc:  # noqa: BLE001 — bench must not die here
+            wild_bench = {"error": str(exc)[:200]}
+    print(json.dumps({"wild_microbench": wild_bench}), file=sys.stderr)
     summary = {
         "metric": "analyze_corpus_wall_s",
         "value": round(wall, 2),
@@ -1651,6 +1720,11 @@ def main() -> None:
         summary["persist_hit_rate"] = persist_bench.get(
             "persist_hit_rate"
         )
+    summary["wild_microbench"] = wild_bench
+    if isinstance(wild_bench.get("corpus_p95_s"), (int, float)):
+        summary["corpus_p95_s"] = wild_bench["corpus_p95_s"]
+    if isinstance(wild_bench.get("wild_survival_pct"), (int, float)):
+        summary["wild_survival_pct"] = wild_bench["wild_survival_pct"]
     # headline sweep utilization: over the corpus pass AND the scale
     # scenarios (the corpus's narrow frontiers rarely dispatch, so the
     # scale rows are where the ratio carries signal)

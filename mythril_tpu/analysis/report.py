@@ -274,6 +274,14 @@ class Report:
                 # cooperative checkpoint — consumers must not read the
                 # issue list as the analysis's final word
                 degraded["partial"] = True
+            # resource governor (resilience/governor.py): a breached
+            # budget names itself and the degradation rungs it cost —
+            # absent entirely when no budget ever tripped
+            from mythril_tpu.resilience.governor import governor_meta
+
+            governor_block = governor_meta()
+            if governor_block is not None:
+                degraded["governor"] = governor_block
             # knowledge plane (persist/plane.py): warm/cold provenance
             # for this run — absent entirely when persistence is off,
             # keeping the pre-persist report byte-for-byte identical

@@ -36,7 +36,17 @@ class Disassembly:
         if isinstance(code, (bytes, bytearray)):
             code = "0x" + bytes(code).hex()
         self.bytecode = code
-        self.raw_bytecode = bytes.fromhex(code.removeprefix("0x"))
+        try:
+            self.raw_bytecode = bytes.fromhex(code.removeprefix("0x"))
+        except ValueError:
+            # wild input (odd nibble, whitespace, 0X prefix): the
+            # triage normalizer repairs what it can and raises the
+            # typed BytecodeInputError — a CriticalError the CLI maps
+            # to a one-line exit 2 — for genuinely non-hex input
+            from mythril_tpu.disassembler.triage import normalize_hex
+
+            self.raw_bytecode = normalize_hex(code)
+            self.bytecode = "0x" + self.raw_bytecode.hex()
         self.instruction_list: List[asm.EvmInstruction] = asm.disassemble(
             self.raw_bytecode
         )

@@ -1,4 +1,4 @@
-"""Minimal JSON-RPC client for on-chain data (reference:
+"""JSON-RPC client + provider pool for on-chain data (reference:
 mythril/ethereum/interface/rpc/client.py).
 
 Only the read methods the analyzer needs.  Uses urllib from the stdlib;
@@ -12,8 +12,28 @@ bad JSON, missing ``result``) fail immediately.  The transport consults
 the resilience fault plane (``rpc_error`` / ``rpc_http_500`` injection
 points), so the whole retry path is testable without a network, and
 retries land in the ``rpc_retries`` degradation counter.
+
+Wild-corpus hardening adds three layers on top of the single client:
+
+- **rate-limit classification** — HTTP 429 and JSON-RPC error
+  ``-32005`` ("limit exceeded", the Infura/Alchemy vocabulary) raise
+  :class:`RateLimitError` instead of generic failures, carrying any
+  ``Retry-After`` hint, so callers back off instead of hammering.
+- **response-shape validation** — ``eth_getCode`` / ``eth_getStorageAt``
+  results must be 0x-prefixed hex strings (code byte-aligned); a
+  provider answering garbage raises :class:`BadResponseError` and, in
+  a pool, costs that provider a breaker strike.
+- **ProviderPool** — N providers with per-provider circuit breakers
+  (``MYTHRIL_TPU_RPC_BREAKER_FAILS`` consecutive strikes open a
+  breaker for ``MYTHRIL_TPU_RPC_BREAKER_COOLDOWN_S``), rate-limit
+  aware backoff + rotation, and a digest-keyed on-disk code cache
+  riding the persist SegmentStore (``MYTHRIL_TPU_RPC_CACHE_DIR``).
+  When every breaker is open the pool raises the typed
+  :class:`~mythril_tpu.exceptions.ProviderExhaustedError`, which the
+  CLI maps to a one-line structured exit 2.
 """
 
+import hashlib
 import json
 import logging
 import random
@@ -28,6 +48,8 @@ JSON_MEDIA_TYPE = "application/json"
 RPC_MAX_ATTEMPTS = 3        # total tries per call (1 + 2 retries)
 RPC_BACKOFF_BASE_S = 0.05   # sleep = base * 2^attempt * (1 + jitter)
 RPC_TIMEOUT_S = 10.0
+#: JSON-RPC error code most providers use for "rate limit exceeded"
+RATE_LIMIT_RPC_CODE = -32005
 
 
 class ClientError(Exception):
@@ -50,15 +72,57 @@ class ConnectionError_(ClientError):
     pass
 
 
+class RateLimitError(ClientError):
+    """The provider is shedding load (HTTP 429 or JSON-RPC -32005).
+    Not a failure of the request — a demand to slow down; the pool
+    backs off and rotates instead of striking the breaker."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+def validate_hex_result(result, what: str = "result",
+                        byte_aligned: bool = False) -> str:
+    """Shape-check one RPC result that must be 0x-prefixed hex (the
+    eth_getCode / eth_getStorageAt contract).  A provider answering
+    anything else is broken or lying; surfacing it as
+    :class:`BadResponseError` keeps garbage out of the disassembler
+    and (in a pool) counts against that provider's breaker."""
+    if not isinstance(result, str) or not result.startswith("0x"):
+        raise BadResponseError(
+            f"{what}: expected 0x-prefixed hex, got {result!r:.80}"
+        )
+    body = result[2:]
+    try:
+        int(body, 16) if body else 0
+    except ValueError:
+        raise BadResponseError(
+            f"{what}: non-hex characters in {result!r:.80}"
+        ) from None
+    if byte_aligned and len(body) % 2:
+        raise BadResponseError(
+            f"{what}: odd-length hex ({len(body)} nibbles)"
+        )
+    return result
+
+
 class BaseClient:
     def eth_getCode(self, address: str, default_block: str = "latest") -> str:
-        return self._call("eth_getCode", [address, default_block])
+        # not byte_aligned: real nodes answer "0x0" for empty code, and
+        # the disassembler triage pass repairs odd nibbles anyway — the
+        # validator only has to keep non-hex garbage out
+        return validate_hex_result(
+            self._call("eth_getCode", [address, default_block]),
+            what="eth_getCode",
+        )
 
     def eth_getStorageAt(
         self, address: str, position: int, block: str = "latest"
     ) -> str:
-        return self._call(
-            "eth_getStorageAt", [address, hex(position), block]
+        return validate_hex_result(
+            self._call("eth_getStorageAt", [address, hex(position), block]),
+            what="eth_getStorageAt",
         )
 
     def eth_getBalance(self, address: str, block: str = "latest") -> int:
@@ -111,8 +175,15 @@ class EthJsonRpc(BaseClient):
             decoded = json.loads(body)
         except json.JSONDecodeError:
             raise BadJsonError(body[:200])
-        if "result" not in decoded:
-            raise BadResponseError(decoded.get("error"))
+        if not isinstance(decoded, dict) or "result" not in decoded:
+            error = (
+                decoded.get("error") if isinstance(decoded, dict) else decoded
+            )
+            if isinstance(error, dict) and error.get(
+                "code"
+            ) == RATE_LIMIT_RPC_CODE:
+                raise RateLimitError(str(error.get("message", error)))
+            raise BadResponseError(error)
         return decoded["result"]
 
     def _transport(self, request) -> bytes:
@@ -146,6 +217,21 @@ class EthJsonRpc(BaseClient):
                 # responses; without this branch an HTTP 500 would
                 # misclassify as a connection failure (HTTPError
                 # subclasses OSError)
+                if e.code == 429:
+                    # rate limiting is a demand, not a failure: carry
+                    # the Retry-After hint up to the backoff logic
+                    # (pool rotation or caller sleep), don't retry the
+                    # same provider in a tight loop
+                    retry_after = 0.0
+                    try:
+                        retry_after = float(
+                            (e.headers or {}).get("Retry-After", 0) or 0
+                        )
+                    except (TypeError, ValueError):
+                        pass
+                    raise RateLimitError(
+                        "HTTP 429", retry_after_s=retry_after
+                    )
                 if e.code < 500:
                     raise BadStatusCodeError(str(e.code))
                 last = BadStatusCodeError(str(e.code))
@@ -158,3 +244,214 @@ class EthJsonRpc(BaseClient):
                           e, attempt + 1, RPC_MAX_ATTEMPTS)
         assert last is not None
         raise last
+
+
+# ---------------------------------------------------------------------------
+# provider pool: breakers, rate-limit rotation, on-disk code cache
+# ---------------------------------------------------------------------------
+
+
+class _ProviderSlot:
+    """One pooled provider plus its circuit-breaker state."""
+
+    __slots__ = ("client", "fails", "open_until")
+
+    def __init__(self, client: BaseClient):
+        self.client = client
+        self.fails = 0          # consecutive strikes
+        self.open_until = 0.0   # monotonic time the breaker re-closes
+
+    def usable(self, now: float) -> bool:
+        return now >= self.open_until
+
+
+class ProviderPool(BaseClient):
+    """N JSON-RPC providers behind one BaseClient face.
+
+    Every call walks the pool round-robin: a provider failure (drop,
+    5xx after the client's own retries, garbage shape) is a breaker
+    strike and a rotation; ``MYTHRIL_TPU_RPC_BREAKER_FAILS``
+    consecutive strikes open that provider's breaker for
+    ``MYTHRIL_TPU_RPC_BREAKER_COOLDOWN_S`` seconds (half-open after:
+    one success fully closes it, one failure re-opens it).  A
+    rate-limit answer (HTTP 429 / JSON-RPC -32005) is not a strike —
+    the pool honors any Retry-After hint (capped by
+    ``MYTHRIL_TPU_RPC_BACKOFF_CAP_S``), rotates, and moves on.  When
+    every breaker is open, :class:`ProviderExhaustedError` surfaces
+    with the per-provider detail.
+
+    ``eth_getCode`` additionally rides a digest-keyed on-disk cache
+    (persist SegmentStore under ``MYTHRIL_TPU_RPC_CACHE_DIR``):
+    deployed code is immutable, so a corpus sweep hits the network
+    once per contract ever, survives SIGKILL, and replays offline.
+    """
+
+    def __init__(self, providers: List[BaseClient],
+                 breaker_fails: Optional[int] = None,
+                 breaker_cooldown_s: Optional[float] = None,
+                 cache_dir: Optional[str] = None):
+        import os
+
+        from mythril_tpu.support.env import env_flag, env_float, env_int
+
+        if not providers:
+            raise ValueError("ProviderPool needs at least one provider")
+        self.slots = [_ProviderSlot(p) for p in providers]
+        self.breaker_fails = breaker_fails if breaker_fails is not None \
+            else env_int("MYTHRIL_TPU_RPC_BREAKER_FAILS", 3, floor=1)
+        self.breaker_cooldown_s = breaker_cooldown_s \
+            if breaker_cooldown_s is not None else env_float(
+                "MYTHRIL_TPU_RPC_BREAKER_COOLDOWN_S", 30.0, floor=0.0)
+        self.backoff_cap_s = env_float(
+            "MYTHRIL_TPU_RPC_BACKOFF_CAP_S", 2.0, floor=0.0)
+        self.max_attempts = env_int(
+            "MYTHRIL_TPU_RPC_POOL_ATTEMPTS",
+            max(RPC_MAX_ATTEMPTS, 2 * len(self.slots)), floor=1)
+        self._index = 0
+        self._store = None
+        self._cache_dir = None
+        if env_flag("MYTHRIL_TPU_RPC_CACHE", True):
+            self._cache_dir = cache_dir or os.environ.get(
+                "MYTHRIL_TPU_RPC_CACHE_DIR"
+            ) or None
+
+    @classmethod
+    def from_spec(cls, spec: str, tls: bool = False,
+                  **kwargs) -> "ProviderPool":
+        """Build a pool from a comma-separated provider spec — each
+        entry a URL or HOST[:PORT] (the --rpc vocabulary, pluralized).
+        """
+        providers: List[BaseClient] = []
+        for entry in spec.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            if entry.startswith(("http://", "https://")):
+                providers.append(EthJsonRpc(entry, None, entry.startswith("https")))
+            else:
+                host, port = (entry.split(":") + ["8545"])[:2]
+                providers.append(EthJsonRpc(host, int(port), tls))
+        return cls(providers, **kwargs)
+
+    # -- breaker bookkeeping -------------------------------------------
+
+    def _pick(self) -> Optional[_ProviderSlot]:
+        """Next usable slot round-robin; None when every breaker is
+        open (the exhaustion case)."""
+        now = time.monotonic()
+        for offset in range(len(self.slots)):
+            slot = self.slots[(self._index + offset) % len(self.slots)]
+            if slot.usable(now):
+                self._index = (self._index + offset) % len(self.slots)
+                return slot
+        return None
+
+    def _rotate(self) -> None:
+        from mythril_tpu.resilience.telemetry import resilience_stats
+
+        resilience_stats.rpc_provider_rotations += 1
+        self._index = (self._index + 1) % len(self.slots)
+
+    def _strike(self, slot: _ProviderSlot) -> None:
+        from mythril_tpu.resilience.telemetry import resilience_stats
+
+        slot.fails += 1
+        if slot.fails >= self.breaker_fails:
+            already_open = slot.open_until > time.monotonic()
+            slot.open_until = time.monotonic() + self.breaker_cooldown_s
+            # half-open relapse keeps the breaker hot without
+            # recounting the open (fails stays saturated)
+            slot.fails = self.breaker_fails
+            if not already_open:
+                resilience_stats.rpc_breaker_opens += 1
+                log.warning(
+                    "rpc pool: breaker OPEN for %s (%d consecutive "
+                    "failures; cooling %.1fs)",
+                    getattr(slot.client, "url", slot.client),
+                    self.breaker_fails, self.breaker_cooldown_s,
+                )
+
+    def _exhausted(self, last: Optional[Exception]):
+        from mythril_tpu.exceptions import ProviderExhaustedError
+
+        detail = ", ".join(
+            f"{getattr(s.client, 'url', s.client)}: breaker open"
+            for s in self.slots
+        )
+        raise ProviderExhaustedError(
+            f"all {len(self.slots)} RPC providers unavailable "
+            f"({detail}); last error: {last}"
+        )
+
+    # -- the pooled call -----------------------------------------------
+
+    def _call(self, method: str, params: Optional[List[Any]] = None):
+        from mythril_tpu.resilience import faults
+        from mythril_tpu.resilience.telemetry import resilience_stats
+
+        last: Optional[Exception] = None
+        for attempt in range(self.max_attempts):
+            slot = self._pick()
+            if slot is None:
+                self._exhausted(last)
+            try:
+                faults.maybe_fault_rpc_flap()
+                result = slot.client._call(method, params)
+            except RateLimitError as e:
+                # shedding, not failure: no breaker strike — honor the
+                # hint (capped), rotate to a provider with headroom
+                resilience_stats.rpc_rate_limited += 1
+                sleep_s = min(
+                    self.backoff_cap_s,
+                    e.retry_after_s
+                    or RPC_BACKOFF_BASE_S * (2 ** attempt),
+                )
+                log.debug("rpc pool: rate limited (%s); backing off "
+                          "%.2fs and rotating", e, sleep_s)
+                time.sleep(sleep_s)
+                self._rotate()
+                last = e
+                continue
+            except (ClientError, OSError) as e:
+                self._strike(slot)
+                self._rotate()
+                last = e
+                continue
+            slot.fails = 0
+            return result
+        assert last is not None
+        raise last
+
+    # -- digest-keyed code cache ---------------------------------------
+
+    def _cache(self):
+        """The SegmentStore, opened lazily (never raises: an unusable
+        directory degrades to a read-only/empty store)."""
+        if self._store is None and self._cache_dir:
+            from mythril_tpu.persist.store import SegmentStore
+
+            self._store = SegmentStore(self._cache_dir).open()
+        return self._store
+
+    @staticmethod
+    def _code_key(address: str, block: str) -> str:
+        return hashlib.sha256(
+            f"{address.lower()}@{block}".encode()
+        ).hexdigest()
+
+    def eth_getCode(self, address: str, default_block: str = "latest") -> str:
+        from mythril_tpu.resilience import faults
+        from mythril_tpu.resilience.telemetry import resilience_stats
+
+        store = self._cache()
+        key = self._code_key(address, default_block)
+        if store is not None and not faults.maybe_fault_code_cache():
+            cached = store.get("rpc_code", key)
+            if cached is not None:
+                resilience_stats.rpc_code_cache_hits += 1
+                return cached.decode("ascii")
+        code = super().eth_getCode(address, default_block)
+        if store is not None:
+            store.put("rpc_code", key, code.encode("ascii"))
+            store.flush()
+        return code

@@ -17,6 +17,7 @@ from mythril_tpu.analysis.module.loader import ModuleLoader
 from mythril_tpu.exceptions import (
     CriticalError,
     DetectorNotFoundError,
+    LoaderError,
 )
 from mythril_tpu.mythril import (
     MythrilAnalyzer,
@@ -907,6 +908,12 @@ def execute_command(
             _fire_and_print(analyzer, args)
         except DetectorNotFoundError as e:
             exit_with_error(args.outform, format(e))
+        except LoaderError as e:
+            # typed wild-input failure: one machine-readable line on
+            # stderr, exit 2 (before CriticalError — its parent — whose
+            # handler exits 0)
+            print(e.to_line(), file=sys.stderr)
+            sys.exit(2)
         except CriticalError as e:
             exit_with_error(
                 args.outform, "Analysis error encountered: " + format(e)
@@ -1188,6 +1195,14 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
         execute_command(
             disassembler=disassembler, address=address, parser=parser, args=args
         )
+    except LoaderError as le:
+        # bad checksum / empty code / provider exhaustion: a one-line
+        # structured error a sweep driver can parse, and — unlike
+        # exit_with_error, which exits 0 — a nonzero exit so CI can
+        # tell "input rejected" from "analysis clean".  Must precede
+        # the CriticalError handler (LoaderError subclasses it).
+        print(le.to_line(), file=sys.stderr)
+        sys.exit(2)
     except CriticalError as ce:
         exit_with_error(getattr(args, "outform", "text"), str(ce))
     except Exception:

@@ -195,14 +195,27 @@ class LaserEVM:
         plane = get_checkpoint_plane()
         start_index = plane.restore_transactions(self, address)
         self._execute_hooks(self._start_exec_trans_hooks)
+        from mythril_tpu.resilience import governor
+
         for i in range(start_index, self.transaction_count):
             if len(self.open_states) == 0:
                 break
+            # governor seam: the transaction start boundary is both a
+            # budget poll site and where the cap_tx_depth rung lands —
+            # the previous transaction finished whole, no further one
+            # starts, and the verdict is partial over fewer txs
+            governor.poll(self)
+            if governor.tx_depth_capped() and i > start_index:
+                self.aborted_at_tx = i
+                obs.instant("svm.governor_tx_cap", cat="svm", tx=i)
+                plane.partial = True
+                break
             if drain_requested():
-                # a drain — SIGTERM or an expired per-request budget —
-                # lands at this transaction's START boundary: the
-                # frontier below is exactly what a resume (or the
-                # serve plane's partial report) continues from
+                # a drain — SIGTERM, an expired per-request budget, or
+                # the governor's terminal rung — lands at this
+                # transaction's START boundary: the frontier below is
+                # exactly what a resume (or the serve plane's partial
+                # report) continues from
                 self.aborted_at_tx = i
                 obs.instant("svm.drain_boundary", cat="svm", tx=i)
                 break
@@ -295,6 +308,12 @@ class LaserEVM:
             # rides the scheduler round boundary: the only point where
             # no dispatch is in flight and the channels are consistent
             plane.tick()
+            # governor seam: same boundary — a breached resource
+            # budget escalates one degradation rung here (shrink
+            # frontier -> disable planes -> cap txs -> drain partial)
+            from mythril_tpu.resilience import governor
+
+            governor.poll(self)
             batch = self.strategy.pop_batch(batch_width)
             if not batch:
                 break

@@ -137,14 +137,26 @@ _SEG_KECCAK_MAX_DEFAULT = 256     # device-hash width cap, bytes
 
 def lockstep_enabled() -> bool:
     """``MYTHRIL_TPU_SYM_LOCKSTEP=0`` pins the exact per-state
-    interpreter path."""
-    return env_flag("MYTHRIL_TPU_SYM_LOCKSTEP", True)
+    interpreter path.  The resource governor's ``disable_planes`` rung
+    (resilience/governor.py) turns the tier off mid-analysis the same
+    way: the serial interpreter allocates no per-lane arenas, which is
+    the point of the rung."""
+    from mythril_tpu.resilience.governor import planes_disabled
+
+    return env_flag("MYTHRIL_TPU_SYM_LOCKSTEP", True) and not (
+        planes_disabled()
+    )
 
 
 def mem_planes_enabled() -> bool:
     """``MYTHRIL_TPU_SEG_PLANES_MEM=0`` restores the pre-plane
-    NEEDS_HOST boundary at every memory/storage/keccak opcode."""
-    return env_flag("MYTHRIL_TPU_SEG_PLANES_MEM", True)
+    NEEDS_HOST boundary at every memory/storage/keccak opcode; the
+    governor's ``disable_planes`` rung does the same mid-analysis."""
+    from mythril_tpu.resilience.governor import planes_disabled
+
+    return env_flag("MYTHRIL_TPU_SEG_PLANES_MEM", True) and not (
+        planes_disabled()
+    )
 
 
 def _fold(op_code: str) -> str:

@@ -197,6 +197,15 @@ class MythrilAnalyzer:
         StartTime()  # per-contract wall-clock epoch for report timestamps
         failure = None
         execution_info = None
+        # resource governor: armed per contract (budgets from the
+        # MYTHRIL_TPU_GOVERNOR_* knobs; all-unlimited by default), so a
+        # state-explosion monster degrades to a partial verdict instead
+        # of taking the process — and the next contract starts clean
+        from mythril_tpu.resilience.governor import (
+            clear_governor, install_governor,
+        )
+
+        install_governor(label=getattr(contract, "name", "") or "contract")
         try:
             sym = self._symbolize(
                 contract,
@@ -221,6 +230,10 @@ class MythrilAnalyzer:
                 "Exception occurred, aborting analysis:\n" + failure
             )
             issues = retrieve_callback_issues(modules)
+        finally:
+            # restores globals (batch width); the governor's meta
+            # block survives the clear so the report still carries it
+            clear_governor()
         return issues, execution_info, failure
 
     @staticmethod

@@ -83,6 +83,21 @@ class MythrilConfig:
         )
 
     def set_api_rpc(self, rpc: Optional[str] = None, rpctls: bool = False) -> None:
+        # provider-pool routes: an explicit comma-separated --rpc spec
+        # or the MYTHRIL_TPU_RPC_PROVIDERS fleet knob wrap every
+        # endpoint behind circuit breakers + rate-limit-aware backoff
+        # (ethereum/interface/rpc/client.py ProviderPool)
+        pool_spec = None
+        if rpc is not None and "," in rpc:
+            pool_spec = rpc
+        elif rpc is None and os.environ.get("MYTHRIL_TPU_RPC_PROVIDERS"):
+            pool_spec = os.environ["MYTHRIL_TPU_RPC_PROVIDERS"]
+        if pool_spec is not None:
+            from mythril_tpu.ethereum.interface.rpc.client import ProviderPool
+
+            self.eth = ProviderPool.from_spec(pool_spec, tls=rpctls)
+            log.info("Using RPC provider pool: %s", pool_spec)
+            return
         if rpc is None or rpc == "ganache":
             rpc = "localhost:8545"
         if rpc.startswith("infura-"):

@@ -7,7 +7,14 @@ import re
 from typing import List, Optional, Tuple
 
 from mythril_tpu.disassembler.disassembly import Disassembly
-from mythril_tpu.exceptions import CriticalError, CompilerError
+from mythril_tpu.exceptions import (
+    BadAddressError,
+    BytecodeInputError,
+    CompilerError,
+    CriticalError,
+    EmptyCodeError,
+    LoaderError,
+)
 from mythril_tpu.ethereum.util import solc_exists
 from mythril_tpu.smt import symbol_factory
 from mythril_tpu.solidity.evmcontract import EVMContract
@@ -57,8 +64,13 @@ class MythrilDisassembler:
         code = code.removeprefix("0x").strip()
         try:
             bytes.fromhex(code)
-        except ValueError as e:
-            raise CriticalError(f"Input is not valid hex-encoded bytecode: {e}")
+        except ValueError:
+            # odd nibble / whitespace repairs go through triage; only
+            # genuinely non-hex input raises (BytecodeInputError — the
+            # CLI's structured exit 2)
+            from mythril_tpu.disassembler.triage import normalize_hex
+
+            code = normalize_hex(code).hex()
         if bin_runtime:
             self.contracts.append(
                 EVMContract(
@@ -77,31 +89,86 @@ class MythrilDisassembler:
             )
         return address, self.contracts[-1]
 
-    def load_from_address(self, address: str) -> Tuple[str, EVMContract]:
-        if not re.match(r"0x[a-fA-F0-9]{40}", address):
-            raise CriticalError(
-                "Invalid contract address. Expected format is '0x...'."
+    @staticmethod
+    def check_address(address: str) -> str:
+        """Validate an on-chain address: shape first, then — when the
+        hex is mixed-case — the EIP-55 checksum (a failed checksum is
+        a mistyped address, and analyzing whatever lives at the typo
+        would be silently wrong).  Raises :class:`BadAddressError`."""
+        if not isinstance(address, str) or not re.fullmatch(
+            r"0x[a-fA-F0-9]{40}", address
+        ):
+            raise BadAddressError(
+                f"invalid contract address {str(address)[:64]!r} "
+                "(expected 0x + 40 hex characters)"
             )
+        body = address[2:]
+        if body != body.lower() and body != body.upper():
+            digest = keccak256(body.lower().encode()).hex()
+            checksummed = "".join(
+                c.upper() if int(digest[i], 16) >= 8 else c.lower()
+                for i, c in enumerate(body.lower())
+            )
+            if body != checksummed:
+                raise BadAddressError(
+                    f"address {address} fails its EIP-55 checksum "
+                    f"(did you mean 0x{checksummed}?)"
+                )
+        return address
+
+    def load_from_address(self, address: str) -> Tuple[str, EVMContract]:
+        """Pull, triage and load the runtime code at ``address``.
+
+        The wild-bytecode funnel: anything ``eth_getCode`` returns is
+        accepted — metadata tails stripped, invalid opcodes counted
+        (the interpreter treats them as terminating boundaries),
+        oversized blobs capped, and an EIP-1167 minimal proxy resolved
+        through DynLoader to its implementation.  Loader-level
+        failures raise typed :class:`LoaderError` subclasses the CLI
+        maps to a one-line structured exit 2."""
+        from mythril_tpu.disassembler import triage as triage_mod
+        from mythril_tpu.support.loader import DynLoader
+
+        self.check_address(address)
         if self.eth is None:
             raise CriticalError(
                 "Please check RPC connection: no client available."
             )
         try:
             code = self.eth.eth_getCode(address)
+        except LoaderError:
+            raise  # ProviderExhaustedError carries its own code
         except Exception as e:
             raise CriticalError(f"IPC / RPC error: {e}")
-        if code == "0x" or code == "0x0":
-            raise CriticalError(
-                "Received an empty response from eth_getCode. "
-                "Check the contract address and verify your RPC is synced."
+        if code in ("0x", "0x0", "", None):
+            raise EmptyCodeError(
+                f"eth_getCode({address}) returned no code; check the "
+                "address and verify your RPC is synced"
             )
-        self.contracts.append(
-            EVMContract(
-                code=code,
-                name=address,
-                enable_online_lookup=self.enable_online_lookup,
+        clean, report = triage_mod.triage(code)
+        name = address
+        if report.proxy_target is not None:
+            # trampolines say nothing about behavior: resolve the
+            # delegate chain and analyze the implementation (the
+            # report keeps the proxy address as the contract name)
+            resolved = DynLoader(self.eth).fetch_code(
+                report.proxy_target
             )
+            if resolved:
+                clean = resolved
+                name = f"{address} -> {report.proxy_target}"
+        if not clean:
+            raise EmptyCodeError(
+                f"code at {address} is empty after triage "
+                f"({report.as_dict()})"
+            )
+        contract = EVMContract(
+            code="0x" + clean.hex(),
+            name=name,
+            enable_online_lookup=self.enable_online_lookup,
         )
+        contract.triage = report.as_dict()
+        self.contracts.append(contract)
         return address, self.contracts[-1]
 
     def load_from_solidity(self, solidity_files: List[str]):

@@ -382,17 +382,23 @@ _handlers_installed = False
 
 def drain_requested() -> bool:
     """True when the run should wind down at the next cooperative
-    boundary: either the process-wide drain flag is set (SIGTERM /
-    SIGINT) or the current request's wall-clock budget has expired
+    boundary: the process-wide drain flag is set (SIGTERM / SIGINT),
+    the current request's wall-clock budget has expired
     (``resilience/budget.py`` — the serve plane's per-request deadline,
-    which clears between requests).  Both causes walk the exact same
-    boundaries: the svm loops, the dispatch gate, and the device round
-    ladders."""
+    which clears between requests), or the resource governor escalated
+    to its terminal ``drain_partial`` rung (``resilience/governor.py``
+    — a breached state/term/lane/RSS budget, which clears per
+    contract).  All causes walk the exact same boundaries: the svm
+    loops, the dispatch gate, and the device round ladders."""
     if _drain_event.is_set():
         return True
     from mythril_tpu.resilience.budget import budget_expired
 
-    return budget_expired()
+    if budget_expired():
+        return True
+    from mythril_tpu.resilience.governor import drain_rung_active
+
+    return drain_rung_active()
 
 
 def request_drain(reason: str = "signal") -> None:

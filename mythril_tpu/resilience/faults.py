@@ -54,6 +54,13 @@ Injection points:
                      gossip message (models a lossy channel) — findings
                      must be unaffected: gossip is an accelerant, never
                      load-bearing
+``governor_breach``  one governor poll observes a resource-budget
+                     breach (whatever the real counters say) — the
+                     degradation rung ladder's chaos hook
+``rpc_flap``         the provider pool's current provider drops the
+                     connection mid-call — rotation + breaker coverage
+``rpc_code_cache``   one on-disk code-cache read answers as a miss —
+                     the loader must fall through to the network
 ``lease_partition``  the coordinator ignores one worker heartbeat
                      (models a network partition): enough shots expire
                      the lease, the subtree is re-leased under a bumped
@@ -121,6 +128,18 @@ FAULT_POINTS = (
     # the segment write — an armed shot aborts the flush (records stay
     # staged), MYTHRIL_TPU_KILL_AT lands a SIGKILL mid-flush
     "persist_flush",
+    # resource governor (resilience/governor.py): an armed shot makes
+    # one poll() observe a breach regardless of the real budgets — the
+    # degradation rung ladder is testable without exhausting anything
+    "governor_breach",
+    # provider pool (ethereum/interface/rpc/client.py): a transient
+    # per-provider connection drop mid-call — the pool must rotate to
+    # the next provider and the breaker must count the failure
+    "rpc_flap",
+    # on-disk code cache (pool.eth_getCode): an armed shot makes one
+    # cache read answer as a miss (models a quarantined segment) — the
+    # loader must fall through to the network, never crash
+    "rpc_code_cache",
 )
 
 DEFAULT_HANG_S = 30.0
@@ -394,3 +413,24 @@ def maybe_fault_rpc() -> None:
         raise urllib.error.HTTPError(
             "http://injected", 500, "injected server error", None, None
         )
+
+
+def maybe_fault_rpc_flap() -> None:
+    """Provider-pool seam (pool._call, per provider attempt): a
+    transient connection drop against the CURRENT provider — the pool
+    must rotate and the per-provider breaker must count it."""
+    if get_fault_plane().fire("rpc_flap") is not None:
+        raise OSError("injected provider flap")
+
+
+def maybe_fault_governor() -> bool:
+    """Governor seam (governor.poll): True when ``governor_breach``
+    fires — that poll observes a breach and applies the next rung."""
+    return get_fault_plane().fire("governor_breach") is not None
+
+
+def maybe_fault_code_cache() -> bool:
+    """Code-cache seam (pool.eth_getCode cache read): True when
+    ``rpc_code_cache`` fires — the read answers as a miss and the
+    loader falls through to the network."""
+    return get_fault_plane().fire("rpc_code_cache") is not None

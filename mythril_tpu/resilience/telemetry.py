@@ -58,6 +58,21 @@ _FIELDS = {
     "persist_corrupt_segments": "knowledge-store segments quarantined",
     "persist_flushes": "knowledge-store segments flushed",
     "persist_report_hits": "admission-edge report cache hits",
+    # resource governor (resilience/governor.py): breach observations
+    # plus one counter per degradation rung, so the ladder's exact
+    # shape is registry-visible (and rides meta.resilience when hit)
+    "governor_breaches": "resource-budget breaches observed",
+    "governor_shrink_frontier": "frontier-width halvings applied",
+    "governor_disable_planes": "lockstep-plane shutoffs applied",
+    "governor_cap_tx_depth": "transaction-depth caps applied",
+    "governor_drain_partial": "governor-forced partial drains",
+    # RPC provider pool (ethereum/interface/rpc/client.py): breaker
+    # trips, 429/-32005 backoffs, failovers, and code-cache hits —
+    # the wild loader's degradation story in counters
+    "rpc_breaker_opens": "provider circuit breakers opened",
+    "rpc_rate_limited": "rate-limit (429/-32005) backoffs taken",
+    "rpc_provider_rotations": "failovers to another provider",
+    "rpc_code_cache_hits": "on-disk code cache hits",
 }
 
 

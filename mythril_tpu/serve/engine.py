@@ -310,6 +310,16 @@ class AnalysisEngine:
                 request_budget.install_budget(
                     budget_s, label=f"{request.source}/{rid}"
                 )
+                # resource governor rides the same per-request scope as
+                # the wall-clock budget: a state-explosion request
+                # degrades to a partial verdict instead of taking the
+                # serving process (the serve path bypasses
+                # MythrilAnalyzer, so it arms its own)
+                from mythril_tpu.resilience.governor import (
+                    clear_governor, install_governor,
+                )
+
+                install_governor(label=f"{request.source}/{rid}")
                 try:
                     if self.router is not None:
                         # fabric first: a connected seat answers the
@@ -328,6 +338,7 @@ class AnalysisEngine:
                             return status, body
                     return 200, self._fire(request, rid, budget_s)
                 finally:
+                    clear_governor()
                     request_budget.clear_budget()
         except Exception as exc:  # noqa: BLE001 — isolate the request
             return 500, self._fail_request(rid, request, exc)

@@ -40,11 +40,13 @@ class EnvSpecError(RuntimeError):
 
 
 #: name -> (kind, floor, ceil); kind in {"int", "float", "listen",
-#: "file", "flag", "dir"}.  "listen" validates a HOST:PORT spec,
-#: "file" an existing non-empty file, "flag" a kill-switch boolean
-#: (the :func:`env_flag` vocabulary), and "dir" a usable directory
-#: path (created on demand by its owner, so it only has to NOT be an
-#: existing non-directory — floor/ceil unused for all four).
+#: "file", "flag", "dir", "providers"}.  "listen" validates a
+#: HOST:PORT spec, "file" an existing non-empty file, "flag" a
+#: kill-switch boolean (the :func:`env_flag` vocabulary), "dir" a
+#: usable directory path (created on demand by its owner, so it only
+#: has to NOT be an existing non-directory), and "providers" a
+#: comma-separated list of RPC endpoints (URL or HOST[:PORT] each) —
+#: floor/ceil unused for all five.
 #: Static entries cover knobs whose owning module may not have
 #: imported by validation time; env_int/env_float self-register the
 #: rest.
@@ -100,6 +102,27 @@ KNOWN_SPECS: Dict[str, Tuple[str, Optional[float], Optional[float]]] = {
     "MYTHRIL_TPU_PERSIST_FLUSH_S": ("float", 0.0, None),
     "MYTHRIL_TPU_PERSIST_CAP_MB": ("float", 1.0, None),
     "MYTHRIL_TPU_PERSIST_GOSSIP": ("flag", None, None),
+    # wild-bytecode triage (disassembler/triage.py): code-size cap and
+    # the proxy-chain resolution depth through DynLoader
+    "MYTHRIL_TPU_TRIAGE_MAX_CODE": ("int", 1, None),
+    "MYTHRIL_TPU_PROXY_DEPTH": ("int", 0, None),
+    # resource governor (resilience/governor.py): kill switch + the
+    # per-analysis budgets (0 = that budget unlimited)
+    "MYTHRIL_TPU_GOVERNOR": ("flag", None, None),
+    "MYTHRIL_TPU_GOVERNOR_STATES": ("int", 0, None),
+    "MYTHRIL_TPU_GOVERNOR_TERMS": ("int", 0, None),
+    "MYTHRIL_TPU_GOVERNOR_LANES": ("int", 0, None),
+    "MYTHRIL_TPU_GOVERNOR_RSS_MB": ("int", 0, None),
+    # RPC provider pool (ethereum/interface/rpc/client.py): provider
+    # list, per-provider circuit breaker, rate-limit backoff cap, and
+    # the digest-keyed on-disk code cache
+    "MYTHRIL_TPU_RPC_PROVIDERS": ("providers", None, None),
+    "MYTHRIL_TPU_RPC_BREAKER_FAILS": ("int", 1, None),
+    "MYTHRIL_TPU_RPC_BREAKER_COOLDOWN_S": ("float", 0.0, None),
+    "MYTHRIL_TPU_RPC_BACKOFF_CAP_S": ("float", 0.0, None),
+    "MYTHRIL_TPU_RPC_POOL_ATTEMPTS": ("int", 1, None),
+    "MYTHRIL_TPU_RPC_CACHE": ("flag", None, None),
+    "MYTHRIL_TPU_RPC_CACHE_DIR": ("dir", None, None),
 }
 
 #: raw values :func:`env_flag` understands; anything else set on a
@@ -206,6 +229,22 @@ def validate_env(environ=None) -> None:
                 raise EnvSpecError(
                     f"{name}={raw!r}: exists and is not a directory"
                 )
+            continue
+        if kind == "providers":
+            entries = [e.strip() for e in raw.split(",") if e.strip()]
+            if not entries:
+                raise EnvSpecError(
+                    f"{name}={raw!r}: no provider endpoints"
+                )
+            for entry in entries:
+                if entry.startswith(("http://", "https://")):
+                    continue
+                host, _, port = entry.partition(":")
+                if not host or (port and not port.isdigit()):
+                    raise EnvSpecError(
+                        f"{name}: bad provider entry {entry!r} "
+                        "(expected URL or HOST[:PORT])"
+                    )
             continue
         try:
             value = int(raw) if kind == "int" else float(raw)

@@ -68,7 +68,12 @@ GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
          # concrete-offset MLOAD/MSTORE/SLOAD/SSTORE/SHA3 inside the
          # batched segment, so this creeping back UP means segments
          # are dying early into serial stepping again
-         "host_boundaries_per_1k_states")
+         "host_boundaries_per_1k_states",
+         # wild-bytecode envelope: p95 wall of the fixture sweep
+         # through the hardened loader (scripts/corpus_sweep.py) —
+         # triage, governor polling, or salvage cost creeping into the
+         # per-contract path shows up in the tail first
+         "corpus_p95_s")
 #: gated metrics where LARGER is better (delta sign inverted):
 #: sustained warm-server throughput must not fall, the microbench
 #: device-vs-host ratio (both sides measured in the same run since the
@@ -91,9 +96,13 @@ GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
 #: against the same --persist-dir must keep answering from the durable
 #: report cache — store-load cost or cache misses creeping into the
 #: restart path show up here first
+#: wild_survival_pct gates the never-crash envelope: the fraction of
+#: mutation-fuzzed bytecodes the loader+analyzer survive with a
+#: full/partial/error verdict — anything under the baseline means an
+#: exception is escaping a boundary that promised it never would
 GATED_HIGHER_BETTER = ("serve_cpm", "microbench_device_vs_host",
                        "fleet_speedup", "states_per_s", "fabric_cpm",
-                       "warm_restart_speedup")
+                       "warm_restart_speedup", "wild_survival_pct")
 #: floor below which a baseline is noise and ratios are meaningless
 MIN_BASE = 0.05
 

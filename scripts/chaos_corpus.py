@@ -57,6 +57,15 @@ continues, a coordinator SIGKILL+restart must be healed by the
 workers' ``--reconnect`` redial, and ``MYTHRIL_TPU_FLEET=0`` must
 yield the exact single-process serve path.
 
+``--wild`` soaks the wild-bytecode envelope (disassembler triage +
+resource governor + RPC provider pool): a flapping provider mid-load
+must rotate through the pool to the exact triage verdict of a calm
+load, a SIGKILL mid-corpus-sweep must be healed by ``--resume`` from
+the fsynced journal (same contract count, zero crash verdicts), and a
+governor breach on the state-heavy fixture must yield a ``partial``
+verdict whose findings are a SUBSET of the unbudgeted run — degraded
+analysis may miss findings, never invent them.
+
 Exit status is nonzero when any round broke findings parity, so the
 script doubles as a soak gate before hardware rounds.
 """
@@ -1161,6 +1170,189 @@ def fleet_soak_main() -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --wild: soak the wild-bytecode envelope (triage + governor + pool)
+# ---------------------------------------------------------------------------
+
+WILD_SWEEP_LIMIT = 12  # fixtures per sweep round (whole corpus once)
+
+
+def _wild_scripts_dir():
+    return os.path.dirname(os.path.abspath(__file__))
+
+
+def _wild_sweep_cmd(journal, out, resume=False, extra=()):
+    cmd = [
+        sys.executable,
+        os.path.join(_wild_scripts_dir(), "corpus_sweep.py"),
+        "--limit", str(WILD_SWEEP_LIMIT), "--deadline-s", "3",
+        "--max-depth", "16", "--journal", journal, "--out", out,
+    ]
+    if resume:
+        cmd.append("--resume")
+    return cmd + list(extra)
+
+
+def wild_soak_main() -> int:
+    """The --wild driver: the never-crash envelope under abuse — a
+    flapping provider mid-load, SIGKILL mid-sweep with a journal
+    resume, and a governor breach whose partial verdict must report a
+    findings SUBSET of the unbudgeted run."""
+    import logging
+
+    logging.basicConfig(level=logging.ERROR)
+    sys.path.insert(0, _wild_scripts_dir())
+    import corpus_sweep
+
+    from mythril_tpu.ethereum.interface.rpc.client import (
+        EthJsonRpc,
+        ProviderPool,
+    )
+    from mythril_tpu.mythril.mythril_disassembler import MythrilDisassembler
+    from mythril_tpu.resilience import faults
+    from mythril_tpu.resilience.telemetry import resilience_stats
+
+    failures = []
+
+    def check(scenario, ok, **detail):
+        row = {"scenario": scenario, "ok": bool(ok), **detail}
+        print(json.dumps(row))
+        if not ok:
+            failures.append(row)
+
+    fixtures = dict(corpus_sweep.load_fixtures(corpus_sweep.FIXTURE_DIR))
+
+    # -- scenario 1: provider flap mid-load ---------------------------
+    # two fake providers serve the proxy fixture + its implementation;
+    # the rpc_flap fault kills attempts mid-chain and the pool must
+    # rotate through it to the same triage verdict as a calm load
+    class _FixtureClient(EthJsonRpc):
+        def _call(self, method, params=None):
+            addr = (params or ["0x"])[0].lower()
+            if addr == "0x" + "c0de" * 10:
+                return "0x" + fixtures["proxy_impl"].removeprefix("0x")
+            return "0x" + fixtures["proxy_1167"].removeprefix("0x")
+
+    def _load(flaps):
+        faults.reset_for_tests()
+        resilience_stats.reset()
+        if flaps:
+            faults.get_fault_plane().arm("rpc_flap", times=flaps)
+        pool = ProviderPool(
+            [_FixtureClient(host=f"fake{i}") for i in range(2)],
+            breaker_fails=5,
+        )
+        _, contract = MythrilDisassembler(eth=pool).load_from_address(
+            "0x" + "11" * 20
+        )
+        rotations = resilience_stats.rpc_provider_rotations
+        faults.reset_for_tests()
+        return contract, rotations
+
+    try:
+        calm, _ = _load(flaps=0)
+        flapped, rotations = _load(flaps=2)
+        check(
+            "provider_flap_mid_load_parity",
+            flapped.triage == calm.triage
+            and flapped.code == calm.code
+            and rotations >= 2
+            and calm.triage.get("proxy_target") == "0x" + "c0de" * 10,
+            rotations=rotations, triage=flapped.triage,
+        )
+    except Exception as exc:  # noqa: BLE001 — a crashed scenario fails
+        check("provider_flap_mid_load_parity", False,
+              error=f"{type(exc).__name__}: {exc}")
+
+    # -- scenario 2: SIGKILL mid-sweep, then --resume from the journal
+    workdir = tempfile.mkdtemp(prefix="mtpu-wild-")
+    journal = os.path.join(workdir, "sweep.jsonl")
+    out = os.path.join(workdir, "report.json")
+    env = dict(os.environ)
+    env.pop("MYTHRIL_TPU_FAULT", None)
+    env.pop("MYTHRIL_TPU_KILL_AT", None)
+    victim = subprocess.Popen(
+        _wild_sweep_cmd(journal, out), env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    deadline = time.time() + 300
+    journaled = 0
+    while time.time() < deadline and victim.poll() is None:
+        try:
+            with open(journal) as fh:
+                journaled = sum(1 for line in fh if line.strip())
+        except OSError:
+            journaled = 0
+        if journaled >= 3:
+            break
+        time.sleep(0.1)
+    killed = victim.poll() is None and journaled >= 3
+    if killed:
+        victim.kill()
+    victim.wait(timeout=30)
+    check("sigkill_mid_sweep_landed", killed, journaled=journaled)
+
+    resumed = subprocess.run(
+        _wild_sweep_cmd(journal, out, resume=True), env=env,
+        capture_output=True, text=True, timeout=600,
+    )
+    try:
+        with open(out) as fh:
+            report = json.load(fh)
+    except (OSError, ValueError):
+        report = {}
+    with open(journal) as fh:
+        replayed = {json.loads(line)["id"] for line in fh if line.strip()}
+    check(
+        "journal_resume_completes_sweep",
+        resumed.returncode == 0
+        and report.get("contracts") == WILD_SWEEP_LIMIT
+        and not report.get("crashes")
+        and len(replayed) == WILD_SWEEP_LIMIT,
+        exit=resumed.returncode, contracts=report.get("contracts"),
+        unique_journaled=len(replayed),
+        survival_pct=report.get("survival_pct"),
+    )
+
+    # -- scenario 3: governor breach => partial whose findings are a
+    # SUBSET of the unbudgeted run on the same contract ---------------
+    # the overflow fixture fans out enough states under two
+    # transactions to ride the ladder all the way to drain_partial
+    name = "unchecked_add"
+    code = fixtures[name]
+    try:
+        free = corpus_sweep.analyze_one(
+            name, code, deadline_s=60, max_depth=24, tx_count=2
+        )
+        os.environ["MYTHRIL_TPU_GOVERNOR_STATES"] = "1"
+        try:
+            squeezed = corpus_sweep.analyze_one(
+                name, code, deadline_s=60, max_depth=24, tx_count=2
+            )
+        finally:
+            os.environ.pop("MYTHRIL_TPU_GOVERNOR_STATES", None)
+        check(
+            "governor_breach_partial_findings_subset",
+            free["verdict"] in ("full", "partial")
+            and squeezed["verdict"] == "partial"
+            and squeezed.get("reason") == "governor"
+            and set(squeezed["findings"]) <= set(free["findings"])
+            and (squeezed.get("governor") or {}).get("rungs"),
+            free=free["verdict"], free_findings=free["findings"],
+            squeezed_findings=squeezed["findings"],
+            rungs=(squeezed.get("governor") or {}).get("rungs"),
+        )
+    except Exception as exc:  # noqa: BLE001
+        check("governor_breach_partial_findings_subset", False,
+              error=f"{type(exc).__name__}: {exc}")
+
+    if failures:
+        print(json.dumps({"wild_soak_failures": failures}))
+        return 1
+    print(json.dumps({"wild_soak_ok": True, "scenarios": 3}))
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--rounds", type=int, default=6)
@@ -1194,6 +1386,12 @@ def main() -> int:
                         "cold start, the MYTHRIL_TPU_PERSIST=0 kill "
                         "switch, and two-seat heartbeat gossip — "
                         "findings parity asserted everywhere")
+    parser.add_argument("--wild", action="store_true",
+                        help="soak the wild-bytecode envelope: provider "
+                        "flap mid-load, SIGKILL mid-sweep + journal "
+                        "resume, governor breach => partial verdict "
+                        "whose findings are a subset of the unbudgeted "
+                        "run")
     parser.add_argument("--kr-child", action="store_true",
                         help=argparse.SUPPRESS)
     parser.add_argument("--kr-dir", default=None, help=argparse.SUPPRESS)
@@ -1212,6 +1410,8 @@ def main() -> int:
         return multihost_soak_main()
     if args_ns.persist:
         return persist_soak_main()
+    if args_ns.wild:
+        return wild_soak_main()
     rng = random.Random(args_ns.seed)
 
     import logging

@@ -1,0 +1,557 @@
+#!/usr/bin/env python
+"""Mainnet corpus sweep: drive wild bytecode through the hardened
+loader + governor envelope at scale and prove the never-crash claim
+with numbers.
+
+Offline by default: the vendored fixtures under
+``tests/fixtures/mainnet/`` (see its README) are the base corpus, and
+``--expand N`` grows it to N contracts with deterministic mutations
+(seeded byte flips, truncations, tail grafts, junk injection) — the
+CI-facing stand-in for "top-N contracts by tx volume", which needs no
+network.  A live sweep points ``--rpc`` at one or more providers (the
+spec accepted by ``ProviderPool.from_spec``: comma-separated
+``URL|HOST[:PORT]``) and ``--addresses FILE`` at a newline list of
+contract addresses; everything downstream is identical.
+
+Per contract: the code crosses the triage pass
+(``disassembler/triage.py``), analysis runs under a wall-clock deadline
+(``resilience/budget.py``) AND the resource governor
+(``resilience/governor.py`` — arm budgets via MYTHRIL_TPU_GOVERNOR_*),
+and the outcome is classified::
+
+    full     analysis ran to completion
+    partial  drained at a budget/governor rung or salvaged an internal
+             failure — findings are a valid prefix, never the final word
+    error    the loader rejected the input with a typed LoaderError
+             (bad checksum, empty code, non-hex bytes …)
+    crash    an exception ESCAPED the envelope — the bug this sweep
+             exists to catch; any crash fails the run (exit 1)
+
+Every outcome appends one fsynced JSONL line to the journal
+(``--journal``), so a SIGKILLed sweep resumes with ``--resume`` and
+re-analyzes nothing.  The final report (stdout, one JSON line; pretty
+copy via ``--out``) carries the survival percentage, findings rate,
+and p50/p95 wall seconds by contract-size bucket
+(small <= 1 KiB < medium <= 24 576 (EIP-170) < large).
+
+``--wild N`` switches to the differential-fuzz harness: N freshly
+mutated/random bytecodes under tiny budgets, where the invariant under
+test is purely "exit 0 or a structured partial — never a traceback".
+
+Fabric tenancy: ``--serve URL`` submits contracts to a running
+``myth serve`` daemon (PR-13 fabric: the server fans requests out to
+its remote seats) instead of analyzing in-process; ``--workers N`` and
+``--checkpoint-dir`` pass through to the in-process analyzer for
+checkpointed fleet mode on one box.
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import random
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FIXTURE_DIR = os.path.join(REPO, "tests", "fixtures", "mainnet")
+
+SMALL_MAX = 1024
+MEDIUM_MAX = 24576  # EIP-170
+
+
+# ----------------------------------------------------------------------
+# corpus assembly
+# ----------------------------------------------------------------------
+
+def load_fixtures(directory: str):
+    """[(name, hex_string)] — every .hex file, raw (the loader must
+    cope with whitespace / odd nibbles / 0x prefixes itself)."""
+    out = []
+    for fn in sorted(os.listdir(directory)):
+        if not fn.endswith(".hex"):
+            continue
+        with open(os.path.join(directory, fn)) as fh:
+            out.append((fn[:-4], fh.read().strip()))
+    return out
+
+
+# Mutations modeled on what wild corpora actually contain.  Each takes
+# (rng, hex_str) -> hex_str and must be deterministic under the rng.
+def _mut_flip(rng, code):
+    clean = code.removeprefix("0x").replace("\n", "")
+    if len(clean) < 2:
+        return clean + "fe"
+    i = rng.randrange(0, len(clean) - 1)
+    return clean[:i] + format(rng.randrange(256), "02x") + clean[i + 2:]
+
+
+def _mut_truncate(rng, code):
+    clean = code.removeprefix("0x").replace("\n", "")
+    if len(clean) < 4:
+        return clean
+    return clean[: rng.randrange(2, len(clean))]  # odd cuts welcome
+
+
+def _mut_append_junk(rng, code):
+    clean = code.removeprefix("0x").replace("\n", "")
+    junk = "".join(format(rng.randrange(256), "02x")
+                   for _ in range(rng.randrange(1, 40)))
+    return clean + junk
+
+
+def _mut_graft_tail(rng, code):
+    clean = code.removeprefix("0x").replace("\n", "")
+    tail = "a165627a7a72305820" + "".join(
+        format(rng.randrange(256), "02x") for _ in range(32)
+    ) + "0029"
+    return clean + tail
+
+
+def _mut_dup_slice(rng, code):
+    clean = code.removeprefix("0x").replace("\n", "")
+    if len(clean) < 8:
+        return clean * 2
+    a = rng.randrange(0, len(clean) // 2) & ~1
+    b = rng.randrange(a + 2, len(clean)) & ~1
+    return clean + clean[a:b]
+
+
+def _mut_invalid_island(rng, code):
+    clean = code.removeprefix("0x").replace("\n", "")
+    i = (rng.randrange(0, max(2, len(clean))) & ~1)
+    return clean[:i] + "fe" + clean[i:]
+
+
+MUTATIONS = (_mut_flip, _mut_truncate, _mut_append_junk,
+             _mut_graft_tail, _mut_dup_slice, _mut_invalid_island)
+
+
+def expand_corpus(base, target: int, seed: int):
+    """Grow [(name, code)] to ``target`` entries with deterministic
+    mutations of the base fixtures."""
+    rng = random.Random(seed)
+    out = list(base)
+    i = 0
+    while len(out) < target:
+        name, code = base[i % len(base)]
+        mut = rng.choice(MUTATIONS)
+        out.append((f"{name}.m{i}", mut(rng, code)))
+        i += 1
+    return out[:target]
+
+
+def random_bytecode(rng) -> str:
+    """Unstructured fuzz input: raw bytes, weighted toward real opcode
+    ranges but free to land anywhere (undefined ops, truncated PUSHes
+    and all)."""
+    n = rng.randrange(1, 400)
+    return "".join(format(rng.randrange(256), "02x") for _ in range(n))
+
+
+def contract_id(name: str, code: str) -> str:
+    return hashlib.sha256(f"{name}:{code}".encode()).hexdigest()[:16]
+
+
+def size_bucket(size: int) -> str:
+    if size <= SMALL_MAX:
+        return "small"
+    if size <= MEDIUM_MAX:
+        return "medium"
+    return "large"
+
+
+# ----------------------------------------------------------------------
+# the never-crash analysis envelope
+# ----------------------------------------------------------------------
+
+def _reset_analysis_state():
+    """Per-contract isolation: the same reset sequence every in-process
+    driver uses (bench.py / serve engine), plus the resilience planes
+    the verdict classification below reads."""
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+    from mythril_tpu.ops.async_dispatch import async_stats
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+    from mythril_tpu.resilience import budget, checkpoint, faults, governor
+    from mythril_tpu.resilience.telemetry import resilience_stats
+    from mythril_tpu.smt.solver import reset_blast_context
+    from mythril_tpu.support.model import clear_model_cache
+
+    reset_blast_context()
+    clear_model_cache()
+    for module in ModuleLoader().get_detection_modules():
+        module.reset_module()
+        module.cache.clear()
+    dispatch_stats.reset()
+    async_stats.reset()
+    resilience_stats.reset()
+    budget.reset_for_tests()
+    checkpoint.reset_for_tests()
+    governor.reset_for_tests()
+    faults.reset_for_tests()
+
+
+def analyze_one(name: str, code: str, deadline_s: float,
+                max_depth: int, tx_count: int,
+                workers=None, checkpoint_dir=None) -> dict:
+    """One contract through the full envelope; ALWAYS returns a verdict
+    dict, crash included (a crash verdict means an exception crossed a
+    boundary that promised it never would)."""
+    from mythril_tpu.exceptions import LoaderError
+    from mythril_tpu.mythril.mythril_analyzer import MythrilAnalyzer
+    from mythril_tpu.mythril.mythril_disassembler import MythrilDisassembler
+
+    began = time.monotonic()
+    row = {"id": contract_id(name, code), "name": name}
+    try:
+        _reset_analysis_state()
+        disassembler = MythrilDisassembler(eth=None)
+        address, contract = disassembler.load_from_bytecode(
+            code, bin_runtime=True
+        )
+        row["size"] = len(contract.disassembly.raw_bytecode)
+        row["bucket"] = size_bucket(row["size"])
+
+        from mythril_tpu.resilience import budget as request_budget
+        from mythril_tpu.resilience.checkpoint import get_checkpoint_plane
+        from mythril_tpu.resilience.governor import governor_meta
+
+        request_budget.install_budget(deadline_s, label=f"sweep/{name}")
+        try:
+            analyzer = MythrilAnalyzer(
+                disassembler,
+                strategy="bfs",
+                address=address,
+                max_depth=max_depth,
+                execution_timeout=max(1, int(deadline_s)),
+                create_timeout=max(1, int(deadline_s)),
+                fleet_workers=workers,
+                checkpoint_dir=checkpoint_dir,
+            )
+            report = analyzer.fire_lasers(transaction_count=tx_count)
+        finally:
+            expired = request_budget.budget_expired()
+            request_budget.clear_budget()
+
+        row["findings"] = sorted(
+            {i.swc_id for i in report.issues.values()}
+        )
+        gov = governor_meta()
+        drained = (
+            get_checkpoint_plane().partial
+            or expired
+            or (gov or {}).get("rungs", [])[-1:] == ["drain_partial"]
+        )
+        if report.exceptions:
+            row["verdict"] = "partial"
+            row["reason"] = "internal_failure_salvaged"
+            # the salvage kept the process alive, but whatever died is
+            # a hardening bug to burn down — surface the last line
+            row["detail"] = report.exceptions[-1].strip().splitlines()[-1][:200]
+        elif drained:
+            row["verdict"] = "partial"
+            row["reason"] = "budget" if gov is None else "governor"
+        else:
+            row["verdict"] = "full"
+        if gov is not None:
+            row["governor"] = gov
+    except LoaderError as exc:
+        row["verdict"] = "error"
+        row["reason"] = exc.code
+        row["detail"] = str(exc)[:200]
+        row.setdefault("size", len(code) // 2)
+        row.setdefault("bucket", size_bucket(row["size"]))
+        row.setdefault("findings", [])
+    except BaseException as exc:  # noqa: BLE001 — the invariant under test
+        if isinstance(exc, KeyboardInterrupt):
+            raise
+        import traceback
+
+        row["verdict"] = "crash"
+        row["reason"] = type(exc).__name__
+        row["detail"] = traceback.format_exc()[-800:]
+        row.setdefault("size", len(code) // 2)
+        row.setdefault("bucket", size_bucket(row["size"]))
+        row.setdefault("findings", [])
+    row["wall_s"] = round(time.monotonic() - began, 3)
+    return row
+
+
+def analyze_via_serve(name: str, code: str, deadline_s: float,
+                      serve_url: str) -> dict:
+    """Fabric tenancy: submit to a running ``myth serve`` daemon (which
+    routes to its remote seats when the fleet is attached) and map the
+    response onto the same verdict vocabulary."""
+    import urllib.error
+    import urllib.request
+
+    began = time.monotonic()
+    row = {"id": contract_id(name, code), "name": name,
+           "size": len(code.removeprefix("0x")) // 2}
+    row["bucket"] = size_bucket(row["size"])
+    payload = json.dumps({
+        "code": code, "name": name, "deadline_s": deadline_s,
+        "source": "corpus_sweep",
+    }).encode()
+    try:
+        req = urllib.request.Request(
+            serve_url.rstrip("/") + "/analyze", data=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        body = json.loads(urllib.request.urlopen(
+            req, timeout=deadline_s + 60
+        ).read())
+        row["findings"] = sorted(body.get("findings_swc", []))
+        row["verdict"] = "partial" if body.get("partial") else "full"
+        if body.get("partial"):
+            row["reason"] = "budget"
+    except urllib.error.HTTPError as exc:
+        row["verdict"] = "error"
+        row["reason"] = f"http_{exc.code}"
+        row["findings"] = []
+    except Exception as exc:  # noqa: BLE001 — network, not a crash
+        row["verdict"] = "error"
+        row["reason"] = type(exc).__name__
+        row["findings"] = []
+    row["wall_s"] = round(time.monotonic() - began, 3)
+    return row
+
+
+# ----------------------------------------------------------------------
+# journal + report
+# ----------------------------------------------------------------------
+
+def read_journal(path: str) -> dict:
+    """{id: row} of completed contracts; tolerates a torn final line
+    (the SIGKILL case the journal exists for)."""
+    done = {}
+    if not os.path.exists(path):
+        return done
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue  # torn tail from a kill mid-write
+            if "id" in row:
+                done[row["id"]] = row
+    return done
+
+
+def append_journal(fh, row: dict) -> None:
+    fh.write(json.dumps(row, sort_keys=True) + "\n")
+    fh.flush()
+    os.fsync(fh.fileno())
+
+
+def percentile(values, pct: float):
+    if not values:
+        return None
+    ordered = sorted(values)
+    k = min(len(ordered) - 1, int(round(pct / 100.0 * (len(ordered) - 1))))
+    return round(ordered[k], 3)
+
+
+def build_report(rows, wall_s: float) -> dict:
+    verdicts = {}
+    for row in rows:
+        verdicts[row["verdict"]] = verdicts.get(row["verdict"], 0) + 1
+    survivors = sum(
+        verdicts.get(k, 0) for k in ("full", "partial", "error")
+    )
+    with_findings = sum(1 for r in rows if r.get("findings"))
+    buckets = {}
+    for bucket in ("small", "medium", "large"):
+        walls = [r["wall_s"] for r in rows if r.get("bucket") == bucket]
+        if not walls:
+            continue
+        sub = [r for r in rows if r.get("bucket") == bucket]
+        buckets[bucket] = {
+            "contracts": len(walls),
+            "p50_s": percentile(walls, 50),
+            "p95_s": percentile(walls, 95),
+            "findings_rate": round(
+                sum(1 for r in sub if r.get("findings")) / len(sub), 3
+            ),
+        }
+    return {
+        "contracts": len(rows),
+        "verdicts": verdicts,
+        "survival_pct": round(100.0 * survivors / len(rows), 2)
+        if rows else None,
+        "findings_rate": round(with_findings / len(rows), 3)
+        if rows else None,
+        "corpus_p50_s": percentile([r["wall_s"] for r in rows], 50),
+        "corpus_p95_s": percentile([r["wall_s"] for r in rows], 95),
+        "buckets": buckets,
+        "wall_s": round(wall_s, 2),
+        "crashes": [
+            {"name": r["name"], "reason": r.get("reason"),
+             "detail": r.get("detail", "")[-300:]}
+            for r in rows if r["verdict"] == "crash"
+        ],
+    }
+
+
+# ----------------------------------------------------------------------
+# drivers
+# ----------------------------------------------------------------------
+
+def run_sweep(opts) -> int:
+    if opts.rpc:
+        corpus = _live_corpus(opts)
+    else:
+        base = load_fixtures(opts.fixtures)
+        corpus = expand_corpus(
+            base, max(opts.expand, len(base)), opts.seed
+        ) if opts.expand else base
+    if opts.limit:
+        corpus = corpus[: opts.limit]
+
+    done = read_journal(opts.journal) if opts.resume else {}
+    rows = []
+    began = time.monotonic()
+    with open(opts.journal, "a" if opts.resume else "w") as journal:
+        for index, (name, code) in enumerate(corpus):
+            cid = contract_id(name, code)
+            if cid in done:
+                rows.append(done[cid])
+                continue
+            if opts.serve:
+                row = analyze_via_serve(
+                    name, code, opts.deadline_s, opts.serve
+                )
+            else:
+                row = analyze_one(
+                    name, code, opts.deadline_s, opts.max_depth,
+                    opts.tx_count, workers=opts.workers,
+                    checkpoint_dir=opts.checkpoint_dir,
+                )
+            append_journal(journal, row)
+            rows.append(row)
+            print(
+                f"[{index + 1}/{len(corpus)}] {name}: {row['verdict']}"
+                f" ({row['wall_s']}s, findings={row.get('findings')})",
+                file=sys.stderr,
+            )
+    report = build_report(rows, time.monotonic() - began)
+    if opts.out:
+        with open(opts.out, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+    print(json.dumps(report, sort_keys=True))
+    return 1 if report["verdicts"].get("crash") else 0
+
+
+def run_wild(opts) -> int:
+    """Differential fuzz: N random/mutated bytecodes under tiny
+    budgets.  The single invariant: every case lands full / partial /
+    error — a crash verdict (or an escaped exception) fails the run."""
+    rng = random.Random(opts.seed)
+    base = load_fixtures(opts.fixtures)
+    rows = []
+    began = time.monotonic()
+    for i in range(opts.wild):
+        if base and rng.random() < 0.6:
+            name, code = base[rng.randrange(len(base))]
+            code = rng.choice(MUTATIONS)(rng, code)
+            name = f"{name}.w{i}"
+        else:
+            name, code = f"rand{i}", random_bytecode(rng)
+        row = analyze_one(
+            name, code, deadline_s=opts.deadline_s,
+            max_depth=opts.max_depth, tx_count=1,
+        )
+        rows.append(row)
+        if row["verdict"] == "crash":
+            print(f"CRASH on {name}:\n{row['detail']}", file=sys.stderr)
+    survivors = sum(1 for r in rows if r["verdict"] != "crash")
+    report = {
+        "cases": len(rows),
+        "wild_survival_pct": round(100.0 * survivors / len(rows), 2)
+        if rows else None,
+        "verdicts": build_report(rows, 0.0)["verdicts"],
+        "wall_s": round(time.monotonic() - began, 2),
+    }
+    if opts.out:
+        with open(opts.out, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+    print(json.dumps(report, sort_keys=True))
+    return 0 if survivors == len(rows) else 1
+
+
+def _live_corpus(opts):
+    """--rpc mode: pull code for --addresses through the provider pool
+    (breakers, backoff, digest-keyed code cache)."""
+    from mythril_tpu.ethereum.interface.rpc.client import ProviderPool
+
+    if not opts.addresses:
+        sys.exit("--rpc needs --addresses FILE (one 0x… per line)")
+    pool = ProviderPool.from_spec(opts.rpc, tls=opts.rpctls)
+    corpus = []
+    with open(opts.addresses) as fh:
+        for line in fh:
+            address = line.strip()
+            if not address or address.startswith("#"):
+                continue
+            try:
+                code = pool.eth_getCode(address)
+            except Exception as exc:  # noqa: BLE001 — sweep past it
+                print(f"skip {address}: {exc}", file=sys.stderr)
+                continue
+            if code in ("0x", "0x0", "", None):
+                continue
+            corpus.append((address, code))
+            if opts.top and len(corpus) >= opts.top:
+                break
+    return corpus
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--fixtures", default=FIXTURE_DIR)
+    parser.add_argument("--expand", type=int, default=0,
+                        help="grow the corpus to N contracts by mutation")
+    parser.add_argument("--limit", type=int, default=0)
+    parser.add_argument("--seed", type=int, default=1167)
+    parser.add_argument("--deadline-s", type=float, default=10.0,
+                        help="per-contract wall budget")
+    parser.add_argument("--max-depth", type=int, default=22)
+    parser.add_argument("--tx-count", type=int, default=1)
+    parser.add_argument("--journal",
+                        default=os.path.join(REPO, "sweep_journal.jsonl"))
+    parser.add_argument("--resume", action="store_true",
+                        help="skip contracts already in the journal")
+    parser.add_argument("--out", default=None,
+                        help="write the pretty report here too")
+    parser.add_argument("--wild", type=int, default=0,
+                        help="fuzz harness: N mutated/random bytecodes")
+    parser.add_argument("--rpc", default=None,
+                        help="live mode: comma-separated provider spec")
+    parser.add_argument("--rpctls", action="store_true")
+    parser.add_argument("--addresses", default=None)
+    parser.add_argument("--top", type=int, default=0,
+                        help="live mode: stop after N non-empty contracts")
+    parser.add_argument("--serve", default=None,
+                        help="submit to a running myth serve URL (fabric)")
+    parser.add_argument("--workers", type=int, default=None,
+                        help="in-process fleet workers per contract")
+    parser.add_argument("--checkpoint-dir", default=None)
+    opts = parser.parse_args()
+
+    import logging
+
+    logging.basicConfig(level=logging.CRITICAL)
+    logging.getLogger("mythril_tpu").setLevel(logging.CRITICAL)
+
+    if opts.wild:
+        sys.exit(run_wild(opts))
+    sys.exit(run_sweep(opts))
+
+
+if __name__ == "__main__":
+    main()
