@@ -100,6 +100,10 @@ def _corpus():
         # module requires caller == origin == attacker, exactly like
         # the reference's modules/suicide.py), so no SWC-106 here
         ("origin_gate", origin_gate, 1, {"115"}),
+        # the veritesting diamond chain: 2^4 paths fork-only, O(1)
+        # merged — the corpus-level findings-parity pin for the merge
+        # tier rides this entry (the other contracts barely re-converge)
+        ("veritest_gauntlet", veritest_gauntlet_contract(), 1, {"101"}),
     ]
 
 
@@ -222,6 +226,49 @@ def chaos_tree_contract() -> str:
         PUSH 0; PUSH 0; REVERT
       ok3:
         JUMPDEST; PUSH 1; PUSH 2; SSTORE; STOP
+        """
+    )
+
+
+def veritest_gauntlet_contract() -> str:
+    """Chain of four balanced branch diamonds over calldata bits with
+    one accumulator slot diverging per diamond, then a symbolic-add
+    overflow tail (SWC-101): the canonical veritesting workload
+    (laser/ethereum/veritest.py).  Fork-only exploration pays 2^4
+    paths per transaction and — because the tail SSTOREs the
+    path-dependent accumulator — (2^4)^depth world states across a
+    deep sequence; with merging every diamond re-converges at its join
+    JUMPDEST into one lane carrying a single ``If`` term, so the
+    frontier stays O(1) per transaction.  Both arms are single basic
+    blocks ending in a static JUMP to the same join, which is exactly
+    the shape :meth:`SegmentPlan.join_pcs` detects."""
+    from mythril_tpu.support.assembler import asm
+
+    diamonds = []
+    for i in range(4):
+        bit = 1 << i
+        a, b = 0x11 * (i + 1), 0x23 * (i + 1)
+        diamonds.append(
+            f"""
+        DUP2; PUSH {bit}; AND; PUSH @t{i}; JUMPI
+        PUSH {a}; ADD; PUSH @j{i}; JUMP
+      t{i}:
+        JUMPDEST; PUSH {b}; ADD; PUSH @j{i}; JUMP
+      j{i}:
+        JUMPDEST
+            """
+        )
+    return asm(
+        """
+        PUSH 4; CALLDATALOAD
+        PUSH 0
+        """
+        + "".join(diamonds)
+        + """
+        DUP2; ADD
+        PUSH 0; SLOAD; ADD
+        PUSH 0; SSTORE
+        STOP
         """
     )
 
@@ -557,6 +604,73 @@ def _run_t3():
             missed.append((name, sorted(expected), sorted(found)))
         rows.append(row)
     return rows, missed
+
+
+def _t45_corpus():
+    """(name, code, minimum expected SWC ids) — the three branchiest
+    embedded contracts, the ones whose per-transaction fork fan-out
+    makes tx depth 4/5 interesting: the veritesting diamond chain
+    (2^4 paths/tx fork-only), the chaos dispatch tree, and the
+    BECToken-shaped batch token."""
+    return [
+        ("veritest_gauntlet", veritest_gauntlet_contract(), {"101"}),
+        ("chaos_tree", chaos_tree_contract(), {"106"}),
+        ("batchtoken", batchtoken_contract(), {"101"}),
+    ]
+
+
+def _run_t45():
+    """The -t 4/-t 5 deep-sequence rows (ROADMAP item 1b): each row
+    carries ``states_stepped`` / ``merges`` / ``subsumed_lanes`` (via
+    the dispatch-stats spread in :func:`_analyze_one`) plus the
+    ledger's per-row decided-tier split, the state-explosion
+    attribution the veritesting tier is judged on.  A fork-only
+    kill-switch twin (``MYTHRIL_TPU_VERITEST=0``) re-runs the
+    branchiest contract at depth 5 so the summary can report
+    ``veritest_speedup_states`` from the same process.  Timeouts cap
+    each row at 120s — a capped row honestly reports salvage, and the
+    oracle still requires the expected SWC."""
+    from mythril_tpu.observability.ledger import get_ledger
+    from mythril_tpu.support.support_args import args
+
+    for key, value in MODES["full"].items():
+        setattr(args, key, value)
+    rows, missed = [], []
+    for depth in (4, 5):
+        for name, code, expected in _t45_corpus():
+            base = get_ledger().snapshot()["decided"]
+            found, row = _analyze_one(
+                f"{name}_t{depth}", code, depth,
+                execution_timeout=120, max_depth=128,
+            )
+            decided = get_ledger().snapshot()["decided"]
+            row["tier_split"] = {
+                tier: count - base.get(tier, 0)
+                for tier, count in decided.items()
+                if count - base.get(tier, 0)
+            }
+            if not expected & found:
+                missed.append((f"{name}_t{depth}", sorted(expected),
+                               sorted(found)))
+            rows.append(row)
+    # fork-only twin: same contract, same depth, merge tier pinned off
+    name, code, expected = _t45_corpus()[0]
+    saved = os.environ.get("MYTHRIL_TPU_VERITEST")
+    os.environ["MYTHRIL_TPU_VERITEST"] = "0"
+    try:
+        twin_found, twin = _analyze_one(
+            f"{name}_t5_forkonly", code, 5,
+            execution_timeout=120, max_depth=128,
+        )
+    finally:
+        if saved is None:
+            os.environ.pop("MYTHRIL_TPU_VERITEST", None)
+        else:
+            os.environ["MYTHRIL_TPU_VERITEST"] = saved
+    if not expected & twin_found:
+        missed.append((f"{name}_t5_forkonly", sorted(expected),
+                       sorted(twin_found)))
+    return rows, twin, missed
 
 
 def _mesh_scale_child():
@@ -1271,6 +1385,20 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
         headline["host_boundaries_per_1k_states"] = summary[
             "host_boundaries_per_1k_states"
         ]
+    if summary.get("merges_per_1k_states") is not None:
+        # veritesting tier: re-convergence merges per 1k lockstep
+        # states over the -t 4/5 deep-sequence rows (gated
+        # higher-is-better in bench_compare), plus the states-stepped
+        # ratio of the fork-only twin vs the merged depth-5 run.
+        # Absent (not null) on --quick rounds or with
+        # MYTHRIL_TPU_VERITEST=0, keeping the cap headroom
+        headline["merges_per_1k_states"] = summary[
+            "merges_per_1k_states"
+        ]
+        if summary.get("veritest_speedup_states") is not None:
+            headline["veritest_speedup_states"] = summary[
+                "veritest_speedup_states"
+            ]
     if "t3_wall_s" in summary:
         headline["t3_wall_s"] = summary["t3_wall_s"]
     if isinstance(mesh_scale, dict) and "skipped" not in mesh_scale:
@@ -1330,6 +1458,7 @@ def build_headline_line(summary, mesh_scale, microbench) -> str:
     if len(line) > 500:  # hard cap so the tail capture can never lose it
         for key in ("autopilot_tuned", "autopilot_ladder",
                     "autopilot_routed", "tier_decided_pct",
+                    "veritest_speedup_states", "merges_per_1k_states",
                     "wild_survival_pct", "corpus_p95_s",
                     "persist_hit_rate", "warm_restart_speedup",
                     "fabric_cpm",
@@ -1447,6 +1576,19 @@ def main() -> None:
             print(json.dumps(row), file=sys.stderr)
         if t3_missed:
             print(f"T3 MISSED: {t3_missed}", file=sys.stderr)
+
+    # deep-sequence rows (tx depth 4/5) + the fork-only twin
+    t45_rows, t45_twin, t45_missed = ([], None, [])
+    if not quick:
+        t45_began = time.time()
+        t45_rows, t45_twin, t45_missed = _run_t45()
+        t45_wall = round(time.time() - t45_began, 2)
+        print("--- -t 4/5 deep-sequence rows (mode=full) ---",
+              file=sys.stderr)
+        for row in t45_rows + [t45_twin]:
+            print(json.dumps(row), file=sys.stderr)
+        if t45_missed:
+            print(f"T45 MISSED: {t45_missed}", file=sys.stderr)
 
     # wide-frontier scale scenarios (device-dispatch telemetry; skipped
     # with --no-scale for corpus-only timing runs)
@@ -1686,6 +1828,44 @@ def main() -> None:
         ]
         if t3_missed:
             summary["t3_error"] = f"t3 missed findings: {t3_missed}"
+    if t45_rows:
+        # veritesting tier (tx depth 4/5): deep-sequence rows where
+        # re-convergence merging pays, with per-row lane-ledger tier
+        # split — plus the fork-only twin the speedup ratio needs
+        summary["t45_wall_s"] = t45_wall
+        summary["t45_rows"] = [
+            {k: r.get(k) for k in ("contract", "wall_s",
+                                   "states_stepped", "merges",
+                                   "subsumed_lanes", "found",
+                                   "tier_split")}
+            for r in t45_rows
+        ]
+        if t45_missed:
+            summary["t45_error"] = f"t45 missed findings: {t45_missed}"
+        # headline ratio #1: states the kill-switch twin stepped over
+        # states the merged depth-5 run stepped on the SAME contract —
+        # the state-explosion cut the veritesting tier is judged on
+        merged_t5 = next(
+            (r for r in t45_rows
+             if r["contract"] == "veritest_gauntlet_t5"), None
+        )
+        if (t45_twin is not None and merged_t5 is not None
+                and merged_t5.get("states_stepped")):
+            summary["veritest_speedup_states"] = round(
+                t45_twin.get("states_stepped", 0)
+                / merged_t5["states_stepped"], 2
+            )
+        # headline ratio #2: re-convergence merges per 1k lockstep
+        # states across the deep rows (gated higher-is-better in
+        # scripts/bench_compare.py).  Absent, not null, when nothing
+        # stepped — e.g. MYTHRIL_TPU_VERITEST=0 plus lockstep off —
+        # mirroring the seg_steps idiom above
+        t45_steps = sum(r.get("states_stepped", 0) for r in t45_rows)
+        t45_merges = sum(r.get("merges", 0) for r in t45_rows)
+        if t45_steps:
+            summary["merges_per_1k_states"] = round(
+                t45_merges / t45_steps * 1000, 2
+            )
     # tracing self-cost estimate: measured per-span bookkeeping cost x
     # events actually recorded across every pass of this process (the
     # headline field bench_compare gates; 0.0 with MYTHRIL_TPU_TRACE=0)

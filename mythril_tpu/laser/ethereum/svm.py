@@ -298,6 +298,13 @@ class LaserEVM:
         if self.time is None:
             self.time = datetime.now()
         batch_width = max(1, getattr(args, "batch_width", 1))
+        # veritesting tier: one merge/subsumption driver per exec —
+        # None when the tier declines (statespace consumers, gas
+        # tracking, CREATE, or the MYTHRIL_TPU_VERITEST=0 kill switch
+        # pinning the exact fork-only path)
+        from mythril_tpu.laser.ethereum import veritest
+
+        vt_engine = veritest.engine_for(self, create, track_gas)
         while True:
             if drain_requested():
                 # graceful drain: stop drawing work — in-flight rounds
@@ -333,6 +340,11 @@ class LaserEVM:
 
             if timed_out is not None:
                 return final_states + [timed_out] if track_gas else None
+            if vt_engine is not None and self.work_list:
+                # between rounds, with no dispatch in flight: merge
+                # re-converged sibling lanes and retire subsumed ones
+                # in place (the strategy holds this same list object)
+                vt_engine.round_tick(self.work_list)
         return final_states if track_gas else None
 
     def _exec_round(self, batch, rounds, create, track_gas,

@@ -195,13 +195,15 @@ class SegmentPlan:
     mid-basic-block (checkpointed frontier, fleet handoff) groups
     exactly like a fresh fork."""
 
-    __slots__ = ("info", "ops")
+    __slots__ = ("info", "ops", "_instrs", "_joins")
 
     def __init__(self, code):
         self.info: List[Optional[_OpPlan]] = []
         self.ops: List[str] = []
+        self._instrs = list(code.instruction_list)
+        self._joins: Optional[frozenset] = None
         instr_objs: Dict[str, Instruction] = {}
-        for instr in code.instruction_list:
+        for instr in self._instrs:
             self.ops.append(instr.op_code)
             self.info.append(self._plan_op(instr, instr_objs))
 
@@ -257,6 +259,46 @@ class SegmentPlan:
             if info.terminator:
                 break
         return n
+
+    def join_pcs(self) -> frozenset:
+        """Static re-convergence points (instruction indices): the
+        JUMPDESTs where distinct control paths can meet again — ≥2
+        statically-resolvable jump in-edges, or one jump in-edge plus
+        fallthrough from a non-terminating predecessor.  Only
+        ``PUSHn addr; JUMP/JUMPI`` edges are resolvable; computed
+        jumps stay invisible, which only costs missed merges (the
+        veritesting tier degrades to plain forking there)."""
+        if self._joins is not None:
+            return self._joins
+        addr_to_pc = {
+            instr.address: pc for pc, instr in enumerate(self._instrs)
+        }
+        in_edges: Dict[int, int] = {}
+        fallthrough: Dict[int, bool] = {}
+        prev = None
+        for pc, instr in enumerate(self._instrs):
+            op = instr.op_code
+            if op in ("JUMP", "JUMPI") and prev is not None:
+                p_op, p_arg = prev
+                if p_op.startswith("PUSH") and p_arg:
+                    target = addr_to_pc.get(int.from_bytes(p_arg, "big"))
+                    if target is not None:
+                        in_edges[target] = in_edges.get(target, 0) + 1
+            if op == "JUMPDEST" and pc > 0:
+                before = self._instrs[pc - 1].op_code
+                fallthrough[pc] = before not in (
+                    "JUMP", "STOP", "RETURN", "REVERT", "INVALID",
+                    "SELFDESTRUCT",
+                )
+            prev = (op, instr.argument)
+        self._joins = frozenset(
+            pc for pc, instr in enumerate(self._instrs)
+            if instr.op_code == "JUMPDEST" and (
+                in_edges.get(pc, 0) >= 2
+                or (in_edges.get(pc, 0) >= 1 and fallthrough.get(pc))
+            )
+        )
+        return self._joins
 
     def plane_kinds(self, pc: int, cap: int) -> Tuple[str, ...]:
         """Sorted plane kinds ("keccak"/"mem"/"storage") the segment

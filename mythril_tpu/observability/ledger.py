@@ -61,8 +61,14 @@ TERMINAL_TIERS = ("structural", "probe", "word", "frontier", "sweep",
 #: stepped through batched segments (symbolic_lockstep.py) — recorded
 #: via count_transition only, so the conservation invariant over solver
 #: lanes is untouched (a segment lane is not a solver query)
+#: ``merge`` / ``subsume`` count veritesting transitions
+#: (laser/ethereum/veritest.py): interpreter lanes that left the
+#: frontier by collapsing into a sibling (merge = ite-join at a
+#: re-convergence point, subsume = retired under a sibling's weaker
+#: constraint set) — aggregate-only like ``lockstep``, so solver-lane
+#: conservation is untouched (a merged lane never became a query)
 TRANSITIONS = ("opened", "deferred", "dispatched", "quarantined",
-               "opaque", "dropped", "lockstep")
+               "opaque", "dropped", "lockstep", "merge", "subsume")
 #: tier-transition legality (validated by scripts/trace_lint.py):
 #: state -> the set of states a lane may move to next
 LEGAL_NEXT = {
@@ -78,6 +84,10 @@ LEGAL_NEXT = {
     # hand off to the funnel's entry states
     "lockstep": {"deferred", "dispatched", "opaque", "dropped",
                  *TERMINAL_TIERS},
+    # a merged/subsumed lane is gone — its survivor carries on as a
+    # plain interpreter lane and re-enters the funnel as "opened"
+    "merge": {"opened", "lockstep"},
+    "subsume": {"opened", "lockstep"},
 }
 VERDICTS = ("sat", "unsat", "undecided")
 

@@ -278,6 +278,21 @@ class DispatchStats:
         self.mem_plane_ops = 0
         self.storage_plane_ops = 0
         self.keccak_device_hashes = 0
+        # veritesting tier (laser/ethereum/veritest.py): re-converged
+        # sibling pairs collapsed to one lane (merges / merged_lanes),
+        # If terms those joins minted (merge_ites — the budget
+        # MYTHRIL_TPU_MERGE_MAX_ITES bounds per join), joins declined
+        # or degraded to plain forking (merge_aborts), and the
+        # frontier-subsumption sweeps with the lanes they retired
+        # without ever reaching a solver; merge_span_s is the
+        # svm.merge/svm.subsume span sink
+        self.merges = 0
+        self.merged_lanes = 0
+        self.merge_ites = 0
+        self.merge_aborts = 0
+        self.subsume_sweeps = 0
+        self.subsumed_lanes = 0
+        self.merge_span_s = 0.0
 
     def as_dict(self):
         from mythril_tpu.parallel.fleet import fleet_stats
@@ -1893,6 +1908,12 @@ def reset_resident_pools() -> None:
     from mythril_tpu.parallel.mesh import reset_mesh_caches
 
     reset_mesh_caches()
+    # the veritesting join-point memo is keyed by bytecode string but
+    # caches SegmentPlan-derived pc sets — dropped with the plan cache
+    # family so a resumed process rebuilds them from its own disassembly
+    from mythril_tpu.laser.ethereum.veritest import reset_veritest_memos
+
+    reset_veritest_memos()
 
 
 def batch_check_states(constraint_sets) -> List[Optional[bool]]:

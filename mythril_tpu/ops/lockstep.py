@@ -809,3 +809,26 @@ def pc_visited(visited, lane: int, pc: int) -> bool:
 
     word = np.asarray(visited)[lane, pc >> 5]
     return bool((int(word) >> (pc & 31)) & 1)
+
+
+def join_known_bits(kv_a, km_a, kv_b, km_b):
+    """Word-tier meet of two known-bits limb planes (the veritesting
+    join lattice, laser/ethereum/veritest.py): a bit survives the
+    merged lane only when BOTH lanes know it AND agree on its value —
+    ``km = km_a & km_b & ~(kv_a ^ kv_b)`` — and the joined value is
+    masked down to the surviving knowledge.  Returns
+    ``(kv, km, disagreements)`` where ``disagreements`` counts the
+    bits both lanes knew but disagreed on (a merge-benefit signal:
+    high disagreement means the join forgets real knowledge)."""
+    kv_a = np.asarray(kv_a, dtype=np.uint32)
+    kv_b = np.asarray(kv_b, dtype=np.uint32)
+    km_a = np.asarray(km_a, dtype=np.uint32)
+    km_b = np.asarray(km_b, dtype=np.uint32)
+    both = km_a & km_b
+    differ = kv_a ^ kv_b
+    km = both & ~differ
+    kv = kv_a & km
+    disagreements = int(
+        np.unpackbits((both & differ).view(np.uint8)).sum()
+    )
+    return kv, km, disagreements

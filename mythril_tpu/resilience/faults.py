@@ -140,6 +140,10 @@ FAULT_POINTS = (
     # cache read answer as a miss (models a quarantined segment) — the
     # loader must fall through to the network, never crash
     "rpc_code_cache",
+    # veritesting tier (laser/ethereum/veritest.py): an armed shot
+    # aborts one state merge mid-join — the pair degrades to plain
+    # forking (both lanes survive), findings parity must hold
+    "merge_abort",
 )
 
 DEFAULT_HANG_S = 30.0
@@ -334,6 +338,14 @@ def maybe_fault_dispatch(lane_ids=None) -> None:
         raise FaultInjected(
             "injected lane-dependent kernel abort (poisoned lane aboard)"
         )
+
+
+def maybe_abort_merge() -> bool:
+    """Veritesting merge seam (laser/ethereum/veritest.py): True when
+    an armed ``merge_abort`` shot fires, which aborts the in-flight
+    state merge AFTER eligibility passed — both lanes survive and fork
+    on, the degraded path whose findings parity the chaos soak pins."""
+    return get_fault_plane().fire("merge_abort") is not None
 
 
 def maybe_fault_frontier() -> None:

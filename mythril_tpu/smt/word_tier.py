@@ -1318,3 +1318,78 @@ def reset_word_tier() -> None:
     ids are reborn and a stale verdict would be silently wrong."""
     if _tier is not None:
         _tier.reset()
+
+
+# ---------------------------------------------------------------------------
+# interval implication (veritesting subsumption, laser/ethereum/veritest.py)
+# ---------------------------------------------------------------------------
+
+
+def _bound_of(node):
+    """Normalize one constraint node to an unsigned interval claim
+    ``(subject id, lo, hi)`` — "the term `subject` lies in [lo, hi]" —
+    or None when the node is not a one-sided/point comparison against
+    a constant.  Only the unsigned vocabulary normalizes (eq / ult /
+    ule and their bnot complements); signed comparisons stay opaque,
+    which only costs missed subsumptions."""
+    op = node.op
+    if op == "bnot":
+        inner = _bound_of(node.args[0])
+        if inner is None:
+            return None
+        subject, lo, hi = inner
+        top = (1 << _subject_width(node.args[0])) - 1
+        # the complement of a one-sided interval is one-sided again;
+        # a punctured range (NOT eq) is not an interval — drop it
+        if lo == 0 and hi < top:
+            return (subject, hi + 1, top)
+        if hi == top and lo > 0:
+            return (subject, 0, lo - 1)
+        return None
+    if op not in ("eq", "ult", "ule"):
+        return None
+    left, right = node.args
+    if left.sort != "bv":
+        return None
+    top = (1 << left.width) - 1
+    if right.is_const and not left.is_const:
+        c = right.value
+        if op == "eq":
+            return (left.id, c, c)
+        if op == "ult":
+            return (left.id, 0, c - 1) if c > 0 else None
+        return (left.id, 0, c)  # ule
+    if left.is_const and not right.is_const:
+        c = left.value
+        if op == "eq":
+            return (right.id, c, c)
+        if op == "ult":
+            return (right.id, c + 1, top) if c < top else None
+        return (right.id, c, top)  # ule
+    return None
+
+
+def _subject_width(cmp_node):
+    for arg in cmp_node.args:
+        if arg.sort == "bv":
+            return arg.width
+    return 256
+
+
+def interval_implies(strong, weak) -> bool:
+    """Does constraint node ``strong`` imply ``weak`` at word level?
+    True only when both normalize to interval claims about the SAME
+    subject term and strong's interval is contained in weak's — e.g.
+    ``x == 5`` implies ``x < 10``.  Sound to use for lane retirement:
+    every model of strong is a model of weak, never the reverse
+    direction.  Returns False (never raises) on anything it cannot
+    normalize."""
+    if strong.id == weak.id:
+        return True
+    try:
+        sb, wb = _bound_of(strong), _bound_of(weak)
+    except Exception:  # noqa: BLE001 — an odd node shape declines
+        return False
+    if sb is None or wb is None or sb[0] != wb[0]:
+        return False
+    return wb[1] <= sb[1] and sb[2] <= wb[2]

@@ -100,9 +100,15 @@ GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
 #: mutation-fuzzed bytecodes the loader+analyzer survive with a
 #: full/partial/error verdict — anything under the baseline means an
 #: exception is escaping a boundary that promised it never would
+#: merges_per_1k_states gates the veritesting tier (laser/ethereum/
+#: veritest.py): re-convergence merges per 1k lockstep states over
+#: the -t 4/5 deep-sequence rows — the merge heuristic declining
+#: diamonds it used to join (token drift, window/ite budget
+#: regressions) shows up here before the t45 walls move
 GATED_HIGHER_BETTER = ("serve_cpm", "microbench_device_vs_host",
                        "fleet_speedup", "states_per_s", "fabric_cpm",
-                       "warm_restart_speedup", "wild_survival_pct")
+                       "warm_restart_speedup", "wild_survival_pct",
+                       "merges_per_1k_states")
 #: floor below which a baseline is noise and ratios are meaningless
 MIN_BASE = 0.05
 

@@ -100,6 +100,12 @@ SCHEDULE = {
     # prefetch only launches when the profit gate declines a frontier,
     # so this round must not force dispatch
     "prefetch_error": ({"times": 99}, {}, {"device_force_dispatch": False}),
+    # the veritesting merge commit aborts at its fault seam
+    # (laser/ethereum/veritest.py maybe_abort_merge): every abort must
+    # degrade to plain forking — more states, identical findings — so
+    # the round pins the tier ON and asserts corpus parity like the
+    # rest of the ladder
+    "merge_abort": ({"times": 99}, {"MYTHRIL_TPU_VERITEST": "1"}, {}),
 }
 
 

@@ -204,7 +204,10 @@ def analyze_one(name: str, code: str, deadline_s: float,
     from mythril_tpu.mythril.mythril_disassembler import MythrilDisassembler
 
     began = time.monotonic()
-    row = {"id": contract_id(name, code), "name": name}
+    # depth rides every row (and the report): two sweeps at different
+    # --max-depth caps must never be compared as one distribution
+    row = {"id": contract_id(name, code), "name": name,
+           "depth": max_depth}
     try:
         _reset_analysis_state()
         disassembler = MythrilDisassembler(eth=None)
@@ -379,8 +382,14 @@ def build_report(rows, wall_s: float) -> dict:
                 sum(1 for r in sub if r.get("findings")) / len(sub), 3
             ),
         }
+    depths = sorted({r.get("depth") for r in rows
+                     if r.get("depth") is not None})
     return {
         "contracts": len(rows),
+        # the --max-depth cap the rows ran under (a list when a resumed
+        # journal mixed caps — a distribution that must not be compared
+        # as one)
+        "depth": depths[0] if len(depths) == 1 else (depths or None),
         "verdicts": verdicts,
         "survival_pct": round(100.0 * survivors / len(rows), 2)
         if rows else None,

@@ -138,6 +138,20 @@ def main() -> None:
     row["lockstep_span_share"] = round(
         lockstep_s / row["total_wall_s"], 4
     ) if row["total_wall_s"] else 0.0
+    # veritesting-tier share: wall spent in the re-convergence merge
+    # and frontier-subsumption passes (svm.merge / svm.subsume spans)
+    # — the row already carries merges / merge_ites / merge_aborts /
+    # subsumed_lanes via DispatchStats, so the cost of the tier is
+    # legible next to the states it saved
+    merge_s = sum(
+        seconds for name, seconds in totals.items()
+        if name.startswith(("svm.merge", "svm.subsume"))
+    )
+    row["span_merge_s"] = round(merge_s, 3)
+    row["merge_span_share"] = round(
+        merge_s / row["total_wall_s"], 4
+    ) if row["total_wall_s"] else 0.0
+    row["subsumed_lanes"] = row.get("subsumed_lanes", 0)
     # NEEDS_HOST boundary breakdown: which opcode (or "cap" /
     # "end-of-code") parked lanes back to serial stepping, sorted by
     # count — the per-cause view behind the bench headline's

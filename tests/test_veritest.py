@@ -1,0 +1,540 @@
+"""Veritesting tier tests (laser/ethereum/veritest.py).
+
+The tier's contract is *soundness under reduction*: merging
+re-converged lanes and retiring subsumed ones may only shrink the
+frontier, never change what the analysis can prove.  Pins here:
+
+- merged-vs-forked parity on the three CFG shapes that matter
+  (diamond, nested diamond, loop body re-converging at its join)
+  through the full pipeline, with the merge counters asserted so a
+  silently-declining heuristic cannot fake parity;
+- the join itself at unit level: ite-joined stack words, disjoined
+  constraint suffixes, satisfiability of the joined set, and every
+  abort gate (ite budget, divergence window, diverged storage);
+- subsumption soundness DIRECTION: the retired lane's models are
+  always covered by the survivor's (stronger retires into weaker,
+  never the reverse), both by constraint-set inclusion and by
+  word-tier interval implication;
+- kill-switch parity through the full pipeline on the chaos tree;
+- ledger lane conservation across the merge/subsume transitions;
+- the merge_abort fault seam degrading to plain forking at parity.
+"""
+
+from copy import copy
+from datetime import datetime
+
+import pytest
+
+from mythril_tpu.disassembler.disassembly import Disassembly
+from mythril_tpu.laser.ethereum import veritest
+from mythril_tpu.laser.ethereum.state.calldata import ConcreteCalldata
+from mythril_tpu.laser.ethereum.state.environment import Environment
+from mythril_tpu.laser.ethereum.state.global_state import GlobalState
+from mythril_tpu.laser.ethereum.state.machine_state import MachineState
+from mythril_tpu.laser.ethereum.state.world_state import WorldState
+from mythril_tpu.laser.ethereum.svm import LaserEVM
+from mythril_tpu.laser.ethereum.transaction.transaction_models import (
+    MessageCallTransaction,
+)
+from mythril_tpu.smt import ULT, symbol_factory
+from mythril_tpu.support.assembler import asm
+
+pytestmark = pytest.mark.veritest
+
+
+# ---------------------------------------------------------------------------
+# harness (mirrors tests/test_sym_lockstep.py)
+# ---------------------------------------------------------------------------
+
+
+def make_state(code_hex: str, stack=None, pc: int = 0,
+               gas_limit: int = 8_000_000) -> GlobalState:
+    world_state = WorldState()
+    account = world_state.create_account(
+        balance=10, address=0x0A, concrete_storage=True,
+        code=Disassembly(code_hex),
+    )
+    environment = Environment(
+        account,
+        sender=symbol_factory.BitVecVal(0xB0B, 256),
+        calldata=ConcreteCalldata("1", []),
+        gasprice=symbol_factory.BitVecVal(1, 256),
+        callvalue=symbol_factory.BitVecVal(0, 256),
+        origin=symbol_factory.BitVecVal(0xB0B, 256),
+    )
+    state = GlobalState(world_state, environment, None,
+                        MachineState(gas_limit))
+    state.transaction_stack.append(
+        (
+            MessageCallTransaction(
+                world_state=world_state,
+                callee_account=account,
+                caller=environment.sender,
+                gas_limit=8_000_000,
+            ),
+            None,
+        )
+    )
+    state.mstate.pc = pc
+    for item in stack or []:
+        state.mstate.stack.append(
+            symbol_factory.BitVecVal(item, 256)
+            if isinstance(item, int) else item
+        )
+    return state
+
+
+def make_svm() -> LaserEVM:
+    svm = LaserEVM(requires_statespace=False, execution_timeout=600)
+    svm.time = datetime.now()
+    return svm
+
+
+def make_engine() -> veritest.VeritestEngine:
+    return veritest.VeritestEngine(make_svm())
+
+
+def diverged_pair(code_hex="6001600201", stack_a=7, stack_b=9):
+    """Two fork siblings at the same frame: shared prefix constraint,
+    one diverging constraint each, one diverging stack word."""
+    x = symbol_factory.BitVecSym("vt_x", 256)
+    base = make_state(code_hex)
+    shared = x < symbol_factory.BitVecVal(100, 256)
+    base.world_state.constraints.append(shared)
+    a, b = copy(base), copy(base)
+    a.world_state.constraints.append(
+        x == symbol_factory.BitVecVal(1, 256)
+    )
+    b.world_state.constraints.append(
+        x == symbol_factory.BitVecVal(2, 256)
+    )
+    a.mstate.stack.append(symbol_factory.BitVecVal(stack_a, 256))
+    b.mstate.stack.append(symbol_factory.BitVecVal(stack_b, 256))
+    return a, b
+
+
+# the three CFG shapes the merged-vs-forked parity runs cover; all end
+# in a symbolic-add SSTORE tail so fork-only exploration pays one world
+# state per path while merging pays one per join
+def diamond_contract() -> str:
+    return asm("""
+        PUSH 4; CALLDATALOAD
+        PUSH 0
+        DUP2; PUSH 1; AND; PUSH @t; JUMPI
+        PUSH 17; ADD; PUSH @j; JUMP
+      t:
+        JUMPDEST; PUSH 35; ADD; PUSH @j; JUMP
+      j:
+        JUMPDEST
+        DUP2; ADD
+        PUSH 0; SSTORE
+        STOP
+    """)
+
+
+def nested_diamond_contract() -> str:
+    return asm("""
+        PUSH 4; CALLDATALOAD
+        PUSH 0
+        DUP2; PUSH 1; AND; PUSH @outer_t; JUMPI
+        DUP2; PUSH 2; AND; PUSH @inner_t; JUMPI
+        PUSH 17; ADD; PUSH @inner_j; JUMP
+      inner_t:
+        JUMPDEST; PUSH 35; ADD; PUSH @inner_j; JUMP
+      inner_j:
+        JUMPDEST
+        PUSH @outer_j; JUMP
+      outer_t:
+        JUMPDEST; PUSH 70; ADD; PUSH @outer_j; JUMP
+      outer_j:
+        JUMPDEST
+        DUP2; ADD
+        PUSH 0; SSTORE
+        STOP
+    """)
+
+
+def loop_exit_contract() -> str:
+    # stack: [x, acc, i]; three iterations, each with a branch diamond
+    # over a calldata bit re-converging at @j before the counter step
+    return asm("""
+        PUSH 4; CALLDATALOAD
+        PUSH 0
+        PUSH 0
+      loop:
+        JUMPDEST
+        DUP3; PUSH 1; AND; PUSH @t; JUMPI
+        SWAP1; PUSH 3; ADD; SWAP1; PUSH @j; JUMP
+      t:
+        JUMPDEST
+        SWAP1; PUSH 5; ADD; SWAP1; PUSH @j; JUMP
+      j:
+        JUMPDEST
+        PUSH 1; ADD
+        PUSH 3; DUP2; LT; PUSH @loop; JUMPI
+        POP
+        ADD
+        PUSH 0; SSTORE
+        STOP
+    """)
+
+
+def _analyze(name, code, tx_count=1):
+    import bench
+
+    return bench._analyze_one(
+        name, code, tx_count, execution_timeout=120, max_depth=128
+    )
+
+
+# ---------------------------------------------------------------------------
+# re-convergence detection
+# ---------------------------------------------------------------------------
+
+
+def test_join_pcs_detected_on_all_three_shapes():
+    from mythril_tpu.laser.ethereum.symbolic_lockstep import plan_for
+
+    for code_hex in (diamond_contract(), nested_diamond_contract(),
+                     loop_exit_contract()):
+        plan = plan_for(Disassembly(code_hex))
+        assert plan is not None
+        joins = plan.join_pcs()
+        assert joins, "a two-armed join JUMPDEST must be detected"
+        instrs = Disassembly(code_hex).instruction_list
+        assert all(
+            instrs[pc].op_code == "JUMPDEST" for pc in joins
+        )
+
+
+def test_straight_line_code_has_no_join_pcs():
+    from mythril_tpu.laser.ethereum.symbolic_lockstep import plan_for
+
+    plan = plan_for(Disassembly("6001600201600055"))
+    assert plan is not None
+    assert plan.join_pcs() == frozenset()
+
+
+# ---------------------------------------------------------------------------
+# the merge join at unit level
+# ---------------------------------------------------------------------------
+
+
+def test_merge_pair_joins_stack_word_and_constraints():
+    from mythril_tpu.support.model import get_model
+
+    engine = make_engine()
+    a, b = diverged_pair()
+    pc = a.mstate.pc
+    prefix = [str(c) for c in list(a.world_state.constraints)[:-1]]
+    merged = engine._try_merge(a, b, pc)
+    assert merged is not None
+    # machine shape: same pc, same depth ceiling, one lane
+    assert merged.mstate.pc == pc
+    assert len(merged.mstate.stack) == len(a.mstate.stack)
+    # the diverging word became a single guarded term, not either
+    # arm's constant
+    joined_word = merged.mstate.stack[-1]
+    assert joined_word.symbolic
+    assert str(joined_word) not in ("7", "9")
+    # constraints: shared prefix verbatim + ONE disjunction
+    got = [str(c) for c in merged.world_state.constraints]
+    assert got[: len(prefix)] == prefix
+    assert len(got) == len(prefix) + 1
+    # the joined set is satisfiable (both arms were)
+    assert get_model(list(merged.world_state.constraints)) is not None
+
+
+def test_merge_counts_ites_and_preserves_agreeing_words():
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+
+    dispatch_stats.reset()
+    engine = make_engine()
+    a, b = diverged_pair()
+    agreeing = symbol_factory.BitVecVal(42, 256)
+    a.mstate.stack.insert(0, agreeing)
+    b.mstate.stack.insert(0, agreeing)
+    merged = engine._try_merge(a, b, a.mstate.pc)
+    assert merged is not None
+    # the agreeing word survives verbatim; only the diff minted an ite
+    assert str(merged.mstate.stack[0]) == "42"
+    assert dispatch_stats.merge_ites == 1
+
+
+def test_merge_ite_budget_aborts_to_fork(monkeypatch):
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+
+    monkeypatch.setenv("MYTHRIL_TPU_MERGE_MAX_ITES", "0")
+    dispatch_stats.reset()
+    engine = make_engine()
+    a, b = diverged_pair()
+    assert engine.max_ites == 0
+    assert engine._try_merge(a, b, a.mstate.pc) is None
+    assert dispatch_stats.merge_aborts == 1
+    assert dispatch_stats.merges == 0
+
+
+def test_merge_window_bounds_constraint_suffix(monkeypatch):
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+
+    monkeypatch.setenv("MYTHRIL_TPU_MERGE_WINDOW", "1")
+    dispatch_stats.reset()
+    engine = make_engine()
+    a, b = diverged_pair()
+    y = symbol_factory.BitVecSym("vt_y", 256)
+    a.world_state.constraints.append(
+        y == symbol_factory.BitVecVal(3, 256)
+    )  # suffix of 2 on one side > window of 1
+    assert engine._try_merge(a, b, a.mstate.pc) is None
+    assert dispatch_stats.merge_aborts == 1
+
+
+def test_diverged_storage_aborts_merge():
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+
+    dispatch_stats.reset()
+    engine = make_engine()
+    a, b = diverged_pair()
+    account_b = b.environment.active_account
+    account_b.storage[symbol_factory.BitVecVal(0, 256)] = (
+        symbol_factory.BitVecVal(0xDEAD, 256)
+    )
+    assert engine._try_merge(a, b, a.mstate.pc) is None
+    assert dispatch_stats.merge_aborts == 1
+
+
+def test_prefix_shaped_constraints_never_merge():
+    """One side's constraints being a prefix of the other's is a
+    subsumption shape, not a diamond — the merge must decline it."""
+    engine = make_engine()
+    base = make_state("6001600201")
+    x = symbol_factory.BitVecSym("vt_p", 256)
+    base.world_state.constraints.append(
+        x == symbol_factory.BitVecVal(1, 256)
+    )
+    a, b = copy(base), copy(base)
+    b.world_state.constraints.append(
+        x < symbol_factory.BitVecVal(50, 256)
+    )
+    assert engine._try_merge(a, b, a.mstate.pc) is None
+
+
+# ---------------------------------------------------------------------------
+# subsumption soundness: stronger retires into weaker, never the reverse
+# ---------------------------------------------------------------------------
+
+
+def _identical_twins():
+    base = make_state("6001600201")
+    return copy(base), copy(base)
+
+
+def test_subsume_retires_superset_constraint_lane():
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+
+    dispatch_stats.reset()
+    engine = make_engine()
+    weak, strong = _identical_twins()
+    x = symbol_factory.BitVecSym("vt_s", 256)
+    p = x < symbol_factory.BitVecVal(10, 256)
+    q = x == symbol_factory.BitVecVal(5, 256)
+    weak.world_state.constraints.append(p)
+    strong.world_state.constraints.append(p)
+    strong.world_state.constraints.append(q)
+    for work_list in ([weak, strong], [strong, weak]):
+        dispatch_stats.reset()
+        engine._subsume_pass(work_list)
+        # models(strong) ⊆ models(weak): the strong lane retires and
+        # the weak survivor covers everything it could reach — NEVER
+        # the other direction, regardless of work-list order
+        assert work_list == [weak]
+        assert dispatch_stats.subsumed_lanes == 1
+
+
+def test_subsume_interval_implication_direction():
+    """No shared constraint nodes at all: x==5 retires into x<10 via
+    the word-tier interval fallback; the weak lane never retires."""
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+
+    engine = make_engine()
+    weak, strong = _identical_twins()
+    v = symbol_factory.BitVecSym("vt_i", 256)
+    weak.world_state.constraints.append(
+        ULT(v, symbol_factory.BitVecVal(10, 256))
+    )
+    strong.world_state.constraints.append(
+        v == symbol_factory.BitVecVal(5, 256)
+    )
+    dispatch_stats.reset()
+    work_list = [strong, weak]
+    engine._subsume_pass(work_list)
+    assert work_list == [weak]
+    assert dispatch_stats.subsumed_lanes == 1
+
+
+def test_subsume_never_fires_across_diverged_machines():
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+
+    engine = make_engine()
+    weak, strong = _identical_twins()
+    x = symbol_factory.BitVecSym("vt_m", 256)
+    p = x < symbol_factory.BitVecVal(10, 256)
+    weak.world_state.constraints.append(p)
+    strong.world_state.constraints.append(p)
+    strong.world_state.constraints.append(
+        x == symbol_factory.BitVecVal(5, 256)
+    )
+    strong.mstate.stack.append(symbol_factory.BitVecVal(1, 256))
+    weak.mstate.stack.append(symbol_factory.BitVecVal(2, 256))
+    dispatch_stats.reset()
+    work_list = [strong, weak]
+    engine._subsume_pass(work_list)
+    assert work_list == [strong, weak]
+    assert dispatch_stats.subsumed_lanes == 0
+
+
+def test_subsume_equal_sets_keep_exactly_one():
+    from mythril_tpu.ops.batched_sat import dispatch_stats
+
+    engine = make_engine()
+    a, b = _identical_twins()
+    x = symbol_factory.BitVecSym("vt_e", 256)
+    p = x < symbol_factory.BitVecVal(10, 256)
+    a.world_state.constraints.append(p)
+    b.world_state.constraints.append(p)
+    dispatch_stats.reset()
+    work_list = [a, b]
+    engine._subsume_pass(work_list)
+    assert len(work_list) == 1
+    assert dispatch_stats.subsumed_lanes == 1
+
+
+# ---------------------------------------------------------------------------
+# merged-vs-forked parity through the full pipeline
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shape,builder", [
+    ("diamond", diamond_contract),
+    ("nested_diamond", nested_diamond_contract),
+    ("loop_exit", loop_exit_contract),
+])
+def test_merged_vs_forked_parity(shape, builder, monkeypatch):
+    import logging
+
+    logging.getLogger("mythril_tpu").setLevel(logging.CRITICAL)
+    code = builder()
+    monkeypatch.setenv("MYTHRIL_TPU_VERITEST", "1")
+    found_on, row_on = _analyze(f"vt_{shape}_on", code)
+    assert row_on.get("merges", 0) > 0, (
+        "the merge transition never engaged — parity below is vacuous"
+    )
+    monkeypatch.setenv("MYTHRIL_TPU_VERITEST", "0")
+    found_off, row_off = _analyze(f"vt_{shape}_off", code)
+    assert row_off.get("merges", 0) == 0
+    assert row_off.get("subsumed_lanes", 0) == 0
+    assert found_on == found_off, (shape, found_on, found_off)
+    # the tier may only SHRINK exploration, never grow it
+    if row_on.get("states_stepped") and row_off.get("states_stepped"):
+        assert row_on["states_stepped"] <= row_off["states_stepped"]
+
+
+def test_kill_switch_full_pipeline_parity_on_chaos_tree(monkeypatch):
+    import logging
+
+    import bench
+
+    logging.getLogger("mythril_tpu").setLevel(logging.CRITICAL)
+    code = bench.chaos_tree_contract()
+    monkeypatch.setenv("MYTHRIL_TPU_VERITEST", "1")
+    found_on, _row_on = _analyze("vt_chaos_on", code, tx_count=2)
+    monkeypatch.setenv("MYTHRIL_TPU_VERITEST", "0")
+    found_off, row_off = _analyze("vt_chaos_off", code, tx_count=2)
+    assert row_off.get("merges", 0) == 0
+    assert found_on == found_off == {"106"}, (found_on, found_off)
+
+
+def test_engine_gate_declines_unsupported_consumers(monkeypatch):
+    svm = make_svm()
+    assert veritest.engine_for(svm, False, False) is not None
+    assert veritest.engine_for(svm, True, False) is None   # CREATE
+    assert veritest.engine_for(svm, False, True) is None   # track_gas
+    svm.requires_statespace = True
+    assert veritest.engine_for(svm, False, False) is None
+    svm.requires_statespace = False
+    monkeypatch.setenv("MYTHRIL_TPU_VERITEST", "0")
+    assert veritest.engine_for(svm, False, False) is None
+
+
+# ---------------------------------------------------------------------------
+# ledger conservation + fault seam
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_conservation_with_merge_transition(monkeypatch):
+    """The aggregate-only ``merge`` transition tally moves with the
+    tier while the solver-lane conservation invariant (every ledgered
+    lane decided exactly once) stays intact."""
+    import logging
+
+    import bench
+    from mythril_tpu.observability.ledger import get_ledger
+
+    logging.getLogger("mythril_tpu").setLevel(logging.CRITICAL)
+    monkeypatch.setenv("MYTHRIL_TPU_VERITEST", "1")
+    ledger = get_ledger()
+    before = ledger.snapshot()["transitions"].get("merge", 0)
+    found, row = _analyze(
+        "vt_ledger", bench.veritest_gauntlet_contract()
+    )
+    assert found == {"101"}
+    assert row.get("merges", 0) > 0
+    snap = ledger.snapshot()
+    assert snap["transitions"].get("merge", 0) > before
+    assert sum(snap["decided"].values()) == snap["lanes_total"]
+
+
+def test_subsume_ledger_transition_counts():
+    from mythril_tpu.observability.ledger import get_ledger
+
+    engine = make_engine()
+    a, b = _identical_twins()
+    x = symbol_factory.BitVecSym("vt_l", 256)
+    p = x < symbol_factory.BitVecVal(10, 256)
+    a.world_state.constraints.append(p)
+    b.world_state.constraints.append(p)
+    ledger = get_ledger()
+    before = ledger.snapshot()["transitions"].get("subsume", 0)
+    work_list = [a, b]
+    engine._subsume_pass(work_list)
+    assert len(work_list) == 1
+    snap = ledger.snapshot()
+    assert snap["transitions"].get("subsume", 0) == before + 1
+    assert sum(snap["decided"].values()) == snap["lanes_total"]
+
+
+def test_merge_abort_fault_seam_degrades_to_fork(monkeypatch):
+    """An armed merge_abort fault kills every join mid-commit: the
+    degraded path is plain forking — zero merges, abort counter moving,
+    findings identical to the unfaulted run."""
+    import logging
+
+    import bench
+    from mythril_tpu.resilience import faults
+
+    logging.getLogger("mythril_tpu").setLevel(logging.CRITICAL)
+    monkeypatch.setenv("MYTHRIL_TPU_VERITEST", "1")
+    code = bench.veritest_gauntlet_contract()
+    found_clean, row_clean = _analyze("vt_seam_clean", code)
+    assert row_clean.get("merges", 0) > 0
+    faults.reset_for_tests()
+    # aborted pairs stay in the work list and retry every round, so
+    # the seam needs enough shots to outlast the whole analysis
+    faults.get_fault_plane().arm("merge_abort", times=10**6)
+    try:
+        found_faulted, row_faulted = _analyze("vt_seam_faulted", code)
+    finally:
+        faults.reset_for_tests()
+    assert row_faulted.get("merges", 0) == 0
+    assert row_faulted.get("merge_aborts", 0) > 0
+    assert found_faulted == found_clean == {"101"}
