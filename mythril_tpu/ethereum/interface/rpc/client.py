@@ -107,6 +107,47 @@ def validate_hex_result(result, what: str = "result",
     return result
 
 
+def validate_block_result(result, what: str = "eth_getBlockByNumber"):
+    """Shape-check one block object (``None`` passes through — the node
+    does not know the block yet, which is a normal answer near the
+    head, not provider garbage).  A block the watch follower can use
+    must carry hex ``number``/``hash``/``parentHash`` and a list of
+    transactions; anything else raises :class:`BadResponseError` and,
+    in a pool, strikes the provider's breaker."""
+    if result is None:
+        return None
+    if not isinstance(result, dict):
+        raise BadResponseError(
+            f"{what}: expected block object or null, got {result!r:.80}"
+        )
+    for field in ("number", "hash", "parentHash"):
+        validate_hex_result(result.get(field), what=f"{what}.{field}")
+    if not isinstance(result.get("transactions"), list):
+        raise BadResponseError(
+            f"{what}.transactions: expected list, got "
+            f"{result.get('transactions')!r:.80}"
+        )
+    return result
+
+
+def validate_receipt_result(result, what: str = "eth_getTransactionReceipt"):
+    """Shape-check one receipt object (``None`` passes through — an
+    unknown/pending tx hash).  Only the fields the deployment
+    extractor reads are pinned: ``contractAddress`` must be hex when
+    present (a CREATE/CREATE2 deployment), and the object itself must
+    be a dict."""
+    if result is None:
+        return None
+    if not isinstance(result, dict):
+        raise BadResponseError(
+            f"{what}: expected receipt object or null, got {result!r:.80}"
+        )
+    address = result.get("contractAddress")
+    if address is not None:
+        validate_hex_result(address, what=f"{what}.contractAddress")
+    return result
+
+
 class BaseClient:
     def eth_getCode(self, address: str, default_block: str = "latest") -> str:
         # not byte_aligned: real nodes answer "0x0" for empty code, and
@@ -128,11 +169,26 @@ class BaseClient:
     def eth_getBalance(self, address: str, block: str = "latest") -> int:
         return int(self._call("eth_getBalance", [address, block]), 16)
 
-    def eth_getBlockByNumber(self, block: str, full: bool = True):
-        return self._call("eth_getBlockByNumber", [block, full])
+    def eth_blockNumber(self) -> int:
+        """Current head height as an int (the watch follower's poll)."""
+        return int(validate_hex_result(
+            self._call("eth_blockNumber"), what="eth_blockNumber",
+        ), 16)
+
+    def eth_getBlockByNumber(self, block, full: bool = True):
+        """Block object (validated shape) or ``None`` for an unknown
+        height.  ``block`` may be an int height, a hex string, or a
+        tag like ``"latest"``."""
+        if isinstance(block, int):
+            block = hex(block)
+        return validate_block_result(
+            self._call("eth_getBlockByNumber", [block, full])
+        )
 
     def eth_getTransactionReceipt(self, tx_hash: str):
-        return self._call("eth_getTransactionReceipt", [tx_hash])
+        return validate_receipt_result(
+            self._call("eth_getTransactionReceipt", [tx_hash])
+        )
 
     def _call(self, method: str, params: Optional[List[Any]] = None):
         raise NotImplementedError

@@ -40,6 +40,7 @@ COMMAND_LIST = (
         "serve",
         "worker",
         "top",
+        "watch",
         "list-detectors",
         "read-storage",
         "leveldb-search",
@@ -526,6 +527,105 @@ def create_top_parser(parser: argparse.ArgumentParser) -> None:
     )
 
 
+def create_watch_parser(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--rpc",
+        help="comma-separated RPC providers (URL or HOST[:PORT] each; "
+        "env: MYTHRIL_TPU_RPC_PROVIDERS) the chain follower polls",
+        metavar="SPEC",
+    )
+    parser.add_argument(
+        "--serve",
+        help="base URL of a running `myth serve` daemon to stream "
+        "deployments into (default: an in-process engine)",
+        metavar="URL",
+    )
+    parser.add_argument(
+        "--from-block",
+        type=int,
+        default=None,
+        help="backfill start height (env: "
+        "MYTHRIL_TPU_WATCH_FROM_BLOCK; default 0)",
+        metavar="N",
+    )
+    parser.add_argument(
+        "--until-block",
+        type=int,
+        default=None,
+        help="stop once the cursor reaches N and the backlog is "
+        "empty (default: follow forever until drained)",
+        metavar="N",
+    )
+    parser.add_argument(
+        "--confirmations",
+        type=int,
+        default=None,
+        help="confirmation-depth lag behind the head (env: "
+        "MYTHRIL_TPU_WATCH_CONFIRMATIONS; default 2)",
+        metavar="N",
+    )
+    parser.add_argument(
+        "--poll-s",
+        type=float,
+        default=None,
+        help="head poll period in seconds when caught up (env: "
+        "MYTHRIL_TPU_WATCH_POLL_S; default 2.0)",
+        metavar="S",
+    )
+    parser.add_argument(
+        "--journal",
+        help="fsynced cursor journal; with --resume a SIGKILLed "
+        "watcher continues losing no block and re-analyzing nothing",
+        metavar="FILE",
+    )
+    parser.add_argument(
+        "--resume",
+        action="store_true",
+        help="replay --journal before following (cursor, seen "
+        "digests, pending submissions)",
+    )
+    parser.add_argument(
+        "--findings-out",
+        help="JSONL findings sink: one row per submission outcome "
+        "(analyzed / cached / duplicate / error)",
+        metavar="FILE",
+    )
+    parser.add_argument(
+        "--tx-count",
+        type=int,
+        default=2,
+        help="transaction depth per analysis",
+        metavar="N",
+    )
+    parser.add_argument(
+        "--deadline-s",
+        type=float,
+        default=None,
+        help="per-analysis wall-clock budget (default: the serve "
+        "plane's default deadline)",
+        metavar="S",
+    )
+    parser.add_argument(
+        "--trace-out",
+        help="Write the watcher's Perfetto span timeline here on exit "
+        "(watch.poll/block/extract/submit spans)",
+        metavar="FILE",
+    )
+    parser.add_argument(
+        "--metrics-out",
+        help="Dump the metrics registry (mythril_tpu_watch_*) to FILE "
+        "on exit",
+        metavar="FILE",
+    )
+    parser.add_argument(
+        "--persist-dir",
+        help="directory for the persistent knowledge store: the "
+        "report cache that makes re-submissions answer cached "
+        "(env: MYTHRIL_TPU_PERSIST_DIR)",
+        metavar="DIR",
+    )
+
+
 def create_disassemble_parser(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
         "solidity_files",
@@ -668,6 +768,15 @@ def main() -> None:
         formatter_class=argparse.ArgumentDefaultsHelpFormatter,
     )
     create_top_parser(top_parser)
+    watch_parser = subparsers.add_parser(
+        "watch",
+        help="Follow new blocks on a live chain and stream every "
+        "newly deployed contract through the serve fabric: "
+        "reorg-tolerant cursor, clone/proxy dedup, backpressure "
+        "backlog (docs/watch.md)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    create_watch_parser(watch_parser)
     subparsers.add_parser("version", parents=[output_parser], help="Outputs the version")
     pro_parser = subparsers.add_parser(
         "pro",
@@ -1022,7 +1131,7 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
             sys.exit(2)
 
     if args.command in ANALYZE_LIST or args.command in (
-        "truffle", "serve",
+        "truffle", "serve", "watch",
     ):
         # graceful drain: SIGTERM/SIGINT walk the cooperative
         # cancellation checkpoints, land a final journal generation,
@@ -1063,6 +1172,25 @@ def parse_args_and_execute(parser: argparse.ArgumentParser, args: argparse.Names
             print(f"cannot bind {args.host}:{args.port}: {e}",
                   file=sys.stderr)
             sys.exit(1)
+
+    if args.command == "watch":
+        # live-chain ingestion (mythril_tpu/watch): typed provider
+        # exhaustion and serve-config typos die as one-line structured
+        # exit-2s, the same contract as the serve/sweep commands
+        from mythril_tpu.exceptions import ProviderExhaustedError
+        from mythril_tpu.serve import ServeConfigError
+        from mythril_tpu.watch import run_watch
+
+        try:
+            sys.exit(run_watch(args))
+        except ProviderExhaustedError as e:
+            print(json.dumps({"error": {
+                "code": e.code, "message": str(e),
+            }}), file=sys.stderr)
+            sys.exit(2)
+        except ServeConfigError as e:
+            print(f"bad serve config: {e}", file=sys.stderr)
+            sys.exit(2)
 
     if args.command == "worker":
         # a worker seat must never recursively spawn its own fleet

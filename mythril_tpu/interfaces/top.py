@@ -184,6 +184,36 @@ def _render_fabric(fleet_body: Optional[dict], out) -> None:
         ), file=out)
 
 
+def _render_watch(watch_body: Optional[dict], out) -> None:
+    """The live-chain ingestion panel: cursor/head/lag, exactly-once
+    accounting, backlog depth, serve-side dedup attribution.  Absent
+    endpoint (older server) or no watcher (inactive, no snapshot
+    pushed) drops the panel entirely."""
+    if not watch_body:
+        return
+    watch = watch_body.get("watch") or {}
+    if not watch.get("active") and not watch.get("blocks_seen"):
+        return
+    state = "following" if watch.get("active") else "stopped"
+    print(f"  watch: {state}  cursor={watch.get('cursor')} "
+          f"head={watch.get('head')} "
+          f"lag={watch.get('lag_blocks')} "
+          f"(+{watch.get('confirmations', 0)} conf)  "
+          f"reorgs={watch.get('reorgs', 0)}", file=out)
+    print(f"    deployments={watch.get('deployments', 0)} "
+          f"unique={watch.get('unique_submitted', 0)} "
+          f"dedup-hits={watch.get('dedup_hits', 0)}  "
+          f"analyzed={watch.get('analyzed', 0)} "
+          f"cached={watch.get('cached', 0)} "
+          f"errors={watch.get('errors', 0)}  "
+          f"backlog={watch.get('backlog_depth', 0)}", file=out)
+    cache_hits = watch_body.get("serve_cache_hits")
+    spent = watch_body.get("watch_tenant_spent_s")
+    if cache_hits or spent:
+        print(f"    serve side: cache-hits={cache_hits or 0} "
+              f"tenant-spend={spent or 0}s", file=out)
+
+
 def _render_fleet(requests: dict, out) -> None:
     print(f"  coordinator trace: {requests.get('trace_id')}", file=out)
     for lease in requests.get("leases", []):
@@ -211,6 +241,7 @@ def render_once(url: str, out=None) -> bool:
     pilot = _get_json(base + "/debug/autopilot")
     ready = _get_json(base + "/readyz")
     fleet_body = _get_json(base + "/debug/fleet")
+    watch_body = _get_json(base + "/debug/watch")
     print(f"myth top — {base}  "
           f"({time.strftime('%H:%M:%S')})", file=out)
     if requests is None and lanes is None:
@@ -223,6 +254,7 @@ def render_once(url: str, out=None) -> bool:
     else:
         _render_serve(ready, requests, out)
         _render_fabric(fleet_body, out)
+        _render_watch(watch_body, out)
     _render_lanes(lanes, out)
     _render_autopilot(pilot, out)
     return True

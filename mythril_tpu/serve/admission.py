@@ -165,6 +165,10 @@ class AdmissionQueue:
             "mythril_tpu_serve_admitted_total",
             "requests admitted to the analysis queue",
         )
+        self._m_cache_hits = registry.counter(
+            "mythril_tpu_serve_cache_hits",
+            "requests answered from the admission-edge report cache",
+        )
         self._shed = {
             reason: registry.counter(
                 f"mythril_tpu_serve_shed_{reason}_total",
@@ -279,6 +283,14 @@ class AdmissionQueue:
         body = dict(body)
         body["cached"] = True
         body["analysis_s"] = 0.0
+        # a cache hit is still a served request: echo the caller's
+        # trace_id (or mint one) so dedup is attributable in traces —
+        # stored bodies predate the engine's trace stamp, so this is
+        # set unconditionally, never inherited from the stored row
+        from mythril_tpu.observability import new_trace_id
+
+        body["trace_id"] = request.trace_id or new_trace_id()
+        self._m_cache_hits.inc()
         return body
 
     def submit(self, request: AnalyzeRequest) -> Ticket:

@@ -35,6 +35,13 @@ Endpoints::
                      (serve/fabric.py) plus per-tenant rolling quota
                      consumption — null fabric when --fleet-listen is
                      not configured
+    GET  /debug/watch
+                     live-chain ingestion status: the in-process
+                     watcher (or the last snapshot a `myth watch
+                     --serve` tenant POSTed here) plus the serve-side
+                     dedup attribution (report-cache hits, the watch
+                     tenant's quota spend) — what the `myth top` watch
+                     panel renders
 
 Shutdown: SIGTERM/SIGINT ride the resilience plane's cooperative drain
 (``install_signal_handlers``).  The serve loop notices, closes
@@ -138,6 +145,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "tenants": self._srv.queue.tenant_usage(),
                 "tenant_quota_s": self._srv.config.tenant_quota_s,
             })
+        elif path == "/debug/watch":
+            self._send_json(200, self._srv.watch_body())
         else:
             self._send_json(404, {"error": {
                 "code": "not_found", "message": f"no route {path!r}",
@@ -146,7 +155,25 @@ class _Handler(BaseHTTPRequestHandler):
     # -- POST -----------------------------------------------------------
 
     def do_POST(self) -> None:
-        if self.path.split("?", 1)[0] != "/analyze":
+        path = self.path.split("?", 1)[0]
+        if path == "/debug/watch":
+            # a `myth watch --serve URL` tenant pushes its status
+            # snapshot here so the daemon's debug surface (and the
+            # `myth top` watch panel) can show the follower's state
+            try:
+                body = json.loads(self._read_body().decode("utf-8"))
+                if not isinstance(body, dict):
+                    raise ValueError("snapshot must be a JSON object")
+            except (RequestError, ValueError,
+                    UnicodeDecodeError) as exc:
+                self._send_json(400, {"error": {
+                    "code": "bad_snapshot", "message": str(exc),
+                }})
+                return
+            self._srv.watch_snapshot = body
+            self._send_json(200, {"ok": True})
+            return
+        if path != "/analyze":
             self._send_json(404, {"error": {
                 "code": "not_found",
                 "message": f"no route {self.path!r}",
@@ -249,6 +276,9 @@ class AnalysisServer:
 
                 self.router = FleetRouter(config)
                 self.engine.router = self.router
+        #: latest status snapshot a `myth watch --serve` tenant pushed
+        #: (POST /debug/watch); an in-process watcher wins over it
+        self.watch_snapshot = None
         self.started_at = time.time()
         self._httpd = ThreadingHTTPServer(
             (config.host, config.port), _Handler
@@ -295,6 +325,25 @@ class AnalysisServer:
                        if self.router is not None else None),
         }
         return ready, body
+
+    def watch_body(self) -> dict:
+        """The ``/debug/watch`` body: the live in-process watcher when
+        one runs here, else the last snapshot a ``--serve`` watch
+        tenant pushed, else inactive — plus the serve-side dedup
+        attribution (report-cache hits, the watch tenant's rolling
+        quota spend)."""
+        from mythril_tpu.watch import debug_status
+
+        watch = debug_status()
+        if not watch.get("active") and self.watch_snapshot is not None:
+            watch = self.watch_snapshot
+        return {
+            "watch": watch,
+            "serve_cache_hits": self.queue._m_cache_hits.value,
+            "watch_tenant_spent_s": self.queue.tenant_usage().get(
+                "watch", 0.0
+            ),
+        }
 
     # -- lifecycle ------------------------------------------------------
 

@@ -130,6 +130,13 @@ KNOWN_SPECS: Dict[str, Tuple[str, Optional[float], Optional[float]]] = {
     "MYTHRIL_TPU_RPC_POOL_ATTEMPTS": ("int", 1, None),
     "MYTHRIL_TPU_RPC_CACHE": ("flag", None, None),
     "MYTHRIL_TPU_RPC_CACHE_DIR": ("dir", None, None),
+    # live-chain ingestion (watch/): confirmation-depth lag behind the
+    # head, poll cadence, the bounded backpressure backlog, and the
+    # backfill start height (--from-block's env twin)
+    "MYTHRIL_TPU_WATCH_CONFIRMATIONS": ("int", 0, None),
+    "MYTHRIL_TPU_WATCH_POLL_S": ("float", 0.0, None),
+    "MYTHRIL_TPU_WATCH_BACKLOG": ("int", 1, None),
+    "MYTHRIL_TPU_WATCH_FROM_BLOCK": ("int", 0, None),
 }
 
 #: raw values :func:`env_flag` understands; anything else set on a

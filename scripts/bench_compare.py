@@ -73,7 +73,12 @@ GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
          # through the hardened loader (scripts/corpus_sweep.py) —
          # triage, governor polling, or salvage cost creeping into the
          # per-contract path shows up in the tail first
-         "corpus_p95_s")
+         "corpus_p95_s",
+         # live-chain ingestion: the watch cursor's end-of-run lag
+         # behind the mock-chain head (mythril_tpu/watch/) — a
+         # follower losing ground to its own deterministic chain means
+         # extraction or dispatch cost outgrew the block cadence
+         "watch_lag_blocks")
 #: gated metrics where LARGER is better (delta sign inverted):
 #: sustained warm-server throughput must not fall, the microbench
 #: device-vs-host ratio (both sides measured in the same run since the
@@ -105,10 +110,14 @@ GATED = ("t3_wall_s", "device_s", "checkpoint_overhead_s",
 #: the -t 4/5 deep-sequence rows — the merge heuristic declining
 #: diamonds it used to join (token drift, window/ite budget
 #: regressions) shows up here before the t45 walls move
+#: watch_cpm gates live-chain ingestion (mythril_tpu/watch/): unique
+#: contracts answered per minute following the deterministic mock
+#: chain end to end — extraction, dedup bookkeeping, or admission
+#: overhead creeping into the stream shows up here first
 GATED_HIGHER_BETTER = ("serve_cpm", "microbench_device_vs_host",
                        "fleet_speedup", "states_per_s", "fabric_cpm",
                        "warm_restart_speedup", "wild_survival_pct",
-                       "merges_per_1k_states")
+                       "merges_per_1k_states", "watch_cpm")
 #: floor below which a baseline is noise and ratios are meaningless
 MIN_BASE = 0.05
 

@@ -1359,6 +1359,207 @@ def wild_soak_main() -> int:
     return 0
 
 
+def watch_soak_main() -> int:
+    """The --watch driver: live-chain ingestion under abuse — a reorg
+    plus a provider flap mid-follow, SIGKILL mid-follow with a
+    ``--resume`` that must finish the chain, and a reorg landing right
+    at the head.  The bar is the exactly-once contract against the
+    mock chain's published ground truth (``GET /__expect``): every
+    unique runtime digest freshly analyzed at most once and answered
+    at least once — a re-submission after a crash answers from the
+    shared report cache (``cached: true`` is dedup, not a duplicate
+    analysis) — with zero watcher crashes and zero missed digests."""
+    failures = []
+
+    def check(scenario, ok, **detail):
+        row = {"scenario": scenario, "ok": bool(ok), **detail}
+        print(json.dumps(row))
+        if not ok:
+            failures.append(row)
+
+    scripts_dir = os.path.dirname(os.path.abspath(__file__))
+    myth = os.path.join(os.path.dirname(scripts_dir), "myth")
+
+    def start_chain(**kw):
+        cmd = [sys.executable,
+               os.path.join(scripts_dir, "mock_chain.py")]
+        for key, value in kw.items():
+            cmd += ["--" + key.replace("_", "-"), str(value)]
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, text=True)
+        info = json.loads(proc.stdout.readline())["mock_chain"]
+        return proc, info["url"]
+
+    def watch_cmd(url, workdir, until, resume=False):
+        cmd = [sys.executable, myth, "watch", "--rpc", url,
+               "--journal", os.path.join(workdir, "cursor.jsonl"),
+               "--findings-out", os.path.join(workdir, "findings.jsonl"),
+               "--until-block", str(until), "--poll-s", "0.05",
+               "--confirmations", "0", "--deadline-s", "2",
+               "--tx-count", "1"]
+        return cmd + (["--resume"] if resume else [])
+
+    def watch_env(workdir):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("MYTHRIL_TPU_FAULT", None)
+        env.pop("MYTHRIL_TPU_KILL_AT", None)
+        # the shared report cache is what turns a crash-window
+        # re-submission into a cached answer instead of a re-analysis
+        env["MYTHRIL_TPU_PERSIST_DIR"] = os.path.join(workdir, "persist")
+        env["MYTHRIL_TPU_PERSIST_FLUSH_S"] = "0"
+        return env
+
+    def summary_of(proc_result):
+        for line in reversed(proc_result.stdout.strip().splitlines()):
+            if line.startswith("{") and "watch_summary" in line:
+                return json.loads(line)["watch_summary"]
+        return {}
+
+    def findings_ledger(workdir):
+        """(fresh-analysis counts per digest, answered digests)."""
+        fresh, answered = {}, set()
+        try:
+            with open(os.path.join(workdir, "findings.jsonl")) as fh:
+                for line in fh:
+                    row = json.loads(line)
+                    if row.get("status") != "analyzed":
+                        continue
+                    answered.add(row["digest"])
+                    if not row.get("cached"):
+                        fresh[row["digest"]] = \
+                            fresh.get(row["digest"], 0) + 1
+        except OSError:
+            pass
+        return fresh, answered
+
+    def exactly_once(workdir, url):
+        _status, expect, _h = _http("GET", url + "/__expect", timeout=10)
+        expected = set((expect or {}).get("unique_digests") or ())
+        fresh, answered = findings_ledger(workdir)
+        doubled = sorted(d for d, n in fresh.items() if n > 1)
+        return (
+            expected == answered and not doubled and bool(expected),
+            {
+                "expected": len(expected),
+                "answered": len(answered),
+                "missed": len(expected - answered),
+                "invented": len(answered - expected),
+                "double_analyzed": len(doubled),
+            },
+        )
+
+    # -- scenario 1: reorg + provider flap mid-follow -----------------
+    workdir = tempfile.mkdtemp(prefix="mtpu-watch-")
+    chain, url = start_chain(blocks=40, deployments=80, reorg_at=20,
+                             reorg_depth=3, head_step=3,
+                             flap_at_head=27, flap_requests=4)
+    try:
+        done = subprocess.run(
+            watch_cmd(url, workdir, until=40), env=watch_env(workdir),
+            capture_output=True, text=True, timeout=420,
+        )
+        summary = summary_of(done)
+        once_ok, once = exactly_once(workdir, url)
+        check(
+            "reorg_and_flap_mid_follow_exactly_once",
+            done.returncode == 0 and once_ok
+            and summary.get("reorgs", 0) >= 1
+            and summary.get("dedup_hits", 0) > 0
+            and summary.get("errors") == 0,
+            exit=done.returncode, reorgs=summary.get("reorgs"),
+            dedup_hits=summary.get("dedup_hits"), **once,
+        )
+    except Exception as exc:  # noqa: BLE001 — a crashed scenario fails
+        check("reorg_and_flap_mid_follow_exactly_once", False,
+              error=f"{type(exc).__name__}: {exc}")
+    finally:
+        chain.kill()
+        chain.wait(timeout=30)
+
+    # -- scenario 2: SIGKILL mid-follow, --resume finishes the chain --
+    workdir = tempfile.mkdtemp(prefix="mtpu-watch-")
+    journal = os.path.join(workdir, "cursor.jsonl")
+    chain, url = start_chain(blocks=60, deployments=120, reorg_at=30,
+                             reorg_depth=3, head_step=3)
+    try:
+        victim = subprocess.Popen(
+            watch_cmd(url, workdir, until=60), env=watch_env(workdir),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        deadline = time.time() + 300
+        journaled = 0
+        while time.time() < deadline and victim.poll() is None:
+            try:
+                with open(journal) as fh:
+                    journaled = sum(1 for line in fh
+                                    if '"block"' in line)
+            except OSError:
+                journaled = 0
+            if journaled >= 8:
+                break
+            time.sleep(0.1)
+        killed = victim.poll() is None and journaled >= 8
+        if killed:
+            victim.kill()
+        victim.wait(timeout=30)
+        check("sigkill_mid_follow_landed", killed, journaled=journaled)
+
+        resumed = subprocess.run(
+            watch_cmd(url, workdir, until=60, resume=True),
+            env=watch_env(workdir), capture_output=True, text=True,
+            timeout=420,
+        )
+        summary = summary_of(resumed)
+        once_ok, once = exactly_once(workdir, url)
+        check(
+            "resume_after_sigkill_exactly_once",
+            resumed.returncode == 0 and once_ok
+            and summary.get("cursor") == 60
+            and summary.get("reorgs", 0) >= 1
+            and summary.get("dedup_hits", 0) > 0,
+            exit=resumed.returncode, cursor=summary.get("cursor"),
+            reorgs=summary.get("reorgs"),
+            dedup_hits=summary.get("dedup_hits"), **once,
+        )
+    except Exception as exc:  # noqa: BLE001
+        check("resume_after_sigkill_exactly_once", False,
+              error=f"{type(exc).__name__}: {exc}")
+    finally:
+        chain.kill()
+        chain.wait(timeout=30)
+
+    # -- scenario 3: reorg landing at the head ------------------------
+    workdir = tempfile.mkdtemp(prefix="mtpu-watch-")
+    chain, url = start_chain(blocks=30, deployments=60, reorg_at=28,
+                             reorg_depth=3, head_step=3)
+    try:
+        done = subprocess.run(
+            watch_cmd(url, workdir, until=30), env=watch_env(workdir),
+            capture_output=True, text=True, timeout=420,
+        )
+        summary = summary_of(done)
+        once_ok, once = exactly_once(workdir, url)
+        check(
+            "reorg_at_head_exactly_once",
+            done.returncode == 0 and once_ok
+            and summary.get("reorgs", 0) >= 1
+            and summary.get("errors") == 0,
+            exit=done.returncode, reorgs=summary.get("reorgs"), **once,
+        )
+    except Exception as exc:  # noqa: BLE001
+        check("reorg_at_head_exactly_once", False,
+              error=f"{type(exc).__name__}: {exc}")
+    finally:
+        chain.kill()
+        chain.wait(timeout=30)
+
+    if failures:
+        print(json.dumps({"watch_soak_failures": failures}))
+        return 1
+    print(json.dumps({"watch_soak_ok": True, "scenarios": 4}))
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--rounds", type=int, default=6)
@@ -1398,6 +1599,12 @@ def main() -> int:
                         "resume, governor breach => partial verdict "
                         "whose findings are a subset of the unbudgeted "
                         "run")
+    parser.add_argument("--watch", action="store_true",
+                        help="soak live-chain ingestion: reorg + "
+                        "provider flap mid-follow, SIGKILL mid-follow "
+                        "+ --resume to completion, and a reorg at the "
+                        "head — exactly-once asserted against the mock "
+                        "chain's ground truth everywhere")
     parser.add_argument("--kr-child", action="store_true",
                         help=argparse.SUPPRESS)
     parser.add_argument("--kr-dir", default=None, help=argparse.SUPPRESS)
@@ -1418,6 +1625,8 @@ def main() -> int:
         return persist_soak_main()
     if args_ns.wild:
         return wild_soak_main()
+    if args_ns.watch:
+        return watch_soak_main()
     rng = random.Random(args_ns.seed)
 
     import logging

@@ -1,0 +1,436 @@
+#!/usr/bin/env python
+"""Deterministic mock chain for the `myth watch` ingestion pipeline.
+
+One seeded :class:`MockChain` produces everything a live-chain
+follower has to survive, with no network and no randomness at
+replay time:
+
+- **blocks** — hash-linked headers 0..N with tx-hash lists, grown a
+  few heights per ``eth_blockNumber`` poll so a follower actually
+  follows instead of slurping a static range;
+- **deployments** — CREATE receipts carrying ``contractAddress``:
+  fresh implementations (unique runtime bytecode), EIP-1167 minimal
+  proxies onto earlier implementations, and factory re-deployments of
+  byte-identical code (the dedup workload), plus plain transfers and
+  one reverted CREATE that must be skipped;
+- **a reorg** — an alternate branch diverging ``--reorg-depth`` blocks
+  below ``--reorg-at``; once the visible head passes the trigger the
+  canonical answers switch branch, exactly like a node that just
+  reorged.  The replacement blocks carry the SAME deployments (plus
+  one branch-only extra), so a correct follower rewinds and loses
+  nothing while double-analyzing nothing;
+- **provider flaps** — :meth:`MockChainClient.fail_next` injects
+  connection drops for pool-rotation tests, and the HTTP server
+  variant answers a scripted burst of 503s once the head passes
+  ``--flap-at-head``.
+
+Three faces over the same state: :class:`MockChain` (the model),
+:class:`MockChainClient` (an in-process ``BaseClient`` for tests and
+the bench microbench), and the ``__main__`` JSON-RPC HTTP server
+(for chaos soaks that SIGKILL the watcher while the chain keeps
+going).  ``GET /__expect`` on the server — and
+:meth:`MockChain.expected_unique_digests` in-process — publish the
+ground truth the exactly-once proof is checked against.
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from mythril_tpu.ethereum.interface.rpc.client import (  # noqa: E402
+    BadResponseError, BaseClient, ConnectionError_,
+)
+
+#: EIP-1167 minimal-proxy runtime = PRE + 20-byte target + POST
+#: (the same constants disassembler/triage.py recognizes)
+_EIP1167_PRE = "363d3d373d3d3d363d73"
+_EIP1167_POST = "5af43d82803e903d91602b57fd5bf3"
+
+_ZERO_HASH = "0x" + "0" * 64
+
+
+def _hex32(*parts) -> str:
+    return "0x" + hashlib.sha256(
+        ":".join(str(p) for p in parts).encode()
+    ).hexdigest()
+
+
+def _address(*parts) -> str:
+    return "0x" + hashlib.sha256(
+        ("addr:" + ":".join(str(p) for p in parts)).encode()
+    ).hexdigest()[:40]
+
+
+def _impl_runtime(index: int) -> str:
+    """Unique tiny runtime per implementation index: PUSH1 a PUSH1 b
+    ADD PUSH1 0 SSTORE STOP — valid EVM, distinct bytes, instant to
+    analyze."""
+    a, b = index % 256, (index // 256) % 256
+    return "0x60%02x60%02x0160005500" % (a, b)
+
+
+def _clone_runtime(impl_address: str) -> str:
+    return "0x" + _EIP1167_PRE + impl_address[2:].lower() + _EIP1167_POST
+
+
+class _Deployment:
+    __slots__ = ("tx_hash", "address", "code", "kind", "impl_index",
+                 "height")
+
+    def __init__(self, tx_hash, address, code, kind, impl_index, height):
+        self.tx_hash = tx_hash
+        self.address = address
+        self.code = code
+        self.kind = kind            # impl | clone | dup | failed
+        self.impl_index = impl_index
+        self.height = height
+
+
+class MockChain:
+    """The seeded two-branch chain model.  Thread-safe: the HTTP
+    server face answers from handler threads."""
+
+    def __init__(self, seed: int = 0, blocks: int = 60,
+                 deployments: int = 120, reorg_at: Optional[int] = None,
+                 reorg_depth: int = 3, head_start: int = 1,
+                 head_step: int = 3):
+        if blocks < 2:
+            raise ValueError("MockChain needs at least 2 blocks")
+        self.seed = seed
+        self.blocks = blocks
+        self.reorg_at = reorg_at
+        self.reorg_depth = reorg_depth
+        self.fork = None
+        if reorg_at is not None:
+            if not (0 < reorg_at - reorg_depth < reorg_at <= blocks):
+                raise ValueError(
+                    f"reorg_at={reorg_at} / depth={reorg_depth} do not "
+                    f"fit a {blocks}-block chain"
+                )
+            self.fork = reorg_at - reorg_depth
+        self._lock = threading.Lock()
+        self._head = max(0, min(head_start, blocks))
+        self._head_step = max(1, head_step)
+        self.switched = False     # canonical flipped to branch B
+        self._build_deployments(deployments)
+        self._build_branches()
+
+    # -- construction ---------------------------------------------------
+
+    def _build_deployments(self, count: int) -> None:
+        """The deployment plan: ~40% fresh implementations, ~30%
+        EIP-1167 clones of earlier impls, ~30% byte-identical factory
+        re-deployments — the clone/dup majority is the dedup workload.
+        Assignment to heights is round-robin over blocks 1..N."""
+        import random as _random
+
+        rnd = _random.Random(self.seed)
+        self.plan: List[_Deployment] = []
+        impl_indices: List[int] = []
+        for i in range(count):
+            height = 1 + (i * (self.blocks - 1)) // max(1, count)
+            tx_hash = _hex32(self.seed, "tx", i)
+            address = _address(self.seed, i)
+            wheel = i % 10
+            if wheel < 4 or not impl_indices:
+                impl_indices.append(i)
+                dep = _Deployment(tx_hash, address, _impl_runtime(i),
+                                  "impl", i, height)
+            elif wheel < 7:
+                target = rnd.choice(impl_indices)
+                target_dep = next(d for d in self.plan
+                                  if d.impl_index == target
+                                  and d.kind == "impl")
+                dep = _Deployment(
+                    tx_hash, address,
+                    _clone_runtime(target_dep.address),
+                    "clone", target, height,
+                )
+            else:
+                target = rnd.choice(impl_indices)
+                dep = _Deployment(tx_hash, address,
+                                  _impl_runtime(target), "dup",
+                                  target, height)
+            self.plan.append(dep)
+        # one reverted CREATE (status 0x0): carries a contractAddress
+        # but deployed nothing — the extractor must skip it
+        self.failed_create = _Deployment(
+            _hex32(self.seed, "tx", "failed"),
+            _address(self.seed, "failed"), "0x", "failed", -1, 1,
+        )
+        # the branch-B-only extra implementation: a deployment the
+        # reorg INTRODUCES, proving the rewind re-reads replaced blocks
+        self.reorg_extra = None
+        if self.fork is not None:
+            self.reorg_extra = _Deployment(
+                _hex32(self.seed, "tx", "reorg-extra"),
+                _address(self.seed, "reorg-extra"),
+                _impl_runtime(100000 + self.seed), "impl",
+                100000 + self.seed, self.fork + 1,
+            )
+        self._receipts: Dict[str, dict] = {}
+        self._code: Dict[str, str] = {}
+        for dep in self.plan + [self.failed_create] + (
+            [self.reorg_extra] if self.reorg_extra else []
+        ):
+            status = "0x0" if dep.kind == "failed" else "0x1"
+            self._receipts[dep.tx_hash] = {
+                "transactionHash": dep.tx_hash,
+                "blockNumber": hex(dep.height),
+                "contractAddress": dep.address,
+                "status": status,
+            }
+            self._code[dep.address.lower()] = dep.code
+        # plain transfers: receipts with no contractAddress
+        for h in range(1, self.blocks + 1, 5):
+            tx_hash = _hex32(self.seed, "transfer", h)
+            self._receipts[tx_hash] = {
+                "transactionHash": tx_hash,
+                "blockNumber": hex(h),
+                "contractAddress": None,
+                "status": "0x1",
+            }
+
+    def _txs_at(self, height: int, branch: str) -> List[str]:
+        txs = [d.tx_hash for d in self.plan if d.height == height]
+        if height == 1:
+            txs.append(self.failed_create.tx_hash)
+        if height % 5 == 1:
+            txs.append(_hex32(self.seed, "transfer", height))
+        if (branch == "B" and self.reorg_extra is not None
+                and height == self.reorg_extra.height):
+            txs.append(self.reorg_extra.tx_hash)
+        return txs
+
+    def _build_branches(self) -> None:
+        def build(branch: str, start: int, parent: str) -> Dict[int, dict]:
+            out = {}
+            for h in range(start, self.blocks + 1):
+                block_hash = _hex32(self.seed, branch, h)
+                out[h] = {
+                    "number": hex(h),
+                    "hash": block_hash,
+                    "parentHash": parent,
+                    "transactions": self._txs_at(h, branch),
+                }
+                parent = block_hash
+            return out
+
+        self._branch_a = build("A", 0, _ZERO_HASH)
+        self._branch_b = {}
+        if self.fork is not None:
+            self._branch_b = build(
+                "B", self.fork + 1, self._branch_a[self.fork]["hash"]
+            )
+
+    # -- the node's answers ---------------------------------------------
+
+    def head(self) -> int:
+        """Current visible head; each poll advances it (bounded by the
+        chain length) and the first poll past ``reorg_at`` flips the
+        canonical branch — the reorg happens *between* polls, as on a
+        real node."""
+        with self._lock:
+            head = self._head
+            self._head = min(self.blocks, self._head + self._head_step)
+            if (self.fork is not None and not self.switched
+                    and head > self.reorg_at):
+                self.switched = True
+            return head
+
+    def peek_head(self) -> int:
+        with self._lock:
+            return self._head
+
+    def block(self, height: int) -> Optional[dict]:
+        with self._lock:
+            if height > self._head or height < 0:
+                return None
+            if self.switched and height > self.fork:
+                return self._branch_b.get(height)
+            return self._branch_a.get(height)
+
+    def receipt(self, tx_hash: str) -> Optional[dict]:
+        return self._receipts.get(tx_hash)
+
+    def code(self, address: str) -> str:
+        return self._code.get(address.lower(), "0x")
+
+    # -- ground truth ----------------------------------------------------
+
+    def expected_unique_digests(self) -> Set[str]:
+        """Digests of every unique runtime an exactly-once follower
+        must analyze on the FINAL canonical branch: clones collapse
+        onto their implementation, dups collapse byte-identically, the
+        reverted CREATE contributes nothing, and the branch-B extra
+        counts only when a reorg is configured."""
+        from mythril_tpu.persist.plane import code_digest
+
+        digests = {
+            code_digest(_impl_runtime(d.impl_index))
+            for d in self.plan
+        }
+        if self.reorg_extra is not None:
+            digests.add(code_digest(self.reorg_extra.code))
+        return digests
+
+    def expectations(self) -> dict:
+        return {
+            "blocks": self.blocks,
+            "deployments": len(self.plan),
+            "unique_digests": sorted(self.expected_unique_digests()),
+            "reorg_at": self.reorg_at,
+            "fork": self.fork,
+        }
+
+
+class MockChainClient(BaseClient):
+    """In-process ``BaseClient`` over a shared :class:`MockChain` —
+    what tests and the bench microbench put inside a ``ProviderPool``.
+    ``fail_next(n)`` drops the next n calls (provider-flap tests)."""
+
+    def __init__(self, chain: MockChain, name: str = "mock"):
+        self.chain = chain
+        self.url = f"mock://{name}"
+        self._fail = 0
+        self.calls = 0
+
+    def fail_next(self, n: int) -> None:
+        self._fail += n
+
+    def _call(self, method, params=None):
+        self.calls += 1
+        if self._fail > 0:
+            self._fail -= 1
+            raise ConnectionError_("mock: injected connection drop")
+        params = params or []
+        if method == "eth_blockNumber":
+            return hex(self.chain.head())
+        if method == "eth_getBlockByNumber":
+            tag = params[0]
+            if tag in ("latest", "pending"):
+                height = self.chain.peek_head()
+            else:
+                height = int(tag, 16)
+            return self.chain.block(height)
+        if method == "eth_getTransactionReceipt":
+            return self.chain.receipt(params[0])
+        if method == "eth_getCode":
+            return self.chain.code(params[0])
+        raise BadResponseError(f"mock chain: unsupported {method}")
+
+
+# ---------------------------------------------------------------------------
+# HTTP face: a real JSON-RPC server over the same model, for soaks
+# that SIGKILL the watcher while the chain must keep its state
+# ---------------------------------------------------------------------------
+
+
+def make_server(chain: MockChain, port: int = 0,
+                flap_at_head: Optional[int] = None,
+                flap_requests: int = 0):
+    """A ``ThreadingHTTPServer`` speaking the four methods the watch
+    pipeline uses.  Once the visible head passes ``flap_at_head`` the
+    next ``flap_requests`` POSTs answer 503 (one scripted provider
+    flap), then service resumes."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    state = {"flap_armed": flap_at_head is not None, "flap_left": 0}
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # noqa: A002 — stdlib name
+            pass
+
+        def _json(self, status, body):
+            payload = json.dumps(body).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def do_GET(self):
+            if self.path.split("?", 1)[0] == "/__expect":
+                self._json(200, chain.expectations())
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if state["flap_armed"] and chain.peek_head() >= flap_at_head:
+                state["flap_armed"] = False
+                state["flap_left"] = flap_requests
+            if state["flap_left"] > 0:
+                state["flap_left"] -= 1
+                self._json(503, {"error": "mock flap"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            req = {}
+            try:
+                req = json.loads(self.rfile.read(length))
+                method = req.get("method")
+                params = req.get("params") or []
+                shim = MockChainClient(chain)
+                result = shim._call(method, params)
+            except Exception as exc:  # noqa: BLE001 — mock never dies
+                self._json(200, {"jsonrpc": "2.0", "id": req.get("id"),
+                                 "error": {"code": -32000,
+                                           "message": str(exc)}})
+                return
+            self._json(200, {"jsonrpc": "2.0", "id": req.get("id"),
+                             "result": result})
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    httpd.daemon_threads = True
+    return httpd
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--blocks", type=int, default=60)
+    ap.add_argument("--deployments", type=int, default=120)
+    ap.add_argument("--reorg-at", type=int, default=None)
+    ap.add_argument("--reorg-depth", type=int, default=3)
+    ap.add_argument("--head-start", type=int, default=1)
+    ap.add_argument("--head-step", type=int, default=3)
+    ap.add_argument("--flap-at-head", type=int, default=None)
+    ap.add_argument("--flap-requests", type=int, default=0)
+    ap.add_argument("--port", type=int, default=0)
+    opts = ap.parse_args(argv)
+
+    chain = MockChain(
+        seed=opts.seed, blocks=opts.blocks,
+        deployments=opts.deployments, reorg_at=opts.reorg_at,
+        reorg_depth=opts.reorg_depth, head_start=opts.head_start,
+        head_step=opts.head_step,
+    )
+    httpd = make_server(chain, port=opts.port,
+                        flap_at_head=opts.flap_at_head,
+                        flap_requests=opts.flap_requests)
+    port = httpd.server_address[1]
+    print(json.dumps({"mock_chain": {
+        "url": f"http://127.0.0.1:{port}",
+        "port": port,
+        "unique": len(chain.expected_unique_digests()),
+    }}), flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -222,3 +222,61 @@ def test_fault_plane_injects_transient_failures_without_a_network():
     with _transport(result="0xdef"):
         assert client.eth_getCode("0x" + "77" * 20) == "0xdef"
     assert resilience_stats.rpc_retries == 2
+
+
+# ---------------------------------------------------------------------------
+# the watch-pipeline trio: block heads, block bodies, receipts
+# ---------------------------------------------------------------------------
+
+
+def test_block_number_parses_hex_quantity():
+    client = EthJsonRpc()
+    with _transport(result="0x10") as captured:
+        assert client.eth_blockNumber() == 16
+    assert captured["payload"]["method"] == "eth_blockNumber"
+    with _transport(result={"not": "hex"}):
+        with pytest.raises(BadResponseError):
+            client.eth_blockNumber()
+
+
+def test_get_block_by_number_accepts_int_heights():
+    block = {
+        "number": "0x2", "hash": "0x" + "aa" * 32,
+        "parentHash": "0x" + "bb" * 32, "transactions": [],
+    }
+    client = EthJsonRpc()
+    with _transport(result=block) as captured:
+        assert client.eth_getBlockByNumber(2, False) == block
+    assert captured["payload"]["params"] == ["0x2", False]
+    with _transport(result=block) as captured:
+        client.eth_getBlockByNumber("latest")
+    assert captured["payload"]["params"] == ["latest", True]
+
+
+def test_block_and_receipt_validators_shape_check():
+    from mythril_tpu.ethereum.interface.rpc.client import (
+        validate_block_result, validate_receipt_result,
+    )
+
+    # None is the node's honest "don't know that yet" — passes through
+    assert validate_block_result(None) is None
+    assert validate_receipt_result(None) is None
+    good = {"number": "0x1", "hash": "0x" + "cc" * 32,
+            "parentHash": "0x" + "dd" * 32, "transactions": ["0xe1"]}
+    assert validate_block_result(good) is good
+    for broken in (
+        "0xdeadbeef",                       # not an object
+        {**good, "number": "latest"},       # non-hex height
+        {**good, "parentHash": None},       # missing chain link
+        {**good, "transactions": "0xe1"},   # txs must be a list
+    ):
+        with pytest.raises(BadResponseError):
+            validate_block_result(broken)
+    receipt = {"contractAddress": "0x" + "11" * 20, "status": "0x1"}
+    assert validate_receipt_result(receipt) is receipt
+    assert validate_receipt_result({"contractAddress": None})[
+        "contractAddress"] is None
+    with pytest.raises(BadResponseError):
+        validate_receipt_result(["not", "a", "receipt"])
+    with pytest.raises(BadResponseError):
+        validate_receipt_result({"contractAddress": "garbage"})

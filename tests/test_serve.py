@@ -679,3 +679,42 @@ def test_coalescer_cli_mode_reset_still_drops_everything():
     co.dispatched = 2
     coalesce.reset_coalescer()   # serve mode off: full reset
     assert not co.queue and co.dispatched == 0
+
+
+def test_report_cache_hit_mints_trace_and_counts(tmp_path, monkeypatch):
+    """An admission-edge cache hit is still a served request: it must
+    carry a trace_id (echoed when the caller sent one, minted when
+    not — stored bodies predate the engine's trace stamp) and count on
+    ``mythril_tpu_serve_cache_hits`` so watch-stream dedup is visible
+    from ``/debug/watch`` and ``myth top``."""
+    from mythril_tpu.observability import metrics as metrics_mod
+    from mythril_tpu.persist import plane as plane_mod
+
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_DIR", str(tmp_path))
+    monkeypatch.setenv("MYTHRIL_TPU_PERSIST_FLUSH_S", "0")
+    plane_mod.reset_for_tests()
+    metrics_mod.reset_for_tests()
+    try:
+        plane = plane_mod.get_knowledge_plane()
+        code = "6001600101"
+        plane.report_cache_put(
+            plane_mod.code_digest(code), 2, 128, None,
+            {"findings_swc": ["106"], "partial": False},
+        )
+        queue = AdmissionQueue(ServeConfig())
+        hit = queue.cached_response(AnalyzeRequest(code=code))
+        assert hit["cached"] is True and hit["findings_swc"] == ["106"]
+        assert hit["trace_id"], "cache hit minted no trace_id"
+        echoed = queue.cached_response(
+            AnalyzeRequest(code=code, trace_id="tr-echo")
+        )
+        assert echoed["trace_id"] == "tr-echo"
+        assert queue._m_cache_hits.value == 2
+        # a miss neither counts nor invents a body
+        assert queue.cached_response(
+            AnalyzeRequest(code="6002600201")
+        ) is None
+        assert queue._m_cache_hits.value == 2
+    finally:
+        plane_mod.reset_for_tests()
+        metrics_mod.reset_for_tests()

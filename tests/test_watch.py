@@ -1,0 +1,309 @@
+"""Live-chain ingestion tests over the deterministic mock chain.
+
+The exactly-once contract is the spine: every unique runtime digest on
+the FINAL canonical branch is analyzed exactly once — through a reorg
+rewind, a SIGKILL-equivalent resume, provider flaps, clone/proxy
+dedup, and admission backpressure.  ``scripts/mock_chain.py`` supplies
+the ground truth (:meth:`MockChain.expected_unique_digests`); a fake
+backend records what actually got submitted.  Everything here is
+tier-1: in-process, no network, no engine unless a test says so.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from mythril_tpu.ethereum.interface.rpc.client import ProviderPool
+from mythril_tpu.observability import metrics as metrics_mod
+from mythril_tpu.persist.plane import code_digest
+from mythril_tpu.watch import debug_status
+from mythril_tpu.watch.extract import Deployment
+from mythril_tpu.watch.follower import ChainFollower, CursorJournal
+from mythril_tpu.watch.stream import (
+    Backpressure, StreamDispatcher, WatchMetrics, WatchService,
+)
+
+pytestmark = pytest.mark.watch
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+
+from mock_chain import MockChain, MockChainClient  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    metrics_mod.reset_for_tests()
+    yield
+    metrics_mod.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# fakes
+# ---------------------------------------------------------------------------
+
+
+class _FakeBackend:
+    """Records every analyze; optionally sheds the first N calls so
+    backpressure paths run without a real admission queue."""
+
+    def __init__(self, sheds: int = 0):
+        self.sheds = sheds
+        self.requests = []
+        self.pushes = 0
+
+    def analyze(self, request):
+        if self.sheds > 0:
+            self.sheds -= 1
+            raise Backpressure(0.0)
+        self.requests.append(request)
+        return {
+            "request_id": f"r{len(self.requests)}",
+            "name": request.name,
+            "issues": [], "findings_swc": [],
+            "analysis_s": 0.001, "trace_id": f"t{len(self.requests)}",
+        }
+
+    def analyzed_digests(self):
+        return [code_digest(r.code) for r in self.requests]
+
+    def push_status(self, snapshot):
+        self.pushes += 1
+
+    def close(self):
+        pass
+
+
+def _dep(i: int, code: str = None) -> Deployment:
+    code = code or ("0x60%02x60%02x0160005500" % (i % 256, i // 256))
+    return Deployment(
+        address="0x%040x" % i, tx_hash="0x%064x" % i, block=i,
+        code=code, digest=code_digest(code),
+    )
+
+
+def _service(chain, backend, **kwargs):
+    pool = ProviderPool([MockChainClient(chain, "a"),
+                         MockChainClient(chain, "b")])
+    kwargs.setdefault("confirmations", 0)
+    kwargs.setdefault("poll_s", 0)
+    kwargs.setdefault("until_block", chain.blocks)
+    return pool, WatchService(pool, backend, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# the exactly-once spine
+# ---------------------------------------------------------------------------
+
+
+def test_reorg_rewinds_and_never_double_submits(tmp_path):
+    chain = MockChain(seed=1, blocks=60, deployments=120,
+                      reorg_at=30, reorg_depth=3, head_step=3)
+    backend = _FakeBackend()
+    _pool, service = _service(
+        chain, backend, journal_path=str(tmp_path / "cursor.jsonl"),
+        findings_out=str(tmp_path / "findings.jsonl"),
+    )
+    summary = service.run()
+
+    assert summary["reorgs"] == 1
+    assert summary["cursor"] == 60
+    assert summary["errors"] == 0
+    digests = backend.analyzed_digests()
+    # exactly once: no digest twice, none missed, none invented
+    assert len(digests) == len(set(digests))
+    assert set(digests) == chain.expected_unique_digests()
+    # the branch-B-only deployment proves the rewind re-read the
+    # replaced blocks instead of skipping over them
+    assert code_digest(chain.reorg_extra.code) in set(digests)
+    # clones + dups + reorg replays all landed as dedup hits
+    assert summary["dedup_hits"] > 0
+    assert summary["deployments"] == \
+        len(set(digests)) + summary["dedup_hits"]
+
+
+def test_resume_from_journal_loses_no_block(tmp_path):
+    """Stop at block 20, resume from the journal alone (fresh
+    follower, fresh backend): the union covers every unique digest and
+    the intersection is empty — a SIGKILL-equivalent handoff."""
+    journal = str(tmp_path / "cursor.jsonl")
+    chain = MockChain(seed=3, blocks=60, deployments=120,
+                      reorg_at=30, reorg_depth=3, head_step=3)
+    first = _FakeBackend()
+    _pool, service = _service(chain, first, journal_path=journal,
+                              until_block=20)
+    service.run()
+    assert 20 <= service.follower.cursor < 30  # stopped mid-chain
+
+    # a torn write the crash left behind must not poison the replay
+    with open(journal, "a", encoding="utf-8") as fh:
+        fh.write("this-is-not-json\n")
+
+    second = _FakeBackend()
+    _pool, resumed = _service(chain, second, journal_path=journal,
+                              resume=True)
+    summary = resumed.run()
+
+    assert resumed.follower.cursor == 60
+    assert summary["reorgs"] == 1  # the reorg fired in phase two
+    d1, d2 = set(first.analyzed_digests()), set(second.analyzed_digests())
+    assert not d1 & d2, "resume re-analyzed already-journaled digests"
+    assert d1 | d2 == chain.expected_unique_digests()
+
+
+def test_provider_flap_rotates_and_stays_exactly_once():
+    chain = MockChain(seed=5, blocks=40, deployments=80, head_step=4)
+    backend = _FakeBackend()
+    pool, service = _service(chain, backend)
+    pool.slots[0].client.fail_next(4)
+    summary = service.run()
+
+    assert set(backend.analyzed_digests()) == \
+        chain.expected_unique_digests()
+    assert summary["errors"] == 0
+    # the pool rotated onto the second provider instead of dying
+    assert pool.slots[1].client.calls > 0
+
+
+def test_clone_and_dup_dedup_with_findings_sink(tmp_path):
+    findings = str(tmp_path / "findings.jsonl")
+    chain = MockChain(seed=7, blocks=30, deployments=60, head_step=5)
+    backend = _FakeBackend()
+    _pool, service = _service(chain, backend, findings_out=findings)
+    summary = service.run()
+
+    rows = [json.loads(line)
+            for line in open(findings, encoding="utf-8")]
+    analyzed = [r for r in rows if r["status"] == "analyzed"]
+    duplicates = [r for r in rows if r["status"] == "duplicate"]
+    assert summary["dedup_hits"] == len(duplicates) > 0
+    assert {r["digest"] for r in analyzed} == \
+        chain.expected_unique_digests()
+    # at least one EIP-1167 clone resolved onto its implementation:
+    # either its first sighting carries proxy_target, or the impl was
+    # seen first and the clone became a duplicate row
+    assert any(r.get("proxy_target") for r in analyzed) or duplicates
+    # every analyzed row is attributable
+    assert all(r["trace_id"] for r in analyzed)
+
+
+# ---------------------------------------------------------------------------
+# backpressure backlog
+# ---------------------------------------------------------------------------
+
+
+def test_backlog_bounded_and_nothing_dropped(tmp_path):
+    journal = CursorJournal(str(tmp_path / "j.jsonl")).open()
+    backend = _FakeBackend(sheds=9)
+    metrics = WatchMetrics(metrics_mod.get_registry())
+    dispatcher = StreamDispatcher(backend, metrics, set(), journal,
+                                  backlog_cap=2)
+    deployments = [_dep(i) for i in range(5)]
+    for deployment in deployments:
+        dispatcher.submit(deployment)
+        assert len(dispatcher.backlog) <= 2  # the bound holds
+    dispatcher.drain(blocking=True)
+    journal.close()
+
+    assert not dispatcher.backlog
+    assert set(backend.analyzed_digests()) == \
+        {d.digest for d in deployments}
+    # every parked submission journaled pending, every retry that
+    # completed journaled done — the crash-safety pairing
+    rows = list(CursorJournal(journal.path).replay())
+    pending = [r["pending"]["digest"] for r in rows if "pending" in r]
+    done = [r["done"] for r in rows if "done" in r]
+    assert pending and sorted(pending) == sorted(done)
+    assert "this-is-not" not in pending  # replay yielded dicts only
+
+
+def test_pending_rows_restored_on_resume(tmp_path):
+    """A pending row with no matching done row is re-dispatched after
+    a crash; a completed one is not."""
+    path = str(tmp_path / "j.jsonl")
+    lost, finished = _dep(1), _dep(2)
+    journal = CursorJournal(path).open()
+    journal.append({"block": 5, "hash": "0xabc",
+                    "digests": [lost.digest, finished.digest]})
+    for deployment in (lost, finished):
+        journal.append({"pending": {
+            "digest": deployment.digest,
+            "address": deployment.address, "block": deployment.block,
+            "tx_hash": deployment.tx_hash, "code": deployment.code,
+            "proxy_target": None,
+        }})
+    journal.append({"done": finished.digest})
+    journal.close()
+
+    follower = ChainFollower(None, journal=CursorJournal(path),
+                             resume=True)
+    assert follower.cursor == 5
+    assert follower.seen_digests == {lost.digest, finished.digest}
+    assert [row["digest"] for row in follower.pending_rows] == \
+        [lost.digest]
+
+    backend = _FakeBackend()
+    metrics = WatchMetrics(metrics_mod.get_registry())
+    dispatcher = StreamDispatcher(backend, metrics,
+                                  follower.seen_digests, None)
+    dispatcher.restore_pending(follower.pending_rows)
+    dispatcher.drain(blocking=True)
+    assert backend.analyzed_digests() == [lost.digest]
+
+
+# ---------------------------------------------------------------------------
+# knobs + status surface
+# ---------------------------------------------------------------------------
+
+
+def test_watch_env_knobs_are_registered(monkeypatch):
+    from mythril_tpu.support.env import EnvSpecError, validate_env
+
+    monkeypatch.setenv("MYTHRIL_TPU_WATCH_CONFIRMATIONS", "abc")
+    with pytest.raises(EnvSpecError):
+        validate_env()
+    monkeypatch.setenv("MYTHRIL_TPU_WATCH_CONFIRMATIONS", "2")
+    monkeypatch.setenv("MYTHRIL_TPU_WATCH_POLL_S", "0.5")
+    monkeypatch.setenv("MYTHRIL_TPU_WATCH_BACKLOG", "16")
+    monkeypatch.setenv("MYTHRIL_TPU_WATCH_FROM_BLOCK", "0")
+    validate_env()
+    monkeypatch.setenv("MYTHRIL_TPU_WATCH_BACKLOG", "0")  # floor is 1
+    with pytest.raises(EnvSpecError):
+        validate_env()
+
+
+def test_confirmation_lag_holds_cursor_back():
+    chain = MockChain(seed=9, blocks=20, deployments=10,
+                      head_start=20, head_step=1)
+    backend = _FakeBackend()
+    _pool, service = _service(chain, backend, confirmations=5,
+                              until_block=None)
+    service.follower.poll_head()
+    while True:
+        block = service.follower.next_block()
+        if block is None:
+            break
+        service._process_block(block)
+    assert service.follower.cursor == 20 - 5
+    assert service.follower.lag_blocks() == 5
+
+
+def test_debug_status_inactive_without_watcher():
+    assert debug_status() == {"active": False}
+
+
+def test_run_watch_without_provider_exits_2(capsys):
+    import argparse
+
+    from mythril_tpu.watch import run_watch
+
+    args = argparse.Namespace(rpc=None)
+    old = os.environ.pop("MYTHRIL_TPU_RPC_PROVIDERS", None)
+    try:
+        assert run_watch(args) == 2
+    finally:
+        if old is not None:
+            os.environ["MYTHRIL_TPU_RPC_PROVIDERS"] = old
+    assert "no RPC provider" in capsys.readouterr().err
